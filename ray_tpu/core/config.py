@@ -1,0 +1,129 @@
+"""Runtime configuration table.
+
+One declarative table of tunables, every entry overridable by an
+``RT_<NAME>`` environment variable — the same single-source-of-truth shape
+as the reference's ``RAY_CONFIG`` macro table
+(`src/ray/common/ray_config_def.h`, 217 entries, env-overridable) without
+the C++ preprocessor.  Processes spawned by the runtime inherit overrides
+through the environment, and ``init(_system_config=...)`` can override
+programmatically (forwarded to children like `services.py` does).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RT_"
+
+
+def _coerce(raw: str, typ):
+    if typ is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    if typ is dict:
+        return json.loads(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # ---- object store ------------------------------------------------
+    #: bytes; default sized at init time from system memory if 0
+    object_store_memory: int = 0
+    #: objects <= this many bytes are returned inline in the RPC reply
+    #: and live in the owner's in-process store (reference: direct
+    #: returns via the core-worker memory store).
+    max_direct_call_object_size: int = 100 * 1024
+    #: chunk size for node-to-node object transfer (reference default
+    #: 5 MiB, `ray_config_def.h` object_manager_default_chunk_size).
+    object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    #: fraction of store capacity above which eviction kicks in
+    object_store_eviction_watermark: float = 1.0
+
+    # ---- scheduling --------------------------------------------------
+    #: delay before a failed task is retried (reference
+    #: task_retry_delay_ms, `ray_config_def.h:410`)
+    task_retry_delay_ms: int = 0
+    #: default max retries for tasks (reference default 3)
+    task_max_retries: int = 3
+    #: workers prestarted per node at init; 0 = num_cpus
+    num_workers_per_node: int = 0
+    #: soft cap on lease pipelining per worker
+    max_tasks_in_flight_per_worker: int = 64
+    #: top-k fraction for hybrid scheduling randomization (reference
+    #: hybrid policy top-k, `hybrid_scheduling_policy.h:50`)
+    scheduler_top_k_fraction: float = 0.2
+    #: pack threshold before spilling to other nodes (reference
+    #: scheduler_spread_threshold)
+    scheduler_spread_threshold: float = 0.5
+
+    # ---- health / fault tolerance ------------------------------------
+    #: period between controller->node health probes (reference
+    #: health_check_period_ms, `ray_config_def.h:843`)
+    health_check_period_ms: int = 1000
+    #: probes missed before a node is declared dead
+    health_check_failure_threshold: int = 5
+    #: max actor restarts when not specified per-actor
+    actor_max_restarts: int = 0
+
+    # ---- rpc ---------------------------------------------------------
+    #: max message size on the control plane
+    rpc_max_message_bytes: int = 512 * 1024 * 1024
+    #: driver/worker connection timeout
+    rpc_connect_timeout_s: float = 30.0
+
+    # ---- metrics / events --------------------------------------------
+    metrics_report_interval_ms: int = 2000
+    task_events_buffer_size: int = 10000
+
+    # ---- paths -------------------------------------------------------
+    session_dir: str = ""  # filled at init: /tmp/ray_tpu/session_<ts>
+
+    def apply_env_overrides(self) -> "Config":
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+        return self
+
+    def apply_dict(self, overrides: Dict[str, Any]) -> "Config":
+        known = {f.name for f in fields(self)}
+        for k, v in overrides.items():
+            if k not in known:
+                raise ValueError(f"unknown config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    def to_env(self) -> Dict[str, str]:
+        """Serialize every non-default entry as RT_* env vars so spawned
+        processes (node daemons, workers) see the same config."""
+        out = {}
+        default = Config()
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v != getattr(default, f.name):
+                out[_ENV_PREFIX + f.name.upper()] = (
+                    json.dumps(v) if isinstance(v, dict) else str(v)
+                )
+        return out
+
+
+_global: Config | None = None
+
+
+def get_config() -> Config:
+    global _global
+    if _global is None:
+        _global = Config().apply_env_overrides()
+    return _global
+
+
+def set_config(cfg: Config) -> None:
+    global _global
+    _global = cfg

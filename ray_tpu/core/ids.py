@@ -1,0 +1,180 @@
+"""Binary identifiers with embedded lineage.
+
+Design follows the reference's nested-ID scheme (`src/ray/common/id.h`,
+`id_def.h`): a JobID is embedded in every TaskID, and an ObjectID is its
+creating TaskID plus a return/put index — so ownership and lineage can be
+recovered from the bits of an ID alone, with no directory lookup.
+
+Layout (bytes):
+    JobID    = 4 random bytes
+    ActorID  = JobID(4) + 8 random          -> 12
+    TaskID   = JobID(4) + 10 random         -> 14  (actor tasks embed ActorID)
+    ObjectID = TaskID(14) + 4 LE index      -> 18
+    NodeID / WorkerID / PlacementGroupID = 14 random bytes
+
+IDs are immutable, hashable, and cheap to pickle (they serialize as raw
+bytes).  Hex forms are used in logs and the state API.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+_JOB_LEN = 4
+_ACTOR_LEN = 12
+_TASK_LEN = 14
+_OBJECT_LEN = 18
+_UNIQUE_LEN = 14
+
+# Index space for object ids: returns are 1..MAX_RETURNS, puts are
+# MAX_RETURNS+1.. (mirrors the reference's put/return index split,
+# `src/ray/common/id.h` ObjectID::FromIndex).
+MAX_RETURNS = 1 << 24
+_PUT_BASE = MAX_RETURNS
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    _LEN = 0
+
+    def __init__(self, b: bytes):
+        if len(b) != self._LEN:
+            raise ValueError(
+                f"{type(self).__name__} requires {self._LEN} bytes, got {len(b)}"
+            )
+        self._bytes = bytes(b)
+
+    @classmethod
+    def random(cls) -> "BaseID":
+        return cls(os.urandom(cls._LEN))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls._LEN)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self._LEN
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    @classmethod
+    def from_hex(cls, h: str) -> "BaseID":
+        return cls(bytes.fromhex(h))
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    __slots__ = ()
+    _LEN = _JOB_LEN
+
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    @classmethod
+    def from_int(cls, i: int) -> "JobID":
+        return cls(struct.pack("<I", i))
+
+
+class NodeID(BaseID):
+    __slots__ = ()
+    _LEN = _UNIQUE_LEN
+
+
+class WorkerID(BaseID):
+    __slots__ = ()
+    _LEN = _UNIQUE_LEN
+
+
+class PlacementGroupID(BaseID):
+    __slots__ = ()
+    _LEN = _UNIQUE_LEN
+
+
+class ActorID(BaseID):
+    __slots__ = ()
+    _LEN = _ACTOR_LEN
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(job_id.binary() + os.urandom(_ACTOR_LEN - _JOB_LEN))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_LEN])
+
+
+class TaskID(BaseID):
+    __slots__ = ()
+    _LEN = _TASK_LEN
+
+    @classmethod
+    def for_job(cls, job_id: JobID) -> "TaskID":
+        return cls(job_id.binary() + os.urandom(_TASK_LEN - _JOB_LEN))
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(
+            actor_id.job_id().binary() + os.urandom(_TASK_LEN - _JOB_LEN)
+        )
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_LEN])
+
+
+class ObjectID(BaseID):
+    """TaskID + 4-byte little-endian index.
+
+    Return values use indices 1..MAX_RETURNS; ``put`` objects use
+    indices above ``MAX_RETURNS`` — the creating task (and therefore the
+    owner and the lineage needed for reconstruction) is recoverable from
+    the first 14 bytes.
+    """
+
+    __slots__ = ()
+    _LEN = _OBJECT_LEN
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        if not 1 <= index <= MAX_RETURNS:
+            raise ValueError(f"return index out of range: {index}")
+        return cls(task_id.binary() + struct.pack("<I", index))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        if not 1 <= put_index < (1 << 32) - _PUT_BASE:
+            raise ValueError(f"put index out of range: {put_index}")
+        return cls(task_id.binary() + struct.pack("<I", _PUT_BASE + put_index))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_TASK_LEN])
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+    def index(self) -> int:
+        return struct.unpack("<I", self._bytes[_TASK_LEN:])[0]
+
+    def is_return(self) -> bool:
+        return self.index() <= _PUT_BASE
+
+    def is_put(self) -> bool:
+        return self.index() > _PUT_BASE

@@ -1,0 +1,85 @@
+"""User-visible exception hierarchy.
+
+Mirrors the surface of the reference's `python/ray/exceptions.py` so users
+switching over find the same failure taxonomy: task errors wrap the user
+traceback, worker/actor/node crashes and lost objects are distinct types,
+and `get` re-raises the underlying cause.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get` with the remote
+    traceback attached (reference: RayTaskError)."""
+
+    def __init__(self, message: str, remote_traceback: str = "", cause_type: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+        self.cause_type = cause_type
+
+    def __str__(self):
+        base = super().__str__()
+        if self.remote_traceback:
+            return f"{base}\n\n--- remote traceback ---\n{self.remote_traceback}"
+        return base
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (reference:
+    WorkerCrashedError)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead and will not be restarted (reference:
+    RayActorError / ActorDiedError)."""
+
+    def __init__(self, message: str = "The actor died.", actor_id=None):
+        super().__init__(message)
+        self.actor_id = actor_id
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object was lost from the store and could not be reconstructed
+    from lineage (reference: ObjectLostError)."""
+
+    def __init__(self, message: str = "Object lost.", object_id=None):
+        super().__init__(message)
+        self.object_id = object_id
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction was attempted but failed (max retries
+    exceeded or lineage evicted)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get(timeout=...)` expired."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the computation died."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing the task/actor runtime environment failed."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """The placement group cannot fit in the cluster."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Task killed by the memory monitor (reference: OomKillerError)."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor max_pending_calls exceeded."""

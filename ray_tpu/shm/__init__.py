@@ -1,0 +1,220 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+The binding seam mirrors the reference's choice of a thin native binding
+under the Python API (`python/ray/_raylet.pyx` over the C++ core), using
+ctypes + an extern-C surface instead of Cython.  Zero-copy reads: Python
+mmaps the same ``/dev/shm`` segment and returns memoryviews at the
+offsets the C side hands back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "shmstore.cc")
+_LIB = os.path.join(_HERE, "libshmstore.so")
+
+OK = 0
+EXISTS = -1
+NOT_FOUND = -2
+OOM = -3
+TIMEOUT = -4
+BAD_STATE = -5
+
+_build_lock = threading.Lock()
+
+
+def _ensure_built() -> str:
+    with _build_lock:
+        if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            tmp = _LIB + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC, "-lpthread", "-lrt"],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _LIB)
+    return _LIB
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_ensure_built())
+            u64 = ctypes.c_uint64
+            p = ctypes.c_void_p
+            lib.rts_create_store.restype = p
+            lib.rts_create_store.argtypes = [ctypes.c_char_p, u64, u64]
+            lib.rts_open_store.restype = p
+            lib.rts_open_store.argtypes = [ctypes.c_char_p]
+            lib.rts_close.argtypes = [p]
+            lib.rts_unlink.argtypes = [ctypes.c_char_p]
+            lib.rts_create.argtypes = [p, ctypes.c_char_p, u64, ctypes.POINTER(u64)]
+            lib.rts_seal.argtypes = [p, ctypes.c_char_p]
+            lib.rts_get.argtypes = [p, ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.POINTER(u64), ctypes.POINTER(u64)]
+            lib.rts_release.argtypes = [p, ctypes.c_char_p]
+            lib.rts_delete.argtypes = [p, ctypes.c_char_p]
+            lib.rts_contains.argtypes = [p, ctypes.c_char_p]
+            lib.rts_reap_creator.argtypes = [p, u64]
+            for fn in ("rts_used", "rts_capacity", "rts_count", "rts_evictions"):
+                getattr(lib, fn).restype = u64
+                getattr(lib, fn).argtypes = [p]
+            _lib = lib
+    return _lib
+
+
+class ShmStoreError(Exception):
+    pass
+
+
+class ObjectExistsError(ShmStoreError):
+    pass
+
+
+class ObjectNotFoundError(ShmStoreError):
+    pass
+
+
+class StoreFullError(ShmStoreError):
+    pass
+
+
+def _check(rc: int, what: str):
+    if rc == OK:
+        return
+    if rc == EXISTS:
+        raise ObjectExistsError(what)
+    if rc == NOT_FOUND:
+        raise ObjectNotFoundError(what)
+    if rc == OOM:
+        raise StoreFullError(what)
+    if rc == TIMEOUT:
+        raise TimeoutError(what)
+    raise ShmStoreError(f"{what}: rc={rc}")
+
+
+def _pad_id(object_id: bytes) -> bytes:
+    if len(object_id) != 18:
+        raise ValueError(f"object id must be 18 bytes, got {len(object_id)}")
+    return object_id
+
+
+class ShmStore:
+    """One node-local store segment; open once per process."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False,
+                 table_cap: int = 0):
+        self.name = name
+        lib = _load()
+        if create:
+            if capacity <= 0:
+                raise ValueError("capacity must be > 0 when creating a store")
+            self._h = lib.rts_create_store(name.encode(), capacity, table_cap)
+        else:
+            self._h = lib.rts_open_store(name.encode())
+        if not self._h:
+            raise ShmStoreError(
+                f"could not {'create' if create else 'open'} store {name!r}"
+            )
+        # Python-side zero-copy view of the same segment.
+        fd = os.open(f"/dev/shm/{name.lstrip('/')}", os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                # User-held memoryviews keep the mapping alive; the OS
+                # reclaims it at process exit.
+                pass
+            _load().rts_close(self._h)
+
+    @staticmethod
+    def unlink(name: str):
+        _load().rts_unlink(name.encode())
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- object ops ----------------------------------------------------
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate a writable buffer; caller must seal() when done."""
+        off = ctypes.c_uint64()
+        rc = _load().rts_create(self._h, _pad_id(object_id), size, ctypes.byref(off))
+        _check(rc, f"create {object_id.hex()}")
+        return self._view[off.value : off.value + size]
+
+    def seal(self, object_id: bytes):
+        _check(_load().rts_seal(self._h, _pad_id(object_id)), f"seal {object_id.hex()}")
+
+    def put(self, object_id: bytes, data) -> None:
+        """create + copy + seal in one call."""
+        data = memoryview(data).cast("B")
+        buf = self.create(object_id, data.nbytes)
+        buf[:] = data
+        self.seal(object_id)
+
+    def get(self, object_id: bytes, timeout_ms: int = 0) -> memoryview:
+        """Pin and return a read view.  timeout_ms: 0 = non-blocking,
+        <0 = wait forever."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _load().rts_get(self._h, _pad_id(object_id), timeout_ms,
+                             ctypes.byref(off), ctypes.byref(size))
+        _check(rc, f"get {object_id.hex()}")
+        return self._view[off.value : off.value + size.value]
+
+    def release(self, object_id: bytes):
+        _load().rts_release(self._h, _pad_id(object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        rc = _load().rts_delete(self._h, _pad_id(object_id))
+        return rc == OK
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(_load().rts_contains(self._h, _pad_id(object_id)))
+
+    def reap_creator(self, pid: int) -> int:
+        """Drop unsealed objects created by a dead process."""
+        return _load().rts_reap_creator(self._h, pid)
+
+    # -- stats ---------------------------------------------------------
+    @property
+    def used(self) -> int:
+        return _load().rts_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return _load().rts_capacity(self._h)
+
+    @property
+    def count(self) -> int:
+        return _load().rts_count(self._h)
+
+    @property
+    def evictions(self) -> int:
+        return _load().rts_evictions(self._h)

@@ -1,0 +1,549 @@
+// Per-node shared-memory object store.
+//
+// Capability-equivalent of the reference's plasma store
+// (`src/ray/object_manager/plasma/store.h:55`): a node-local arena of
+// immutable objects with create/seal/get/release lifecycle, pinning,
+// and LRU eviction of sealed-unpinned objects
+// (`plasma/eviction_policy.h`, `object_lifecycle_manager.h`).
+//
+// Architectural departure from plasma (deliberate, TPU-first): plasma is
+// a daemon which clients talk to over a unix socket with fd-passing
+// (`plasma/fling.h`); here the *entire store state lives inside the
+// shared-memory segment* — object table, allocator free list, and a
+// process-shared robust mutex — so every process on the node (workers,
+// node daemon, driver) maps the segment once and performs metadata
+// operations directly, with no per-op IPC.  On a TPU host the store only
+// carries host-side data (batches, checkpoints metadata, pickled
+// results); device arrays stay resident on the TPU and never pass
+// through it.
+//
+// Concurrency: one robust process-shared mutex guards the table +
+// allocator; a process-shared condvar broadcasts seals so blocking Get
+// can wait without polling.  If a process dies while holding the lock
+// the next locker recovers via EOWNERDEAD and makes the state
+// consistent.
+//
+// Build: g++ -O2 -shared -fPIC -o libshmstore.so shmstore.cc -lpthread -lrt
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <new>
+
+extern "C" {
+
+#define RTS_OK 0
+#define RTS_EXISTS (-1)
+#define RTS_NOT_FOUND (-2)
+#define RTS_OOM (-3)
+#define RTS_TIMEOUT (-4)
+#define RTS_BAD_STATE (-5)
+#define RTS_IO (-6)
+
+static const uint64_t kMagic = 0x5254535348'4d0001ULL;  // "RTSSHM" v1
+static const uint64_t kAlign = 64;
+static const int kIdLen = 24;  // padded; ObjectID is 18 bytes
+
+enum EntryState : uint8_t {
+  ENTRY_FREE = 0,
+  ENTRY_CREATED = 1,
+  ENTRY_SEALED = 2,
+  ENTRY_TOMBSTONE = 3,  // deleted slot, keeps probe chains intact
+};
+
+struct Entry {
+  uint8_t id[kIdLen];
+  uint8_t state;
+  uint8_t pad_[3];
+  uint32_t pins;
+  uint64_t off;    // data offset from segment base
+  uint64_t size;   // payload bytes
+  uint64_t alloc;  // bytes actually taken from the arena (>= size)
+  uint64_t lru;    // last-touch tick
+  uint64_t creator_pid;
+};
+
+// Free blocks form an address-ordered doubly-linked list threaded
+// through the arena itself (offsets, not pointers — every process maps
+// the segment at a different base address).
+struct FreeBlock {
+  uint64_t size;
+  uint64_t next;  // offset of next free block, 0 = none
+  uint64_t prev;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t table_cap;  // power of two
+  uint64_t table_off;
+  uint64_t arena_off;
+  uint64_t arena_size;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  uint64_t lru_tick;
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t free_head;  // offset of first free block
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+};
+
+struct Handle {
+  uint8_t* base;
+  Header* hdr;
+  Entry* table;
+  uint64_t mapped_size;  // actual mmap length (don't trust hdr on teardown)
+  int fd;
+  char name[256];
+};
+
+static uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+static uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 18 significant bytes.
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 18; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+static void lock(Header* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the lock.  Table/allocator mutations below
+    // are each small and idempotent-ish; mark consistent and continue —
+    // worst case an object leaks until deleted by its owner's GC.
+    pthread_mutex_consistent(&hdr->mu);
+  }
+}
+
+static void unlock(Header* hdr) { pthread_mutex_unlock(&hdr->mu); }
+
+static Entry* find_entry(Handle* h, const uint8_t* id) {
+  uint64_t mask = h->hdr->table_cap - 1;
+  uint64_t i = id_hash(id) & mask;
+  for (uint64_t probe = 0; probe <= mask; probe++, i = (i + 1) & mask) {
+    Entry* e = &h->table[i];
+    if (e->state == ENTRY_FREE) return nullptr;
+    if (e->state != ENTRY_TOMBSTONE && memcmp(e->id, id, 18) == 0) return e;
+  }
+  return nullptr;
+}
+
+static Entry* find_slot(Handle* h, const uint8_t* id) {
+  uint64_t mask = h->hdr->table_cap - 1;
+  uint64_t i = id_hash(id) & mask;
+  Entry* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe <= mask; probe++, i = (i + 1) & mask) {
+    Entry* e = &h->table[i];
+    if (e->state == ENTRY_FREE) return first_tomb ? first_tomb : e;
+    if (e->state == ENTRY_TOMBSTONE) {
+      if (!first_tomb) first_tomb = e;
+    } else if (memcmp(e->id, id, 18) == 0) {
+      return e;  // existing
+    }
+  }
+  return first_tomb;  // table full of tombstones/live
+}
+
+// ---- allocator ------------------------------------------------------
+
+static FreeBlock* fb(Handle* h, uint64_t off) {
+  return reinterpret_cast<FreeBlock*>(h->base + off);
+}
+
+// Allocate nbytes from the free list (first fit, address ordered).
+// Returns offset or 0 on failure; *actual receives the bytes really
+// taken (may exceed the request when a whole block is consumed).
+static uint64_t arena_alloc(Handle* h, uint64_t nbytes, uint64_t* actual) {
+  Header* hdr = h->hdr;
+  nbytes = align_up(nbytes < sizeof(FreeBlock) ? sizeof(FreeBlock) : nbytes, kAlign);
+  uint64_t off = hdr->free_head;
+  while (off) {
+    FreeBlock* b = fb(h, off);
+    if (b->size >= nbytes) {
+      uint64_t rem = b->size - nbytes;
+      if (rem >= kAlign + sizeof(FreeBlock)) {
+        // split: tail remains free
+        uint64_t tail_off = off + nbytes;
+        FreeBlock* tail = fb(h, tail_off);
+        tail->size = rem;
+        tail->next = b->next;
+        tail->prev = b->prev;
+        if (b->prev)
+          fb(h, b->prev)->next = tail_off;
+        else
+          hdr->free_head = tail_off;
+        if (b->next) fb(h, b->next)->prev = tail_off;
+      } else {
+        nbytes = b->size;  // take whole block
+        if (b->prev)
+          fb(h, b->prev)->next = b->next;
+        else
+          hdr->free_head = b->next;
+        if (b->next) fb(h, b->next)->prev = b->prev;
+      }
+      hdr->used_bytes += nbytes;
+      *actual = nbytes;
+      return off;
+    }
+    off = b->next;
+  }
+  return 0;
+}
+
+// Free [off, off+size) back into the address-ordered list, coalescing
+// with adjacent free blocks.
+static void arena_free(Handle* h, uint64_t off, uint64_t size) {
+  Header* hdr = h->hdr;
+  size = align_up(size < sizeof(FreeBlock) ? sizeof(FreeBlock) : size, kAlign);
+  hdr->used_bytes -= size;
+  // find insertion point (prev < off < next)
+  uint64_t prev = 0, next = hdr->free_head;
+  while (next && next < off) {
+    prev = next;
+    next = fb(h, next)->next;
+  }
+  uint64_t blk_off = off;
+  uint64_t blk_size = size;
+  // coalesce with prev
+  if (prev && prev + fb(h, prev)->size == off) {
+    blk_off = prev;
+    blk_size += fb(h, prev)->size;
+    prev = fb(h, prev)->prev;
+    // prev now points before the merged block; relink below rebuilds
+  }
+  // coalesce with next
+  if (next && blk_off + blk_size == next) {
+    blk_size += fb(h, next)->size;
+    next = fb(h, next)->next;
+  }
+  FreeBlock* b = fb(h, blk_off);
+  b->size = blk_size;
+  b->prev = prev;
+  b->next = next;
+  if (prev)
+    fb(h, prev)->next = blk_off;
+  else
+    hdr->free_head = blk_off;
+  if (next) fb(h, next)->prev = blk_off;
+}
+
+// Evict the single LRU sealed+unpinned object.  Caller holds the lock.
+// Mirrors plasma's eviction policy (`plasma/eviction_policy.h`): only
+// sealed, unreferenced objects are evictable.  Returns 1 if something
+// was evicted, 0 if nothing is evictable.
+static int evict_one(Handle* h) {
+  Header* hdr = h->hdr;
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < hdr->table_cap; i++) {
+    Entry* e = &h->table[i];
+    if (e->state == ENTRY_SEALED && e->pins == 0) {
+      if (!victim || e->lru < victim->lru) victim = e;
+    }
+  }
+  if (!victim) return 0;
+  arena_free(h, victim->off, victim->alloc);
+  victim->state = ENTRY_TOMBSTONE;
+  hdr->num_objects--;
+  hdr->num_evictions++;
+  hdr->bytes_evicted += victim->size;
+  return 1;
+}
+
+// ---- lifecycle ------------------------------------------------------
+
+static Handle* map_segment(const char* name, int create, uint64_t segment_size) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (create) {
+    if (ftruncate(fd, (off_t)segment_size) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    segment_size = (uint64_t)st.st_size;
+  }
+  void* base = mmap(nullptr, segment_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Handle* h = new Handle();
+  h->base = (uint8_t*)base;
+  h->hdr = (Header*)base;
+  h->mapped_size = segment_size;
+  h->fd = fd;
+  snprintf(h->name, sizeof(h->name), "%s", name);
+  return h;
+}
+
+void* rts_create_store(const char* name, uint64_t capacity, uint64_t table_cap) {
+  if (table_cap == 0) table_cap = 1 << 16;
+  // round table_cap up to power of two
+  uint64_t tc = 1;
+  while (tc < table_cap) tc <<= 1;
+  table_cap = tc;
+
+  uint64_t hdr_size = align_up(sizeof(Header), kAlign);
+  uint64_t table_size = align_up(table_cap * sizeof(Entry), kAlign);
+  uint64_t arena_size = align_up(capacity, kAlign);
+  uint64_t segment_size = hdr_size + table_size + arena_size;
+
+  Handle* h = map_segment(name, 1, segment_size);
+  if (!h) return nullptr;
+
+  Header* hdr = h->hdr;
+  memset(hdr, 0, sizeof(Header));
+  hdr->segment_size = segment_size;
+  hdr->table_cap = table_cap;
+  hdr->table_off = hdr_size;
+  hdr->arena_off = hdr_size + table_size;
+  hdr->arena_size = arena_size;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&hdr->cv, &ca);
+
+  memset(h->base + hdr->table_off, 0, table_size);
+  h->table = (Entry*)(h->base + hdr->table_off);
+
+  // one big free block
+  FreeBlock* b = fb(h, hdr->arena_off);
+  b->size = arena_size;
+  b->next = 0;
+  b->prev = 0;
+  hdr->free_head = hdr->arena_off;
+
+  __sync_synchronize();
+  hdr->magic = kMagic;
+  return h;
+}
+
+void* rts_open_store(const char* name) {
+  Handle* h = map_segment(name, 0, 0);
+  if (!h) return nullptr;
+  // wait briefly for creator to finish init
+  for (int i = 0; i < 1000 && h->hdr->magic != kMagic; i++) usleep(1000);
+  if (h->hdr->magic != kMagic) {
+    munmap(h->base, h->mapped_size);
+    close(h->fd);
+    delete h;
+    return nullptr;
+  }
+  h->table = (Entry*)(h->base + h->hdr->table_off);
+  return h;
+}
+
+int rts_close(void* hv) {
+  Handle* h = (Handle*)hv;
+  munmap(h->base, h->mapped_size);
+  close(h->fd);
+  delete h;
+  return RTS_OK;
+}
+
+int rts_unlink(const char* name) { return shm_unlink(name) == 0 ? RTS_OK : RTS_IO; }
+
+// ---- object ops -----------------------------------------------------
+
+int rts_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* out_off) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  lock(hdr);
+  Entry* existing = find_entry(h, id);
+  if (existing) {
+    unlock(hdr);
+    return RTS_EXISTS;
+  }
+  // Evict-until-fit: retry the allocation after each eviction so
+  // fragmentation is resolved by coalescing, not just total-free math.
+  uint64_t alloc_size = 0;
+  uint64_t off = arena_alloc(h, size, &alloc_size);
+  while (!off) {
+    if (!evict_one(h)) {
+      unlock(hdr);
+      return RTS_OOM;
+    }
+    off = arena_alloc(h, size, &alloc_size);
+  }
+  Entry* e = find_slot(h, id);
+  if (!e) {
+    arena_free(h, off, alloc_size);
+    unlock(hdr);
+    return RTS_OOM;  // table full
+  }
+  memcpy(e->id, id, 18);
+  memset(e->id + 18, 0, kIdLen - 18);
+  e->state = ENTRY_CREATED;
+  e->pins = 1;  // creator holds a pin until seal
+  e->off = off;
+  e->size = size;
+  e->alloc = alloc_size;
+  e->lru = ++hdr->lru_tick;
+  e->creator_pid = (uint64_t)getpid();
+  hdr->num_objects++;
+  unlock(hdr);
+  *out_off = off;
+  return RTS_OK;
+}
+
+int rts_seal(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  lock(hdr);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    unlock(hdr);
+    return RTS_NOT_FOUND;
+  }
+  if (e->state != ENTRY_CREATED) {
+    unlock(hdr);
+    return RTS_BAD_STATE;
+  }
+  e->state = ENTRY_SEALED;
+  if (e->pins > 0) e->pins--;  // drop creator pin
+  e->lru = ++hdr->lru_tick;
+  pthread_cond_broadcast(&hdr->cv);
+  unlock(hdr);
+  return RTS_OK;
+}
+
+int rts_get(void* hv, const uint8_t* id, int64_t timeout_ms, uint64_t* out_off,
+            uint64_t* out_size) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec++;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  lock(hdr);
+  for (;;) {
+    Entry* e = find_entry(h, id);
+    if (e && e->state == ENTRY_SEALED) {
+      e->pins++;
+      e->lru = ++hdr->lru_tick;
+      *out_off = e->off;
+      *out_size = e->size;
+      unlock(hdr);
+      return RTS_OK;
+    }
+    if (timeout_ms == 0) {
+      unlock(hdr);
+      return e ? RTS_BAD_STATE : RTS_NOT_FOUND;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&hdr->cv, &hdr->mu);
+    } else {
+      rc = pthread_cond_timedwait(&hdr->cv, &hdr->mu, &deadline);
+    }
+    if (rc == ETIMEDOUT) {
+      unlock(hdr);
+      return RTS_TIMEOUT;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&hdr->mu);
+  }
+}
+
+int rts_release(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  lock(h->hdr);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h->hdr);
+    return RTS_NOT_FOUND;
+  }
+  if (e->pins > 0) e->pins--;
+  unlock(h->hdr);
+  return RTS_OK;
+}
+
+int rts_delete(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  lock(hdr);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    unlock(hdr);
+    return RTS_NOT_FOUND;
+  }
+  if (e->pins > 0) {
+    // Pinned (including the creator pin on unsealed objects): refuse —
+    // freeing here would be a use-after-free for the pin holder.
+    unlock(hdr);
+    return RTS_BAD_STATE;
+  }
+  arena_free(h, e->off, e->alloc);
+  e->state = ENTRY_TOMBSTONE;
+  hdr->num_objects--;
+  unlock(hdr);
+  return RTS_OK;
+}
+
+int rts_contains(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  lock(h->hdr);
+  Entry* e = find_entry(h, id);
+  int r = (e && e->state == ENTRY_SEALED) ? 1 : 0;
+  unlock(h->hdr);
+  return r;
+}
+
+// Delete every object created by a now-dead process that was never
+// sealed (orphan cleanup after a worker crash).
+int rts_reap_creator(void* hv, uint64_t pid) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  int n = 0;
+  lock(hdr);
+  for (uint64_t i = 0; i < hdr->table_cap; i++) {
+    Entry* e = &h->table[i];
+    if (e->state == ENTRY_CREATED && e->creator_pid == pid) {
+      arena_free(h, e->off, e->alloc);
+      e->state = ENTRY_TOMBSTONE;
+      hdr->num_objects--;
+      n++;
+    }
+  }
+  unlock(hdr);
+  return n;
+}
+
+uint64_t rts_used(void* hv) { return ((Handle*)hv)->hdr->used_bytes; }
+uint64_t rts_capacity(void* hv) { return ((Handle*)hv)->hdr->arena_size; }
+uint64_t rts_count(void* hv) { return ((Handle*)hv)->hdr->num_objects; }
+uint64_t rts_evictions(void* hv) { return ((Handle*)hv)->hdr->num_evictions; }
+
+}  // extern "C"

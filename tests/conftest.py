@@ -1,0 +1,39 @@
+"""Test harness configuration.
+
+Sharding/collective tests run on a virtual 8-device CPU mesh — the same
+trick the reference uses for cluster tests without a cluster
+(`python/ray/cluster_utils.py`): everything runs on one host, but the code
+paths exercised are the real multi-device ones.  Env vars must be set
+before jax initializes its backends, hence this file sets them at import
+time (conftest is imported before any test module).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_start():
+    """Start a fresh single-node runtime for a test, shut down after."""
+    import ray_tpu as rt
+
+    rt.init(num_workers=2, ignore_reinit_error=True)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def rt_start_4():
+    import ray_tpu as rt
+
+    rt.init(num_workers=4, ignore_reinit_error=True)
+    yield rt
+    rt.shutdown()

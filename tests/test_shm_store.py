@@ -1,0 +1,153 @@
+"""Tests for the C++ shared-memory object store.
+
+Modeled on the reference's plasma test coverage
+(`src/ray/object_manager/plasma/test/`): lifecycle, pinning vs eviction,
+delete semantics, blocking get across processes, orphan reaping.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.shm import (
+    ObjectExistsError,
+    ObjectNotFoundError,
+    ShmStore,
+    StoreFullError,
+)
+
+
+@pytest.fixture
+def store():
+    name = f"/rt_test_{os.getpid()}_{os.urandom(4).hex()}"
+    s = ShmStore(name, capacity=32 * 1024 * 1024, create=True)
+    yield s
+    s.close()
+    ShmStore.unlink(name)
+
+
+def oid():
+    return os.urandom(18)
+
+
+def test_put_get_roundtrip(store):
+    i = oid()
+    payload = os.urandom(100_000)
+    store.put(i, payload)
+    v = store.get(i)
+    assert bytes(v) == payload
+    store.release(i)
+
+
+def test_create_seal_lifecycle(store):
+    i = oid()
+    buf = store.create(i, 16)
+    buf[:] = b"0123456789abcdef"
+    # unsealed objects are not gettable
+    with pytest.raises(Exception):
+        store.get(i, timeout_ms=0)
+    store.seal(i)
+    assert store.contains(i)
+    assert bytes(store.get(i)) == b"0123456789abcdef"
+    store.release(i)
+
+
+def test_duplicate_create_rejected(store):
+    i = oid()
+    store.put(i, b"x")
+    with pytest.raises(ObjectExistsError):
+        store.create(i, 4)
+
+
+def test_get_missing(store):
+    with pytest.raises(ObjectNotFoundError):
+        store.get(oid(), timeout_ms=0)
+
+
+def test_delete_and_refcount(store):
+    i = oid()
+    store.put(i, b"data")
+    v = store.get(i)  # pin
+    assert not store.delete(i)  # pinned -> refused
+    store.release(i)
+    del v
+    assert store.delete(i)
+    assert not store.contains(i)
+
+
+def test_lru_eviction_skips_pinned(store):
+    pinned = oid()
+    store.put(pinned, b"p" * (8 * 1024 * 1024))
+    _ = store.get(pinned)  # keep pinned
+    # fill the store; pinned object must survive
+    for _i in range(20):
+        o = oid()
+        store.put(o, b"x" * (4 * 1024 * 1024))
+        store.release(o)
+    assert store.evictions > 0
+    assert store.contains(pinned)
+    store.release(pinned)
+
+
+def test_store_full_when_all_pinned(store):
+    held = []
+    with pytest.raises(StoreFullError):
+        for _i in range(20):
+            o = oid()
+            store.put(o, b"x" * (4 * 1024 * 1024))
+            held.append(store.get(o))  # pin everything
+
+
+def test_numpy_zero_copy(store):
+    i = oid()
+    arr = np.arange(10000, dtype=np.float32)
+    store.put(i, arr.tobytes())
+    v = store.get(i)
+    out = np.frombuffer(v, dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    del out, v
+    store.release(i)
+
+
+def _blocking_get_child(name, i, q):
+    s = ShmStore(name)
+    v = s.get(i, timeout_ms=10_000)
+    q.put(bytes(v))
+    s.release(i)
+    s.close()
+
+
+def test_cross_process_blocking_get(store):
+    i = oid()
+    q = mp.Queue()
+    p = mp.Process(target=_blocking_get_child, args=(store.name, i, q))
+    p.start()
+    import time
+
+    time.sleep(0.2)
+    store.put(i, b"late arrival")
+    assert q.get(timeout=10) == b"late arrival"
+    p.join(timeout=10)
+    assert p.exitcode == 0
+
+
+def _crash_mid_create(name, i):
+    s = ShmStore(name)
+    s.create(i, 1024)  # never sealed
+    os._exit(1)
+
+
+def test_reap_orphans_from_dead_creator(store):
+    i = oid()
+    p = mp.Process(target=_crash_mid_create, args=(store.name, i))
+    p.start()
+    p.join(timeout=10)
+    assert store.reap_creator(p.pid) == 1
+    assert not store.contains(i)
+
+
+def test_timeout(store):
+    with pytest.raises(TimeoutError):
+        store.get(oid(), timeout_ms=50)
