@@ -1,0 +1,54 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Core runtime (tasks, actors, objects, placement groups) plus libraries
+for datasets, distributed training, hyperparameter tuning, serving and
+RL — designed around JAX/XLA/Pallas/pjit.  The capability contract
+matches the reference Ray snapshot (see SURVEY.md); the architecture is
+TPU-first: meshes and ICI topology are first-class scheduler resources,
+collectives lower to `jax.lax` ops, and device arrays never ride the
+host object store.
+"""
+
+from ray_tpu import exceptions
+from ray_tpu.api import (
+    ActorClass,
+    ActorHandle,
+    RemoteFunction,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_started,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "init",
+    "is_started",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,365 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors.
+
+Surface mirrors the reference's `python/ray/_private/worker.py` public
+functions (`ray.init:1240`, `get:2601`, `put:2737`, `wait:2802`,
+`ray.remote:3191`) and `remote_function.py` / `actor.py` decorator
+products, so reference users find the same call shapes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import runtime as _rtmod
+from ray_tpu.core.config import Config, get_config, set_config
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import Runtime, get_runtime, is_initialized, set_runtime
+
+_session: Dict[str, Any] = {}
+_init_lock = threading.Lock()
+
+
+# ----------------------------------------------------------------------
+# init / shutdown
+# ----------------------------------------------------------------------
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    num_workers: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
+    **_kwargs,
+):
+    """Start (or connect to) a cluster.
+
+    With no address, boots a single-node cluster: a node daemon process
+    (hosting the controller) plus a worker pool, then connects this
+    process as the driver — the same shape as the reference's
+    `ray.init()` auto-start (`_private/worker.py:1240` + `node.py:37`).
+    """
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return _session.get("info")
+            raise exc.RayTpuError("ray_tpu.init() called twice")
+
+        cfg = Config().apply_env_overrides()
+        if _system_config:
+            cfg.apply_dict(_system_config)
+        if object_store_memory:
+            cfg.object_store_memory = object_store_memory
+        set_config(cfg)
+
+        if address is None:
+            session_dir = _make_session_dir()
+            ready_file = os.path.join(session_dir, "ready.json")
+            cmd = [
+                sys.executable,
+                "-m",
+                "ray_tpu.core.noded",
+                "--session-dir",
+                session_dir,
+                "--head",
+                "--ready-file",
+                ready_file,
+            ]
+            if num_cpus is not None:
+                cmd += ["--num-cpus", str(num_cpus)]
+            if num_tpus is not None:
+                cmd += ["--num-tpus", str(num_tpus)]
+            if resources:
+                cmd += ["--resources", json.dumps(resources)]
+            if num_workers:
+                cmd += ["--num-workers", str(num_workers)]
+            env = dict(os.environ)
+            env.update(cfg.to_env())
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=open(os.path.join(session_dir, "noded.out"), "wb"),
+                stderr=subprocess.STDOUT,
+            )
+            info = _wait_ready(ready_file, proc)
+            _session["noded_proc"] = proc
+            _session["session_dir"] = session_dir
+        else:
+            # join an existing cluster: address is the head ready-file
+            # or "host:port" of the controller plus a local socket
+            info = _resolve_address(address)
+
+        rt = Runtime("driver")
+        rt.start(info["socket_path"], tuple(info["controller_addr"]))
+        set_runtime(rt)
+        rt.controller_call(
+            "register_job", {"job_id": rt.job_id.hex(), "pid": os.getpid()}
+        )
+        _session["info"] = info
+        atexit.register(shutdown)
+        return info
+
+
+def _make_session_dir() -> str:
+    base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+    d = os.path.join(base, f"session_{int(time.time())}_{os.getpid()}")
+    os.makedirs(os.path.join(d, "logs"), exist_ok=True)
+    return d
+
+
+def _wait_ready(ready_file: str, proc, timeout: float = 60.0) -> Dict:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise exc.RayTpuError(
+                f"node daemon exited with {proc.returncode}; see session logs"
+            )
+        if os.path.exists(ready_file):
+            with open(ready_file) as f:
+                return json.load(f)
+        time.sleep(0.02)
+    raise exc.RayTpuError("timed out waiting for the node daemon to start")
+
+
+def _resolve_address(address: str) -> Dict:
+    if os.path.exists(address):
+        with open(address) as f:
+            return json.load(f)
+    raise exc.RayTpuError(
+        "address must be a ready-file path of a running cluster for now"
+    )
+
+
+def shutdown():
+    if is_initialized():
+        rt = get_runtime()
+        rt.shutdown()
+        set_runtime(None)
+    proc = _session.pop("noded_proc", None)
+    if proc is not None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    _session.pop("info", None)
+
+
+def is_started() -> bool:
+    return is_initialized()
+
+
+# ----------------------------------------------------------------------
+# object API
+# ----------------------------------------------------------------------
+def put(value: Any) -> ObjectRef:
+    return get_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    return get_runtime().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: List[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    return get_runtime().wait(refs, num_returns, timeout, fetch_local)
+
+
+# ----------------------------------------------------------------------
+# remote functions
+# ----------------------------------------------------------------------
+class RemoteFunction:
+    """Product of @remote on a function (reference:
+    `remote_function.py:40`)."""
+
+    def __init__(self, fn, options: Dict[str, Any]):
+        self._fn = fn
+        self._options = options
+        self.__name__ = getattr(fn, "__name__", "remote_function")
+
+    def remote(self, *args, **kwargs):
+        refs = get_runtime().submit_task(self._fn, list(args), kwargs, **self._options)
+        n = self._options.get("num_returns", 1)
+        return refs[0] if n == 1 else refs
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        return RemoteFunction(self._fn, merged)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Remote function cannot be called directly; use "
+            f"{self.__name__}.remote()"
+        )
+
+
+_seq_counters: Dict[bytes, int] = {}
+_seq_lock = threading.Lock()
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        refs = get_runtime().submit_actor_task(
+            self._handle, self._name, list(args), kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1, **_opts):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+
+class ActorHandle:
+    """Reference: `actor.py:1238` ActorHandle; callers get per-handle
+    ordered delivery via process-wide sequence numbers."""
+
+    def __init__(self, actor_id: ActorID, address, class_name: str,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._address = address  # (node_id, worker_id)
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    def _next_seq(self) -> int:
+        with _seq_lock:
+            n = _seq_counters.get(self._actor_id.binary(), 0)
+            _seq_counters[self._actor_id.binary()] = n + 1
+            return n
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (
+            _rebuild_handle,
+            (
+                self._actor_id.binary(),
+                self._address,
+                self._class_name,
+                self._max_task_retries,
+            ),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
+
+
+def _rebuild_handle(aid_bytes, address, class_name, max_task_retries):
+    return ActorHandle(ActorID(aid_bytes), address, class_name, max_task_retries)
+
+
+class ActorClass:
+    """Product of @remote on a class (reference: `actor.py:581`)."""
+
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = options
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        actor_id, address = get_runtime().create_actor(
+            self._cls, list(args), kwargs, **self._options
+        )
+        return ActorHandle(
+            actor_id,
+            address,
+            self._cls.__name__,
+            self._options.get("max_task_retries", 0),
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def __call__(self, *a, **k):
+        raise TypeError("Actor class cannot be instantiated directly; use .remote()")
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (reference:
+    `worker.py:3191`)."""
+
+    def _wrap(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return _wrap(args[0])
+    if args:
+        raise TypeError("@remote accepts keyword options only")
+    return _wrap
+
+
+# ----------------------------------------------------------------------
+# actor management
+# ----------------------------------------------------------------------
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = get_runtime().controller_call(
+        "get_actor", {"name": name, "namespace": namespace}
+    )
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(
+        ActorID(info["actor_id"]),
+        info["address"],
+        name,
+        info.get("max_task_retries", 0),
+    )
+
+
+def kill(handle: ActorHandle, *, no_restart: bool = True):
+    get_runtime().controller_call(
+        "kill_actor", {"actor_id": handle._actor_id.binary()}
+    )
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    raise NotImplementedError("task cancellation lands in a later milestone")
+
+
+# ----------------------------------------------------------------------
+# cluster introspection
+# ----------------------------------------------------------------------
+def nodes() -> List[Dict]:
+    return get_runtime().controller_call("get_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["resources"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    # controller's resource view reflects PG reservations; live
+    # availability comes from per-node stats
+    rt = get_runtime()
+    stats = rt.noded_call("node_stats")
+    return stats["available_resources"]

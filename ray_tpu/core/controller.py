@@ -1,0 +1,372 @@
+"""Controller: the cluster-global control plane (GCS equivalent).
+
+Role-for-role match with the reference's `GcsServer`
+(`src/ray/gcs/gcs_server/gcs_server.h:79`): node membership + health,
+the actor registry with restart-on-failure (reference:
+`gcs_actor_manager.h:307`), named actors, a KV store used for function
+shipping and library state (reference: `gcs_kv_manager.h`), job
+tracking, and placement groups (reference:
+`gcs_placement_group_manager.h`).  Storage is a pluggable store —
+in-memory by default, snapshot-to-disk optional — mirroring the
+reference's `StoreClient` split (`store_client/in_memory_store_client.h:31`).
+
+Runs inside the head node daemon process; remote node daemons connect
+over TCP (the reference colocates GCS on the head node the same way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import get_config
+from ray_tpu.core.task_spec import ActorCreationSpec, fits as _fits
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    addr: Tuple[str, int]  # (host, port) of the noded server
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.time)
+    is_head: bool = False
+    conn: Optional[rpc.Connection] = None
+
+
+@dataclass
+class ActorInfo:
+    spec: ActorCreationSpec
+    state: str = "PENDING"  # PENDING/ALIVE/RESTARTING/DEAD
+    address: Optional[Tuple[str, str]] = None  # (node_id, worker_id)
+    restarts_used: int = 0
+    death_cause: str = ""
+
+
+class Controller:
+    """Service object; methods handle_<name> are RPC entry points."""
+
+    def __init__(self):
+        self.cfg = get_config()
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor id
+        self.kv: Dict[str, bytes] = {}
+        self.jobs: Dict[str, Dict] = {}
+        self.placement_groups: Dict[bytes, Any] = {}  # filled by placement module
+        self._pg_manager = None  # set by placement module
+        self._health_task: Optional[asyncio.Task] = None
+        self._subscribers: Dict[str, List[rpc.Connection]] = {}
+
+    def start_health_checks(self):
+        self._health_task = asyncio.ensure_future(self._health_loop())
+
+    async def _health_loop(self):
+        """Active node health checking (reference:
+        `gcs_health_check_manager.h:39`)."""
+        period = self.cfg.health_check_period_ms / 1000.0
+        threshold = self.cfg.health_check_failure_threshold
+        misses: Dict[str, int] = {}
+        while True:
+            await asyncio.sleep(period)
+            for node in list(self.nodes.values()):
+                if not node.alive or node.is_head or node.conn is None:
+                    continue
+                try:
+                    await node.conn.call("ping", None, timeout=period * threshold)
+                    misses[node.node_id] = 0
+                except Exception:
+                    misses[node.node_id] = misses.get(node.node_id, 0) + 1
+                    if misses[node.node_id] >= threshold:
+                        await self._mark_node_dead(node, "health check failed")
+
+    async def _mark_node_dead(self, node: NodeInfo, reason: str):
+        if not node.alive:
+            return
+        logger.warning("node %s dead: %s", node.node_id, reason)
+        node.alive = False
+        self._publish("node_dead", {"node_id": node.node_id, "reason": reason})
+        # restart or bury actors that lived there
+        for info in list(self.actors.values()):
+            if info.address and info.address[0] == node.node_id and info.state == "ALIVE":
+                await self._handle_actor_failure(info, f"node died: {reason}")
+
+    # ---- pubsub (reference: src/ray/pubsub/) -------------------------
+    def _publish(self, channel: str, msg):
+        for conn in self._subscribers.get(channel, []):
+            if not conn.closed:
+                try:
+                    conn.send("publish", {"channel": channel, "msg": msg})
+                except Exception:
+                    pass
+
+    async def handle_subscribe(self, payload, conn):
+        self._subscribers.setdefault(payload["channel"], []).append(conn)
+        return {"ok": True}
+
+    # ---- nodes -------------------------------------------------------
+    async def handle_register_node(self, payload, conn):
+        node = NodeInfo(
+            node_id=payload["node_id"],
+            addr=tuple(payload["addr"]),
+            resources=payload["resources"],
+            labels=payload.get("labels", {}),
+            is_head=payload.get("is_head", False),
+            conn=conn,
+        )
+        self.nodes[node.node_id] = node
+        if conn is not None:
+            conn.on_close = lambda c, n=node: asyncio.ensure_future(
+                self._mark_node_dead(n, "connection lost")
+            )
+        self._publish("node_added", {"node_id": node.node_id})
+        logger.info("node registered: %s resources=%s", node.node_id, node.resources)
+        if self._pg_manager is not None:
+            self._pg_manager.retry_pending()
+        return {"ok": True}
+
+    async def handle_get_nodes(self, payload, conn):
+        return [
+            {
+                "node_id": n.node_id,
+                "addr": n.addr,
+                "resources": n.resources,
+                "labels": n.labels,
+                "alive": n.alive,
+                "is_head": n.is_head,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def handle_get_node_addr(self, payload, conn):
+        n = self.nodes.get(payload["node_id"])
+        return n.addr if n else None
+
+    # ---- kv ----------------------------------------------------------
+    async def handle_kv_put(self, payload, conn):
+        self.kv[payload["key"]] = payload["value"]
+        return {"ok": True}
+
+    # fire-and-forget variant used on the submission fast path
+    handle_kv_put_oneway = handle_kv_put
+
+    async def handle_kv_get(self, payload, conn):
+        return self.kv.get(payload["key"])
+
+    async def handle_kv_del(self, payload, conn):
+        self.kv.pop(payload["key"], None)
+        return {"ok": True}
+
+    async def handle_kv_keys(self, payload, conn):
+        prefix = payload.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---- actors (reference: gcs_actor_manager.h) ---------------------
+    async def handle_create_actor(self, spec: ActorCreationSpec, conn):
+        if spec.name is not None:
+            key = (spec.namespace, spec.name)
+            if key in self.named_actors:
+                existing = self.actors[self.named_actors[key]]
+                if existing.state != "DEAD":
+                    return {
+                        "ok": False,
+                        "error": f"actor name {spec.name!r} already taken",
+                    }
+            self.named_actors[key] = spec.actor_id.binary()
+        info = ActorInfo(spec=spec)
+        self.actors[spec.actor_id.binary()] = info
+        ok, addr_or_err = await self._place_actor(info)
+        if not ok:
+            info.state = "DEAD"
+            info.death_cause = addr_or_err
+            return {"ok": False, "error": addr_or_err}
+        info.state = "ALIVE"
+        info.address = addr_or_err
+        return {"ok": True, "address": info.address}
+
+    async def _place_actor(self, info: ActorInfo):
+        """Pick a node with room and ask its daemon to host the actor
+        (reference: `gcs_actor_scheduler.h` leasing a worker)."""
+        demand = info.spec.resources.as_dict()
+        strategy = info.spec.strategy
+        candidates = [n for n in self.nodes.values() if n.alive]
+        if strategy.kind == "node_affinity" and strategy.node_id:
+            candidates = [n for n in candidates if n.node_id == strategy.node_id]
+        if self._pg_manager is not None and strategy.kind == "placement_group":
+            node_id = self._pg_manager.node_for_bundle(
+                strategy.pg_id, strategy.pg_bundle_index
+            )
+            candidates = [n for n in candidates if n.node_id == node_id]
+        # weakest-fit: most available first (spread actors)
+        def avail(n: NodeInfo):
+            return sum(n.resources.values())
+
+        for node in sorted(candidates, key=avail, reverse=True):
+            if not _fits(demand, node.resources):
+                continue
+            try:
+                reply = await node.conn.call("host_actor", info.spec, timeout=60)
+            except Exception as e:
+                logger.warning("host_actor on %s failed: %s", node.node_id, e)
+                continue
+            if reply.get("ok"):
+                return True, (node.node_id, reply["worker_id"])
+        return False, "no node can host actor (insufficient resources)"
+
+    async def _handle_actor_failure(self, info: ActorInfo, cause: str):
+        """Restart policy (reference: gcs_actor_manager.h:274 restart on
+        worker/node death up to max_restarts)."""
+        if info.restarts_used < info.spec.max_restarts:
+            info.restarts_used += 1
+            info.state = "RESTARTING"
+            self._publish(
+                "actor_state",
+                {"actor_id": info.spec.actor_id.binary(), "state": "RESTARTING"},
+            )
+            ok, addr_or_err = await self._place_actor(info)
+            if ok:
+                info.state = "ALIVE"
+                info.address = addr_or_err
+                self._publish(
+                    "actor_state",
+                    {
+                        "actor_id": info.spec.actor_id.binary(),
+                        "state": "ALIVE",
+                        "address": addr_or_err,
+                    },
+                )
+                return
+            cause = addr_or_err
+        info.state = "DEAD"
+        info.death_cause = cause
+        self._publish(
+            "actor_state",
+            {"actor_id": info.spec.actor_id.binary(), "state": "DEAD", "cause": cause},
+        )
+
+    async def handle_actor_worker_died(self, payload, conn):
+        info = self.actors.get(payload["actor_id"])
+        if info and info.state == "ALIVE":
+            await self._handle_actor_failure(info, payload.get("cause", "worker died"))
+        return {"ok": True}
+
+    async def handle_get_actor(self, payload, conn):
+        aid = payload.get("actor_id")
+        if aid is None:
+            key = (payload.get("namespace", "default"), payload["name"])
+            aid = self.named_actors.get(key)
+            if aid is None:
+                return None
+        info = self.actors.get(aid)
+        if info is None:
+            return None
+        return {
+            "actor_id": aid,
+            "state": info.state,
+            "address": info.address,
+            "class_blob": info.spec.class_blob,
+            "max_task_retries": info.spec.max_task_retries,
+            "death_cause": info.death_cause,
+        }
+
+    async def handle_kill_actor(self, payload, conn):
+        info = self.actors.get(payload["actor_id"])
+        if info is None:
+            return {"ok": False, "error": "no such actor"}
+        info.spec.max_restarts = 0  # no restart after explicit kill
+        if info.address:
+            node = self.nodes.get(info.address[0])
+            if node and node.conn:
+                await node.conn.call(
+                    "kill_worker", {"worker_id": info.address[1]}, timeout=10
+                )
+        info.state = "DEAD"
+        info.death_cause = "killed via kill_actor"
+        for key, aid in list(self.named_actors.items()):
+            if aid == payload["actor_id"]:
+                del self.named_actors[key]
+        return {"ok": True}
+
+    async def handle_list_actors(self, payload, conn):
+        return [
+            {
+                "actor_id": aid.hex() if isinstance(aid, bytes) else aid,
+                "state": i.state,
+                "name": i.spec.name,
+                "address": i.address,
+                "restarts": i.restarts_used,
+            }
+            for aid, i in self.actors.items()
+        ]
+
+    # ---- placement groups -------------------------------------------
+    async def handle_create_placement_group(self, payload, conn):
+        info = await self._pg_manager.create(
+            payload["pg_id"],
+            payload["bundles"],
+            payload["strategy"],
+            payload.get("name", ""),
+        )
+        return {"ok": info.state == "CREATED", "state": info.state}
+
+    async def handle_pg_wait_ready(self, payload, conn):
+        info = self._pg_manager.groups.get(payload["pg_id"])
+        if info is None:
+            return {"ok": False, "error": "no such placement group"}
+        timeout = payload.get("timeout")
+        try:
+            await asyncio.wait_for(info.ready_event.wait(), timeout)
+        except asyncio.TimeoutError:
+            return {"ok": False, "state": info.state}
+        return {"ok": True, "state": info.state, "bundle_nodes": info.bundle_nodes}
+
+    async def handle_remove_placement_group(self, payload, conn):
+        self._pg_manager.remove(payload["pg_id"])
+        return {"ok": True}
+
+    async def handle_list_placement_groups(self, payload, conn):
+        return [
+            {
+                "pg_id": pid.hex(),
+                "state": i.state,
+                "strategy": i.strategy,
+                "bundles": i.bundles,
+                "bundle_nodes": i.bundle_nodes,
+                "name": i.name,
+            }
+            for pid, i in self._pg_manager.groups.items()
+        ]
+
+    # ---- jobs --------------------------------------------------------
+    async def handle_register_job(self, payload, conn):
+        self.jobs[payload["job_id"]] = {
+            "start_time": time.time(),
+            "driver_pid": payload.get("pid"),
+            "status": "RUNNING",
+        }
+        return {"ok": True}
+
+    # ---- spillback target query (used by noded schedulers) ----------
+    async def handle_find_node_for(self, payload, conn):
+        """Cluster-level placement for spilled-back leases (reference:
+        `cluster_task_manager.cc:44` spillback)."""
+        demand = payload["resources"]
+        exclude = set(payload.get("exclude", []))
+        best = None
+        for n in self.nodes.values():
+            if not n.alive or n.node_id in exclude:
+                continue
+            if _fits(demand, n.resources):
+                if best is None or sum(n.resources.values()) > sum(
+                    best.resources.values()
+                ):
+                    best = n
+        return best.node_id if best else None
+

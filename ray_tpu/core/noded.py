@@ -1,0 +1,722 @@
+"""Node daemon: the per-node runtime (raylet equivalent).
+
+One per node (reference: `src/ray/raylet/node_manager.h:119`).  Owns:
+
+- the worker pool: prestart, spawn-on-demand, death detection
+  (reference: `worker_pool.h:174`),
+- the local scheduler: FIFO-with-window dispatch against node resources,
+  worker leases with in-lease pipelining, spillback to other nodes via
+  the controller (reference: `cluster_task_manager.h:42`,
+  `local_task_manager.h:58`, lease pipelining in
+  `normal_task_submitter.h:75`),
+- message routing between workers/drivers across nodes (the owner
+  protocol rides this),
+- node-to-node object transfer in/out of the shm store (reference:
+  `object_manager.h:117` chunked push/pull),
+- the shm store segment lifecycle for the node.
+
+The head daemon also hosts the Controller service on its TCP port
+(the reference colocates GCS on the head node).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.task_spec import ActorCreationSpec, Resources, TaskResult, TaskSpec, fits as _fits
+from ray_tpu.shm import ShmStore
+
+logger = logging.getLogger(__name__)
+
+_PIPELINE_DEPTH = 4  # tasks pushed to one leased worker ahead of completion
+
+
+@dataclass
+class WorkerState:
+    worker_id: str
+    pid: int
+    conn: Optional[rpc.Connection] = None
+    kind: str = "worker"  # worker | driver
+    socket_path: Optional[str] = None  # worker's own server socket
+    actor_id: Optional[bytes] = None
+    lease: Optional[Dict[str, float]] = None  # charged resources
+    leased_to: Optional[str] = None  # worker_id of the lease holder
+    in_flight: Dict[bytes, TaskSpec] = field(default_factory=dict)
+    proc: Optional[subprocess.Popen] = None
+
+    @property
+    def idle(self):
+        return (
+            not self.in_flight
+            and self.actor_id is None
+            and self.leased_to is None
+        )
+
+
+class NodeDaemon:
+    def __init__(self, session_dir: str, is_head: bool, controller_addr=None,
+                 num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 num_workers: int = 0, node_name: str = ""):
+        self.cfg: Config = get_config()
+        self.session_dir = session_dir
+        self.is_head = is_head
+        self.node_id = NodeID.random().hex()
+        self.node_name = node_name or self.node_id[:8]
+        self.shm_name = f"/rt_{os.path.basename(session_dir)}_{self.node_id[:8]}"
+        self.socket_path = os.path.join(session_dir, f"noded_{self.node_id[:8]}.sock")
+
+        ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 4)
+        self.total_resources: Dict[str, float] = {"CPU": ncpu}
+        if num_tpus:
+            self.total_resources["TPU"] = float(num_tpus)
+        self.total_resources.update(resources or {})
+        self.available = dict(self.total_resources)
+
+        self.num_workers = num_workers or int(ncpu)
+        self.store: Optional[ShmStore] = None
+        self.workers: Dict[str, WorkerState] = {}  # worker_id -> state
+        self._conn_worker: Dict[rpc.Connection, str] = {}
+        self.task_queue: Deque[TaskSpec] = deque()
+        self.controller_addr = controller_addr
+        self.controller_conn: Optional[rpc.Connection] = None
+        self.controller = None  # Controller object when head
+        self._node_conns: Dict[str, rpc.Connection] = {}  # node_id -> conn
+        self._node_addrs: Dict[str, Tuple[str, int]] = {}
+        self._pulls: Dict[bytes, asyncio.Future] = {}
+        self._actor_locations: Dict[bytes, Tuple[str, str]] = {}
+        self.unix_server: Optional[rpc.Server] = None
+        self.tcp_server: Optional[rpc.Server] = None
+        self.tcp_port: int = 0
+        self.controller_port: int = 0
+
+    # ------------------------------------------------------------------
+    # startup
+    # ------------------------------------------------------------------
+    async def start(self):
+        cap = self.cfg.object_store_memory
+        if cap <= 0:
+            cap = _default_store_capacity()
+        self.store = ShmStore(self.shm_name, capacity=cap, create=True)
+
+        self.unix_server = rpc.Server(self, name=f"noded-{self.node_name}-unix")
+        await self.unix_server.start_unix(self.socket_path)
+        self.tcp_server = rpc.Server(self, name=f"noded-{self.node_name}-tcp")
+        self.tcp_port = await self.tcp_server.start_tcp("127.0.0.1", 0)
+
+        if self.is_head:
+            from ray_tpu.core.controller import Controller
+            from ray_tpu.core.placement import PlacementGroupManager
+
+            self.controller = Controller()
+            self.controller._pg_manager = PlacementGroupManager(self.controller)
+            ctl_server = rpc.Server(self.controller, name="controller")
+            self.controller_port = await ctl_server.start_tcp("127.0.0.1", 0)
+            self._ctl_server = ctl_server
+            self.controller.start_health_checks()
+            self.controller_addr = ("127.0.0.1", self.controller_port)
+
+        # register with the controller like any node
+        self.controller_conn = await rpc.connect_tcp(
+            *self.controller_addr, handler=self._ctl_push, name="noded->controller"
+        )
+        await self.controller_conn.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "addr": ("127.0.0.1", self.tcp_port),
+                "resources": dict(self.total_resources),
+                "is_head": self.is_head,
+            },
+        )
+        for _ in range(self.num_workers):
+            self._spawn_worker()
+        logger.info(
+            "noded %s up: %d workers, resources=%s",
+            self.node_name,
+            self.num_workers,
+            self.total_resources,
+        )
+
+    async def _ctl_push(self, method, payload, conn):
+        if method == "ping":
+            return "pong"
+        if method == "host_actor":
+            return await self.handle_host_actor(payload, conn)
+        if method == "kill_worker":
+            return await self.handle_kill_worker(payload, conn)
+        raise rpc.RpcError(f"noded: unexpected controller push {method!r}")
+
+    def write_ready_file(self, path: str):
+        with open(path + ".tmp", "w") as f:
+            json.dump(
+                {
+                    "node_id": self.node_id,
+                    "socket_path": self.socket_path,
+                    "controller_addr": list(self.controller_addr),
+                    "tcp_port": self.tcp_port,
+                    "shm_name": self.shm_name,
+                },
+                f,
+            )
+        os.replace(path + ".tmp", path)
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: worker_pool.h:174)
+    # ------------------------------------------------------------------
+    _pending_spawns = 0
+
+    def _spawn_worker(self) -> None:
+        self._pending_spawns += 1
+        env = dict(os.environ)
+        env.update(self.cfg.to_env())
+        env["RT_NODE_SOCKET"] = self.socket_path
+        env["RT_CONTROLLER"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=open(os.path.join(self.session_dir, "logs", f"worker-{time.time():.0f}-{os.urandom(2).hex()}.out"), "wb"),
+            stderr=subprocess.STDOUT,
+        )
+        # the worker introduces itself via `register`; we just remember
+        # the proc so we can reap/replace it
+        asyncio.ensure_future(self._watch_proc(proc))
+
+    async def _watch_proc(self, proc: subprocess.Popen):
+        while proc.poll() is None:
+            await asyncio.sleep(0.2)
+        # find the worker that had this pid
+        for w in list(self.workers.values()):
+            if w.pid == proc.pid:
+                self._on_worker_dead(w, f"process exited with {proc.returncode}")
+                return
+        # died before registering: release the pending-spawn slot so
+        # on-demand spawning doesn't deadlock on a boot-crashing worker
+        if self._pending_spawns > 0:
+            self._pending_spawns -= 1
+        logger.warning(
+            "worker pid %d exited with %s before registering",
+            proc.pid,
+            proc.returncode,
+        )
+
+    def on_connect(self, conn: rpc.Connection):
+        conn.on_close = self._on_conn_close
+
+    def _on_conn_close(self, conn: rpc.Connection):
+        wid = self._conn_worker.pop(conn, None)
+        if wid is None:
+            return
+        w = self.workers.get(wid)
+        if w is not None and w.conn is conn:
+            self._on_worker_dead(w, "connection lost")
+
+    def _on_worker_dead(self, w: WorkerState, reason: str):
+        if w.worker_id not in self.workers:
+            return
+        del self.workers[w.worker_id]
+        logger.warning("worker %s died: %s", w.worker_id[:8], reason)
+        if self.store is not None:
+            self.store.reap_creator(w.pid)
+        # fail in-flight tasks back to their owners
+        for spec in w.in_flight.values():
+            result = TaskResult(task_id=spec.task_id, status="worker_died")
+            asyncio.ensure_future(self._route_to_owner(spec.owner, "task_result", result))
+        self._release_lease(w)
+        if w.actor_id is not None and self.controller_conn:
+            self.controller_conn.send(
+                "actor_worker_died",
+                {"actor_id": w.actor_id, "cause": reason},
+            )
+        if w.kind == "worker" and not self._draining:
+            self._spawn_worker()
+        self._schedule()
+
+    _draining = False
+
+    # ------------------------------------------------------------------
+    # local registration
+    # ------------------------------------------------------------------
+    async def handle_register(self, payload, conn):
+        w = WorkerState(
+            worker_id=payload["worker_id"],
+            pid=payload["pid"],
+            conn=conn,
+            kind=payload["kind"],
+        )
+        if w.kind == "worker" and self._pending_spawns > 0:
+            self._pending_spawns -= 1
+        w.socket_path = payload.get("socket_path")
+        self.workers[w.worker_id] = w
+        self._conn_worker[conn] = w.worker_id
+        self._schedule()
+        return {
+            "node_id": self.node_id,
+            "shm_name": self.shm_name,
+            "controller_addr": list(self.controller_addr),
+        }
+
+    async def handle_ping(self, payload, conn):
+        return "pong"
+
+    # ------------------------------------------------------------------
+    # scheduling (reference: local_task_manager.cc:122 dispatch loop)
+    # ------------------------------------------------------------------
+    async def handle_submit_task(self, spec: TaskSpec, conn):
+        self.task_queue.append(spec)
+        self._schedule()
+
+    def _schedule(self):
+        """Dispatch as many queued tasks as possible.  Scans a bounded
+        window past the head to avoid head-of-line blocking by an
+        infeasible task (reference behavior: separate infeasible queue);
+        each dispatch is O(window), keeping the 10k-tasks-queued case
+        linear overall."""
+        q = self.task_queue
+        while q:
+            dispatched = False
+            for i in range(min(len(q), 64)):
+                spec = q[i]
+                w = self._find_worker_for(spec)
+                if w is not None:
+                    del q[i]
+                    self._dispatch(w, spec)
+                    dispatched = True
+                    break
+            if not dispatched:
+                asyncio.ensure_future(self._maybe_spill(q[0]))
+                break
+        # spawn extra workers if queue is deep and the pool is small
+        if q and len(self.workers) < self.num_workers:
+            self._spawn_worker()
+
+    def _find_worker_for(self, spec: TaskSpec) -> Optional[WorkerState]:
+        demand = spec.resources.as_dict()
+        # 1) pipeline onto a worker already leased with identical demand
+        for w in self.workers.values():
+            if (
+                w.kind == "worker"
+                and w.actor_id is None
+                and w.leased_to is None
+                and w.lease is not None
+                and w.lease == demand
+                and len(w.in_flight) < _PIPELINE_DEPTH
+            ):
+                return w
+        # 2) idle worker + available resources
+        if _fits(demand, self.available):
+            for w in self.workers.values():
+                if w.kind == "worker" and w.idle and w.lease is None and w.conn:
+                    return w
+        return None
+
+    def _dispatch(self, w: WorkerState, spec: TaskSpec):
+        demand = spec.resources.as_dict()
+        if w.lease is None:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            w.lease = demand
+        w.in_flight[spec.task_id.binary()] = spec
+        w.conn.send("execute_task", spec)
+
+    def _release_lease(self, w: WorkerState):
+        if w.lease is not None and not w.in_flight:
+            for k, v in w.lease.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            w.lease = None
+
+    async def _maybe_spill(self, spec: TaskSpec):
+        """Spillback: if this node can never or not-soon run the task,
+        hand it to another node (reference: cluster_task_manager.cc:44)."""
+        demand = spec.resources.as_dict()
+        if _fits(demand, self.total_resources):
+            return  # feasible here, just busy: keep queued
+        if self.controller_conn is None:
+            return
+        target = await self.controller_conn.call(
+            "find_node_for", {"resources": demand, "exclude": [self.node_id]}
+        )
+        if target is None:
+            return  # unschedulable for now; stays queued
+        for i, s in enumerate(self.task_queue):
+            if s is spec:
+                del self.task_queue[i]
+                break
+        else:
+            return  # already dispatched elsewhere
+        conn = await self._node_conn(target)
+        conn.send("submit_task", spec)
+
+    # ------------------------------------------------------------------
+    # worker leasing: direct-push protocol (reference two-level
+    # scheduling — leases granted here, tasks pushed caller->worker)
+    # ------------------------------------------------------------------
+    async def handle_request_lease(self, payload, conn):
+        """Grant a leased worker to a caller; returns (worker_id,
+        socket_path) or None if nothing is available right now
+        (reference: `HandleRequestWorkerLease` node_manager.cc:1797)."""
+        demand = payload["resources"]
+        holder = self._conn_worker.get(conn, "remote")
+        if not _fits(demand, self.available):
+            return None
+        for w in self.workers.values():
+            if w.kind == "worker" and w.idle and w.conn and w.socket_path:
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0.0) - v
+                w.lease = dict(demand)
+                w.leased_to = holder
+                return (w.worker_id, w.socket_path)
+        if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
+            self._spawn_worker()
+        return None
+
+    async def handle_return_lease(self, payload, conn):
+        w = self.workers.get(payload["worker_id"])
+        if w is None or w.leased_to is None:
+            return {"ok": False}
+        w.leased_to = None
+        w.in_flight.clear()
+        self._release_lease(w)
+        self._schedule()
+        return {"ok": True}
+
+    async def handle_resolve_worker_socket(self, payload, conn):
+        node_id = payload.get("node_id", self.node_id)
+        if node_id != self.node_id:
+            try:
+                c = await self._node_conn(node_id)
+                return await c.call(
+                    "resolve_worker_socket",
+                    {"node_id": node_id, "worker_id": payload["worker_id"]},
+                )
+            except Exception:
+                return None
+        w = self.workers.get(payload["worker_id"])
+        return w.socket_path if w else None
+
+    # ------------------------------------------------------------------
+    # task completion (noded-dispatched tasks only; direct pushes reply
+    # straight to the owner)
+    # ------------------------------------------------------------------
+    async def handle_task_done(self, payload, conn):
+        result: TaskResult = payload["result"]
+        owner = payload["owner"]
+        wid = self._conn_worker.get(conn)
+        w = self.workers.get(wid) if wid else None
+        if w is not None:
+            w.in_flight.pop(result.task_id.binary(), None)
+            self._release_lease(w)
+        await self._route_to_owner(owner, "task_result", result)
+        self._schedule()
+
+    # worker replies arrive as task_result on its registration conn for
+    # tasks this daemon dispatched (spillback / relayed actor tasks)
+    handle_task_result = handle_task_done
+
+    async def _route_to_owner(self, owner: Tuple[str, str], method: str, payload):
+        node_id, worker_id = owner
+        if node_id == self.node_id:
+            w = self.workers.get(worker_id)
+            if w is not None and w.conn and not w.conn.closed:
+                w.conn.send(method, payload)
+            return
+        try:
+            conn = await self._node_conn(node_id)
+            conn.send("route", {
+                "target": owner, "method": method, "payload": payload,
+                "want_reply": False,
+            })
+        except Exception:
+            logger.warning("could not route %s to %s", method, owner)
+
+    # ------------------------------------------------------------------
+    # generic routing (owner protocol, borrows, value fetch)
+    # ------------------------------------------------------------------
+    async def handle_route(self, payload, conn):
+        target = payload["target"]
+        node_id, worker_id = target
+        if node_id != self.node_id:
+            c = await self._node_conn(node_id)
+            if payload.get("want_reply"):
+                return await c.call("route", payload)
+            c.send("route", payload)
+            return None
+        w = self.workers.get(worker_id)
+        if w is None or w.conn is None or w.conn.closed:
+            if payload.get("want_reply"):
+                return ("gone",)
+            return None
+        if payload.get("want_reply"):
+            return await w.conn.call(payload["method"], payload["payload"])
+        w.conn.send(payload["method"], payload["payload"])
+        return None
+
+    async def _node_conn(self, node_id: str) -> rpc.Connection:
+        conn = self._node_conns.get(node_id)
+        if conn is not None and not conn.closed:
+            return conn
+        addr = self._node_addrs.get(node_id)
+        if addr is None:
+            addr = await self.controller_conn.call("get_node_addr", {"node_id": node_id})
+            if addr is None:
+                raise rpc.RpcError(f"unknown node {node_id}")
+            self._node_addrs[node_id] = tuple(addr)
+        conn = await rpc.connect_tcp(
+            *self._node_addrs[node_id], handler=self._handle_peer, name=f"noded->{node_id[:8]}"
+        )
+        self._node_conns[node_id] = conn
+        return conn
+
+    async def _handle_peer(self, method, payload, conn):
+        fn = getattr(self, "handle_" + method, None)
+        if fn is None:
+            raise rpc.RpcError(f"noded: no handler {method!r}")
+        return await fn(payload, conn)
+
+    # ------------------------------------------------------------------
+    # object plane: transfer + free (reference: object_manager.h)
+    # ------------------------------------------------------------------
+    async def handle_pull_object(self, payload, conn):
+        """Pull an object from a remote node into the local store."""
+        id_bytes, node_id = payload["id"], payload["node_id"]
+        if self.store.contains(id_bytes):
+            return {"ok": True}
+        fut = self._pulls.get(id_bytes)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._pulls[id_bytes] = fut
+            try:
+                c = await self._node_conn(node_id)
+                data = await c.call("fetch_object", {"id": id_bytes}, timeout=120)
+                if data is None:
+                    fut.set_exception(rpc.RpcError("object not on remote node"))
+                else:
+                    if not self.store.contains(id_bytes):
+                        self.store.put(id_bytes, data)
+                    fut.set_result(True)
+            except Exception as e:
+                fut.set_exception(e)
+            finally:
+                self._pulls.pop(id_bytes, None)
+        await fut
+        return {"ok": True}
+
+    async def handle_fetch_object(self, payload, conn):
+        id_bytes = payload["id"]
+        try:
+            buf = self.store.get(id_bytes, timeout_ms=0)
+        except Exception:
+            return None
+        try:
+            return bytes(buf)
+        finally:
+            self.store.release(id_bytes)
+
+    async def handle_free_object(self, payload, conn):
+        self.store.delete(payload["id"])
+
+    async def handle_free_remote(self, payload, conn):
+        node_id = payload["node_id"]
+        if node_id == self.node_id:
+            self.store.delete(payload["id"])
+            return
+        try:
+            c = await self._node_conn(node_id)
+            c.send("free_object", {"id": payload["id"]})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def handle_host_actor(self, aspec: ActorCreationSpec, conn):
+        """Controller asks this node to host an actor: dedicate a worker
+        (reference: actor creation runs as a special task on a leased
+        worker, gcs_actor_scheduler.h)."""
+        demand = aspec.resources.as_dict()
+        if not _fits(demand, self.available):
+            return {"ok": False, "error": "resources no longer available"}
+        # reserve BEFORE the wait loop so concurrent host_actor requests
+        # cannot both pass the feasibility check and oversubscribe
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        target = None
+        deadline = time.monotonic() + 60
+        while target is None:
+            for w in self.workers.values():
+                if w.kind == "worker" and w.idle and w.lease is None and w.conn:
+                    target = w
+                    break
+            if target is None:
+                if time.monotonic() > deadline:
+                    for k, v in demand.items():
+                        self.available[k] = self.available.get(k, 0.0) + v
+                    return {"ok": False, "error": "no idle worker"}
+                if self._pending_spawns == 0:
+                    self._spawn_worker()
+                await asyncio.sleep(0.02)
+        target.actor_id = aspec.actor_id.binary()
+        target.lease = demand
+        try:
+            reply = await target.conn.call("create_actor_instance", aspec, timeout=300)
+        except Exception as e:
+            self._on_worker_dead(target, f"actor init crashed: {e}")
+            return {"ok": False, "error": f"actor __init__ failed: {e}"}
+        if not reply.get("ok"):
+            return {"ok": False, "error": reply.get("error", "init failed")}
+        # replace the consumed pool worker
+        if sum(1 for w in self.workers.values() if w.kind == "worker" and w.actor_id is None) < self.num_workers:
+            self._spawn_worker()
+        return {"ok": True, "worker_id": target.worker_id}
+
+    async def handle_submit_actor_task(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        actor_addr = payload["actor_addr"]
+        node_id, worker_id = actor_addr
+        if node_id == self.node_id:
+            w = self.workers.get(worker_id)
+            if w is None or w.conn is None or w.conn.closed:
+                result = TaskResult(task_id=spec.task_id, status="worker_died")
+                await self._route_to_owner(spec.owner, "task_result", result)
+                return
+            w.in_flight[spec.task_id.binary()] = spec
+            w.conn.send("execute_task", spec)
+        else:
+            c = await self._node_conn(node_id)
+            c.send("submit_actor_task", payload)
+
+    async def handle_kill_worker(self, payload, conn):
+        w = self.workers.get(payload["worker_id"])
+        if w is None:
+            return {"ok": False}
+        try:
+            os.kill(w.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # introspection / state API
+    # ------------------------------------------------------------------
+    async def handle_node_stats(self, payload, conn):
+        return {
+            "node_id": self.node_id,
+            "total_resources": self.total_resources,
+            "available_resources": self.available,
+            "num_workers": len([w for w in self.workers.values() if w.kind == "worker"]),
+            "queued_tasks": len(self.task_queue),
+            "in_flight": sum(len(w.in_flight) for w in self.workers.values()),
+            "store_used": self.store.used if self.store else 0,
+            "store_capacity": self.store.capacity if self.store else 0,
+            "store_objects": self.store.count if self.store else 0,
+        }
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    async def shutdown(self):
+        self._draining = True
+        for w in self.workers.values():
+            if w.proc is not None or w.kind == "worker":
+                try:
+                    os.kill(w.pid, signal.SIGKILL)
+                except Exception:
+                    pass
+        if self.unix_server:
+            await self.unix_server.stop()
+        if self.tcp_server:
+            await self.tcp_server.stop()
+        if self.store:
+            self.store.close()
+            ShmStore.unlink(self.shm_name)
+
+
+
+def _default_store_capacity() -> int:
+    try:
+        import shutil
+
+        free = shutil.disk_usage("/dev/shm").free
+        return max(256 * 1024 * 1024, int(free * 0.3))
+    except Exception:
+        return 1024 * 1024 * 1024
+
+
+# ----------------------------------------------------------------------
+# process entry
+# ----------------------------------------------------------------------
+async def _amain(args):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s noded %(levelname)s %(message)s",
+    )
+    daemon = NodeDaemon(
+        session_dir=args.session_dir,
+        is_head=args.head,
+        controller_addr=tuple(args.controller.split(":")) if args.controller else None,
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None,
+        num_workers=args.num_workers,
+    )
+    if daemon.controller_addr and not args.head:
+        host, port = daemon.controller_addr
+        daemon.controller_addr = (host, int(port))
+    await daemon.start()
+    if args.ready_file:
+        daemon.write_ready_file(args.ready_file)
+
+    stop = asyncio.Event()
+
+    def _sig(*_a):
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, _sig)
+    loop.add_signal_handler(signal.SIGINT, _sig)
+    # exit if our parent (the driver) disappears
+    ppid = os.getppid()
+
+    async def _parent_watch():
+        while True:
+            await asyncio.sleep(1)
+            if os.getppid() != ppid:
+                stop.set()
+                return
+
+    asyncio.ensure_future(_parent_watch())
+    await stop.wait()
+    await daemon.shutdown()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--controller", default=None, help="host:port when joining")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default=None, help="json dict")
+    p.add_argument("--num-workers", type=int, default=0)
+    p.add_argument("--ready-file", default=None)
+    args = p.parse_args()
+    os.makedirs(os.path.join(args.session_dir, "logs"), exist_ok=True)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""ObjectRef: a first-class future/reference to an owned object.
+
+Mirrors the reference's `ObjectRef` (`python/ray/_raylet.pyx` ObjectRef,
+`includes/object_ref.pxi`): identity is the binary ObjectID; the owner's
+address travels with the ref so any holder can reach the owner for
+value fetch and so deserialization registers a borrow with the owner
+(reference: `reference_count.h:64` borrower protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID, WorkerID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_size_hint", "_registered")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[Tuple[str, str]] = None,
+                 size_hint: int = 0, _register: bool = False):
+        """owner: (node_id_hex, worker_id_hex) of the owning process."""
+        self.id = object_id
+        self.owner = owner
+        self._size_hint = size_hint
+        self._registered = _register
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    # -- future-like sugar --------------------------------------------
+    def __await__(self):
+        from ray_tpu.core import runtime as _rt
+
+        return _rt.async_get(self).__await__()
+
+    def future(self):
+        from ray_tpu.core import runtime as _rt
+
+        return _rt.as_future(self)
+
+    # -- serialization: in-band capture + borrow registration ----------
+    def _serialize_args(self):
+        return (self.id.binary(), self.owner, self._size_hint)
+
+    @staticmethod
+    def _deserialize(args):
+        id_bytes, owner, size_hint = args
+        ref = ObjectRef(ObjectID(id_bytes), owner, size_hint, _register=True)
+        from ray_tpu.core import runtime as _rt
+
+        _rt.on_ref_deserialized(ref)
+        return ref
+
+    def __reduce__(self):
+        return (ObjectRef._deserialize, (self._serialize_args(),))
+
+    # -- refcounting hooks --------------------------------------------
+    def __del__(self):
+        if not self._registered:
+            # transient refs constructed internally are not counted
+            return
+        try:
+            from ray_tpu.core import runtime as _rt
+
+            _rt.on_ref_deleted(self)
+        except Exception:
+            pass
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"

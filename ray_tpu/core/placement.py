@@ -1,0 +1,173 @@
+"""Placement groups with TPU-topology-aware bundle packing.
+
+Reference: `gcs_placement_group_manager.h` + bundle policies
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+(`bundle_scheduling_policy.h:31-106`).  TPU-first inversion (SURVEY §7):
+the unit of gang placement is an ICI-connected slice — STRICT_PACK means
+"one ICI domain", expressed here through node labels
+(`tpu-slice`: nodes in the same slice share a label value), not just
+"one machine".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: bytes
+    bundles: List[Dict[str, float]]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    # bundle index -> node_id
+    bundle_nodes: List[Optional[str]] = field(default_factory=list)
+    name: str = ""
+    ready_event: Optional[asyncio.Event] = None
+
+
+class PlacementGroupManager:
+    """Lives in the controller; reserves bundle resources on nodes.
+
+    Two-phase commit like the reference scheduler
+    (`gcs_placement_group_scheduler.h`): prepare (reserve on all nodes)
+    then commit; any failure rolls back all reservations.
+    """
+
+    def __init__(self, controller):
+        self.controller = controller
+        self.groups: Dict[bytes, PlacementGroupInfo] = {}
+        controller.placement_groups = self.groups
+
+    async def create(self, pg_id: bytes, bundles, strategy: str, name: str = "") -> PlacementGroupInfo:
+        info = PlacementGroupInfo(
+            pg_id=pg_id,
+            bundles=[dict(b) for b in bundles],
+            strategy=strategy,
+            bundle_nodes=[None] * len(bundles),
+            name=name,
+            ready_event=asyncio.Event(),
+        )
+        self.groups[pg_id] = info
+        self._try_place(info)
+        return info
+
+    def _try_place(self, info: PlacementGroupInfo) -> bool:
+        placed = self._plan(info)
+        if placed is None:
+            info.state = "PENDING"  # retried when resources appear
+            return False
+        # reserve: decrement controller's view of node resources
+        for idx, node_id in enumerate(placed):
+            node = self.controller.nodes[node_id]
+            for k, v in info.bundles[idx].items():
+                node.resources[k] = node.resources.get(k, 0.0) - v
+        info.bundle_nodes = placed
+        info.state = "CREATED"
+        info.ready_event.set()
+        return True
+
+    def retry_pending(self):
+        """Re-plan PENDING groups; called when capacity appears (node
+        registration, PG removal) — reference: the PG manager's retry
+        queue (`gcs_placement_group_manager.h` pending queue)."""
+        for info in list(self.groups.values()):
+            if info.state == "PENDING":
+                self._try_place(info)
+
+    def _plan(self, info: PlacementGroupInfo) -> Optional[List[str]]:
+        nodes = [n for n in self.controller.nodes.values() if n.alive]
+        avail = {n.node_id: dict(n.resources) for n in nodes}
+
+        def take(node_id, bundle) -> bool:
+            a = avail[node_id]
+            if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
+                for k, v in bundle.items():
+                    a[k] = a.get(k, 0.0) - v
+                return True
+            return False
+
+        s = info.strategy
+        if s in ("PACK", "STRICT_PACK"):
+            # try to fit all bundles into one ICI domain (same tpu-slice
+            # label), else one node, else (PACK only) spill across nodes
+            domains: Dict[str, List] = {}
+            for n in nodes:
+                key = n.labels.get("tpu-slice", n.node_id)
+                domains.setdefault(key, []).append(n)
+            for _key, group in sorted(
+                domains.items(), key=lambda kv: -len(kv[1])
+            ):
+                trial = {n.node_id: dict(avail[n.node_id]) for n in group}
+                placed: List[Optional[str]] = []
+                ok = True
+                for b in info.bundles:
+                    hit = None
+                    for n in group:
+                        a = trial[n.node_id]
+                        if all(a.get(k, 0.0) >= v for k, v in b.items()):
+                            for k, v in b.items():
+                                a[k] = a.get(k, 0.0) - v
+                            hit = n.node_id
+                            break
+                    if hit is None:
+                        ok = False
+                        break
+                    placed.append(hit)
+                if ok:
+                    return placed
+            if s == "STRICT_PACK":
+                return None
+            # PACK fallback: greedy anywhere
+            placed = []
+            for b in info.bundles:
+                hit = next((nid for nid in avail if take(nid, b)), None)
+                if hit is None:
+                    return None
+                placed.append(hit)
+            return placed
+        if s in ("SPREAD", "STRICT_SPREAD"):
+            placed = []
+            used_nodes = set()
+            for b in info.bundles:
+                choice = None
+                # prefer unused nodes
+                for nid in sorted(avail, key=lambda x: x in used_nodes):
+                    if s == "STRICT_SPREAD" and nid in used_nodes:
+                        continue
+                    if take(nid, b):
+                        choice = nid
+                        break
+                if choice is None:
+                    return None
+                used_nodes.add(choice)
+                placed.append(choice)
+            return placed
+        raise ValueError(f"unknown placement strategy {s!r}")
+
+    def node_for_bundle(self, pg_id: bytes, bundle_index: int) -> Optional[str]:
+        info = self.groups.get(pg_id)
+        if info is None or info.state != "CREATED":
+            return None
+        if bundle_index < 0:
+            return info.bundle_nodes[0] if info.bundle_nodes else None
+        return info.bundle_nodes[bundle_index]
+
+    def remove(self, pg_id: bytes):
+        info = self.groups.pop(pg_id, None)
+        if info is None or info.state != "CREATED":
+            return
+        for idx, node_id in enumerate(info.bundle_nodes):
+            node = self.controller.nodes.get(node_id)
+            if node is not None:
+                for k, v in info.bundles[idx].items():
+                    node.resources[k] = node.resources.get(k, 0.0) + v
+        info.state = "REMOVED"
+        self.retry_pending()

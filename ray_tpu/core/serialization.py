@@ -1,0 +1,146 @@
+"""Object serialization.
+
+Equivalent of the reference's `python/ray/_private/serialization.py`:
+cloudpickle for arbitrary Python objects, zero-copy numpy via pickle
+protocol-5 out-of-band buffers, ObjectRefs captured in-band and surfaced
+so the reference counter can track borrows, and task errors wrapped in a
+typed envelope that `get` re-raises.
+
+Wire format of a stored object:
+    [1 byte tag][4 bytes LE meta_len][meta pickle][buffer data...]
+where meta contains the in-band pickle plus (offset, length) table for
+out-of-band buffers, which follow contiguously (64-byte aligned) so
+numpy arrays deserialize as views over shared memory without a copy.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+TAG_DATA = 0
+TAG_ERROR = 1  # payload is a pickled exception (TaskError envelope)
+
+_ALIGN = 64
+
+# Registered custom (reducer, reconstructor) pairs, keyed by type —
+# the `util/serialization.py` register_serializer surface.
+_custom_serializers: dict = {}
+
+
+def register_serializer(cls, *, serializer: Callable, deserializer: Callable):
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls):
+    _custom_serializers.pop(cls, None)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, protocol=5, buffer_callback=None, refs=None):
+        super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
+        self._captured_refs = refs
+
+    def persistent_id(self, obj):  # noqa: D401 - pickler hook
+        return None
+
+    def reducer_override(self, obj):
+        # Capture ObjectRefs in-band; record them for borrower tracking.
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            if self._captured_refs is not None:
+                self._captured_refs.append(obj)
+            return (ObjectRef._deserialize, obj._serialize_args())
+        ser = _custom_serializers.get(type(obj))
+        if ser is not None:
+            serializer, deserializer = ser
+            return (deserializer, (serializer(obj),))
+        return super().reducer_override(obj)
+
+
+def serialize(
+    value: Any, tag: int = TAG_DATA
+) -> Tuple[List[memoryview], int, List["Any"]]:
+    """Serialize to (chunks, total_size, captured_object_refs).
+
+    chunks is a list of buffers to be written contiguously; numpy/jax
+    host arrays travel as raw out-of-band buffers (no copy on write if
+    the caller writes straight into shm).
+    """
+    import io
+
+    buffers: List[pickle.PickleBuffer] = []
+    refs: List[Any] = []
+    f = io.BytesIO()
+    p = _Pickler(f, protocol=5, buffer_callback=buffers.append, refs=refs)
+    p.dump(value)
+    inband = f.getvalue()
+
+    raw = [b.raw() for b in buffers]
+    # layout: header | meta | pad | buf0 | pad | buf1 ...
+    offsets = []
+    meta_payload = pickle.dumps((inband, [len(r) for r in raw]), protocol=5)
+    header = struct.pack("<BI", tag, len(meta_payload))
+    pos = len(header) + len(meta_payload)
+    chunks: List[memoryview] = [memoryview(header), memoryview(meta_payload)]
+    for r in raw:
+        pad = (-pos) % _ALIGN
+        if pad:
+            chunks.append(memoryview(b"\x00" * pad))
+            pos += pad
+        offsets.append(pos)
+        chunks.append(r)
+        pos += r.nbytes
+    # offsets are recomputed at load from lengths; nothing else needed
+    return chunks, pos, refs
+
+
+def serialize_to_bytes(value: Any, tag: int = TAG_DATA) -> bytes:
+    chunks, total, _refs = serialize(value, tag)
+    out = bytearray(total)
+    pos = 0
+    for c in chunks:
+        out[pos : pos + c.nbytes] = c
+        pos += c.nbytes
+    return bytes(out)
+
+
+def write_chunks(chunks: List[memoryview], dest: memoryview):
+    pos = 0
+    for c in chunks:
+        dest[pos : pos + c.nbytes] = c
+        pos += c.nbytes
+
+
+def deserialize(buf: memoryview) -> Tuple[int, Any]:
+    """Deserialize from a contiguous buffer (zero-copy for array data).
+
+    Returns (tag, value).  The returned value may hold views into
+    ``buf`` — the caller manages the pin lifetime.
+    """
+    buf = memoryview(buf).cast("B")
+    tag, meta_len = struct.unpack_from("<BI", buf, 0)
+    hdr = 5
+    inband, lengths = pickle.loads(buf[hdr : hdr + meta_len])
+    pos = hdr + meta_len
+    out_of_band = []
+    for ln in lengths:
+        pad = (-pos) % _ALIGN
+        pos += pad
+        out_of_band.append(buf[pos : pos + ln])
+        pos += ln
+    value = pickle.loads(inband, buffers=out_of_band)
+    return tag, value
+
+
+def dumps_oob(value: Any) -> bytes:
+    """Plain cloudpickle for control-plane payloads (no buffer split)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(data) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,145 @@
+"""Task and actor specifications.
+
+Equivalent of the reference's `TaskSpecification`
+(`src/ray/common/task/task_spec.h`): everything the executing side needs
+to run a task — function identity, resolved/unresolved args, resource
+demands, retry policy, actor linkage, scheduling strategy.
+
+Functions ship by content hash through the controller's function store
+(reference: `_private/function_manager.py` exporting via GCS KV) so a
+function is transferred to each node at most once, not per-task.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+
+def function_id_of(blob: bytes) -> bytes:
+    return hashlib.sha256(blob).digest()[:16]
+
+
+def fits(demand: Dict[str, float], supply: Dict[str, float]) -> bool:
+    """Resource feasibility with float-dust tolerance; shared by the
+    controller and node daemons so both agree on schedulability."""
+    return all(supply.get(k, 0.0) >= v - 1e-9 for k, v in demand.items() if v > 0)
+
+
+@dataclass
+class ArgRef:
+    """Marker for a top-level ObjectRef argument to be resolved by the
+    executor (reference: dependency_resolver.h resolution + plasma args)."""
+
+    id_bytes: bytes
+    owner: Optional[Tuple[str, str]]
+
+
+@dataclass
+class Resources:
+    """Resource demand; values are floats like the reference's resource
+    set (`src/ray/common/scheduling/resource_set.h`).  TPU chips are a
+    predefined resource, not a custom string."""
+
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    memory: float = 0.0
+    custom: Dict[str, float] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, float]:
+        d = dict(self.custom)
+        if self.num_cpus:
+            d["CPU"] = self.num_cpus
+        if self.num_tpus:
+            d["TPU"] = self.num_tpus
+        if self.memory:
+            d["memory"] = self.memory
+        return d
+
+    @staticmethod
+    def from_options(opts: Dict[str, Any]) -> "Resources":
+        res = dict(opts.get("resources") or {})
+        return Resources(
+            num_cpus=opts.get("num_cpus", 1.0) or 0.0,
+            num_tpus=opts.get("num_tpus", res.pop("TPU", 0.0)) or 0.0,
+            memory=opts.get("memory", 0.0) or 0.0,
+            custom=res,
+        )
+
+
+@dataclass
+class SchedulingStrategy:
+    """Placement constraints (reference: `util/scheduling_strategies.py`).
+
+    kind: "default" | "spread" | "node_affinity" | "placement_group"
+    """
+
+    kind: str = "default"
+    node_id: Optional[str] = None
+    soft: bool = False
+    pg_id: Optional[bytes] = None
+    pg_bundle_index: int = -1
+    pg_capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    function_id: bytes
+    # small function blobs ride in the spec on first submission; the
+    # executor caches by function_id and later specs omit it
+    function_blob: Optional[bytes]
+    args: List[Any]  # positional: raw values or ArgRef markers
+    kwargs: Dict[str, Any]
+    num_returns: int
+    owner: Tuple[str, str]  # (node_id_hex, worker_id_hex)
+    resources: Resources
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    name: str = ""
+    # actor linkage
+    actor_id: Optional[ActorID] = None  # actor task if set
+    seq_no: int = -1  # per-caller submission order for actor tasks
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    class_id: bytes
+    class_blob: Optional[bytes]
+    init_args: List[Any]
+    init_kwargs: Dict[str, Any]
+    owner: Tuple[str, str]
+    resources: Resources
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async: bool = False
+    name: Optional[str] = None  # named actor (reference: get_actor)
+    namespace: str = "default"
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    lifetime: Optional[str] = None  # "detached" keeps it past driver exit
+
+
+@dataclass
+class TaskResult:
+    """Sent executor -> owner when a task finishes.
+
+    Small return values are inlined (reference: direct returns into the
+    caller's in-process memory store); large ones were sealed into the
+    executor node's shm store and only (object_id, node_id, size) travels.
+    """
+
+    task_id: TaskID
+    status: str  # "ok" | "error" | "worker_died"
+    # per-return: ("inline", bytes) or ("shm", node_id_hex, size)
+    returns: List[Tuple] = field(default_factory=list)
+    error: Optional[bytes] = None  # serialized TaskError envelope
+    execution_info: Dict[str, float] = field(default_factory=dict)

@@ -1,0 +1,51 @@
+"""Worker process entry point.
+
+Spawned by the node daemon (reference: `WorkerPool::StartWorkerProcess`,
+`src/ray/raylet/worker_pool.h`); hosts a Runtime in worker mode whose io
+loop receives execute_task pushes and runs user code in executor
+threads (reference: the worker exec loop, `core_worker.cc:2908` +
+`_raylet.pyx task_execution_handler:2222`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RT_LOG_LEVEL", "INFO"),
+        format="%(asctime)s worker %(levelname)s %(message)s",
+    )
+    node_socket = os.environ["RT_NODE_SOCKET"]
+    host, port = os.environ["RT_CONTROLLER"].rsplit(":", 1)
+
+    from ray_tpu.core.runtime import Runtime, set_runtime
+
+    rt = Runtime("worker")
+    rt.start(node_socket, (host, int(port)),
+             serve_dir=os.path.dirname(node_socket))
+    set_runtime(rt)
+
+    # exit when the node daemon goes away (socket closes) or parent dies
+    ppid = os.getppid()
+    try:
+        while True:
+            time.sleep(0.5)
+            if rt.noded is None or rt.noded.closed:
+                break
+            if os.getppid() != ppid:
+                break
+    except KeyboardInterrupt:
+        pass
+    rt.shutdown()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()
