@@ -334,7 +334,8 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
 
 def kill(handle: ActorHandle, *, no_restart: bool = True):
     get_runtime().controller_call(
-        "kill_actor", {"actor_id": handle._actor_id.binary()}
+        "kill_actor",
+        {"actor_id": handle._actor_id.binary(), "no_restart": no_restart},
     )
 
 

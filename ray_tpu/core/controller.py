@@ -280,18 +280,23 @@ class Controller:
         info = self.actors.get(payload["actor_id"])
         if info is None:
             return {"ok": False, "error": "no such actor"}
-        info.spec.max_restarts = 0  # no restart after explicit kill
+        no_restart = payload.get("no_restart", True)
+        if no_restart:
+            info.spec.max_restarts = 0
         if info.address:
             node = self.nodes.get(info.address[0])
             if node and node.conn:
                 await node.conn.call(
                     "kill_worker", {"worker_id": info.address[1]}, timeout=10
                 )
-        info.state = "DEAD"
-        info.death_cause = "killed via kill_actor"
-        for key, aid in list(self.named_actors.items()):
-            if aid == payload["actor_id"]:
-                del self.named_actors[key]
+        if no_restart:
+            # mark dead now; worker-death notifications see max_restarts=0
+            info.state = "DEAD"
+            info.death_cause = "killed via kill_actor"
+            for key, aid in list(self.named_actors.items()):
+                if aid == payload["actor_id"]:
+                    del self.named_actors[key]
+        # with no_restart=False the death notification path restarts it
         return {"ok": True}
 
     async def handle_list_actors(self, payload, conn):
@@ -326,6 +331,11 @@ class Controller:
         except asyncio.TimeoutError:
             return {"ok": False, "state": info.state}
         return {"ok": True, "state": info.state, "bundle_nodes": info.bundle_nodes}
+
+    async def handle_pg_node_for_bundle(self, payload, conn):
+        return self._pg_manager.node_for_bundle(
+            payload["pg_id"], payload.get("bundle_index", -1)
+        )
 
     async def handle_remove_placement_group(self, payload, conn):
         self._pg_manager.remove(payload["pg_id"])

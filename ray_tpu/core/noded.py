@@ -277,6 +277,33 @@ class NodeDaemon:
     # scheduling (reference: local_task_manager.cc:122 dispatch loop)
     # ------------------------------------------------------------------
     async def handle_submit_task(self, spec: TaskSpec, conn):
+        strat = spec.strategy
+        if strat.kind == "placement_group" and strat.pg_id is not None:
+            target = await self.controller_conn.call(
+                "pg_node_for_bundle",
+                {"pg_id": strat.pg_id, "bundle_index": strat.pg_bundle_index},
+            )
+            if target is not None and target != self.node_id:
+                (await self._node_conn(target)).send("submit_task", spec)
+                return
+        elif strat.kind == "node_affinity" and strat.node_id:
+            if strat.node_id != self.node_id:
+                try:
+                    (await self._node_conn(strat.node_id)).send("submit_task", spec)
+                    return
+                except Exception:
+                    if not strat.soft:
+                        result = TaskResult(task_id=spec.task_id, status="worker_died")
+                        await self._route_to_owner(spec.owner, "task_result", result)
+                        return
+        elif strat.kind == "spread":
+            target = await self.controller_conn.call(
+                "find_node_for",
+                {"resources": spec.resources.as_dict(), "exclude": []},
+            )
+            if target is not None and target != self.node_id:
+                (await self._node_conn(target)).send("submit_task", spec)
+                return
         self.task_queue.append(spec)
         self._schedule()
 
@@ -573,10 +600,22 @@ class NodeDaemon:
         target.lease = demand
         try:
             reply = await target.conn.call("create_actor_instance", aspec, timeout=300)
+        except rpc.RemoteError as e:
+            # user __init__ raised: the worker is alive — return it to
+            # the pool instead of declaring it dead
+            target.actor_id = None
+            target.lease = None
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            return {"ok": False, "error": f"actor __init__ failed: {e}"}
         except Exception as e:
             self._on_worker_dead(target, f"actor init crashed: {e}")
             return {"ok": False, "error": f"actor __init__ failed: {e}"}
         if not reply.get("ok"):
+            target.actor_id = None
+            target.lease = None
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
             return {"ok": False, "error": reply.get("error", "init failed")}
         # replace the consumed pool worker
         if sum(1 for w in self.workers.values() if w.kind == "worker" and w.actor_id is None) < self.num_workers:

@@ -58,11 +58,12 @@ logger = logging.getLogger(__name__)
 
 _INLINE = "inline"
 _SHM = "shm"
-# Max tasks pushed ahead of completion on one leased worker.  Kept small:
-# one executing + one prefetched hides the result round-trip without
-# head-of-line-blocking short tasks behind a long one (the reference
-# bounds this with max_tasks_in_flight_per_worker).
-_PIPELINE_DEPTH = 2
+# Max tasks pushed ahead of completion on one leased worker (the
+# reference's max_tasks_in_flight_per_worker).  The worker runs normal
+# tasks on a thread pool at least this wide, so a task that blocks
+# (collectives, nested gets) never deadlocks a pipelined successor and
+# short tasks are not serialized behind long ones.
+_PIPELINE_DEPTH = 4
 
 
 @dataclass
@@ -162,9 +163,10 @@ class Runtime:
         self._exported_fids: set = set()
         self._fn_cache: Dict[bytes, Any] = {}
 
-        # executor-side state
+        # executor-side state; pool width >= _PIPELINE_DEPTH so pushed
+        # tasks always find a thread (see _PIPELINE_DEPTH comment)
         self._exec_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="rt-exec"
+            max_workers=max(8, _PIPELINE_DEPTH), thread_name_prefix="rt-exec"
         )
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
@@ -174,6 +176,11 @@ class Runtime:
         self._actor_drain_lock: Optional[asyncio.Lock] = None
         self._put_counter = 0
         self._task_local = threading.local()
+        # shm objects this process has materialized via get: the pin is
+        # held for the process lifetime because deserialized numpy/jax
+        # values are zero-copy views into the segment (the reference
+        # pins plasma buffers the same way while Python buffers exist)
+        self._held_pins: set = set()
         self._shutdown = False
 
     # ------------------------------------------------------------------
@@ -249,6 +256,12 @@ class Runtime:
         self._io_thread.join(timeout=5)
         self._exec_pool.shutdown(wait=False)
         if self.store:
+            for id_bytes in self._held_pins:
+                try:
+                    self.store.release(id_bytes)
+                except Exception:
+                    pass
+            self._held_pins.clear()
             self.store.close()
 
     # ------------------------------------------------------------------
@@ -410,15 +423,26 @@ class Runtime:
         return pool
 
     def _push_or_queue(self, spec: TaskSpec):
+        if spec.strategy.kind != "default":
+            # placement-constrained tasks go through the node daemon,
+            # which consults the controller for PG bundles / affinity /
+            # spread targets (reference: lease policy + spillback)
+            try:
+                self.noded.send_threadsafe("submit_task", spec)
+            except rpc.ConnectionLost:
+                pass
+            return
         pool = self._pool_for(spec)
         with self._state_lock:
-            # immediate push only onto an idle lease; a busy lease gets
-            # refills from the queue as its results come back
+            # push to the least-loaded lease with pipeline room (worker
+            # exec pools are >= depth threads, so a blocked task can
+            # never wedge a pipelined successor)
             lease = None
             for cand in pool.leases.values():
-                if cand.in_flight == 0:
+                if cand.in_flight < _PIPELINE_DEPTH and (
+                    lease is None or cand.in_flight < lease.in_flight
+                ):
                     lease = cand
-                    break
             if lease is not None:
                 lease.in_flight += 1
                 lease.assigned[spec.task_id.binary()] = spec
@@ -428,7 +452,10 @@ class Runtime:
                 if need_request:
                     pool.requesting = True
         if lease is not None:
-            lease.conn.send_threadsafe("execute_task", spec)
+            try:
+                lease.conn.send_threadsafe("execute_task", spec)
+            except rpc.ConnectionLost:
+                pass  # teardown requeues/fails via _on_lease_conn_closed
         elif need_request:
             self.loop.call_soon_threadsafe(
                 lambda: asyncio.ensure_future(self._acquire_leases(pool))
@@ -440,10 +467,13 @@ class Runtime:
         try:
             while not self._shutdown:
                 with self._state_lock:
-                    capacity = sum(
-                        _PIPELINE_DEPTH - l.in_flight for l in pool.leases.values()
+                    # prefer one lease per queued task; deep pipelines
+                    # only absorb work when the node can't grant more
+                    # workers (saturation)
+                    idle_capacity = sum(
+                        1 for l in pool.leases.values() if l.in_flight == 0
                     )
-                    if not pool.queue or capacity >= len(pool.queue):
+                    if not pool.queue or idle_capacity >= len(pool.queue):
                         pool.requesting = False
                         return
                 try:
@@ -486,7 +516,10 @@ class Runtime:
                 spec = pool.queue.popleft()
                 lease.in_flight += 1
                 lease.assigned[spec.task_id.binary()] = spec
-            lease.conn.send_threadsafe("execute_task", spec)
+            try:
+                lease.conn.send_threadsafe("execute_task", spec)
+            except rpc.ConnectionLost:
+                return
 
     def _on_lease_conn_closed(self, conn: rpc.Connection):
         with self._state_lock:
@@ -576,7 +609,8 @@ class Runtime:
                     rc = self.refs.get(a.id_bytes)
                     if rc:
                         rc.submitted += 1
-            self._actor_addr.setdefault(aid, tuple(handle._address))
+            if handle._address is not None:
+                self._actor_addr.setdefault(aid, tuple(handle._address))
         self._push_actor_task(aid, spec)
         return refs
 
@@ -592,7 +626,10 @@ class Runtime:
                     self._actor_connecting.add(aid)
                 conn = None
         if conn is not None:
-            conn.send_threadsafe("execute_task", spec)
+            try:
+                conn.send_threadsafe("execute_task", spec)
+            except rpc.ConnectionLost:
+                pass  # teardown fails/retries via _on_actor_conn_closed
         elif need_connect:
             self.loop.call_soon_threadsafe(
                 lambda: asyncio.ensure_future(self._connect_actor(aid))
@@ -786,6 +823,18 @@ class Runtime:
             return await self._read_shm(ref, st.node_id)
         return await self._get_borrowed(ref)
 
+    def _deser_pinned(self, id_bytes: bytes, buf):
+        """Deserialize a shm buffer, keeping ONE pin per object for the
+        life of this process (values hold zero-copy views into the
+        segment; releasing would allow eviction under a live array)."""
+        if id_bytes in self._held_pins:
+            # already held once; drop the extra pin from this get
+            self.store.release(id_bytes)
+        else:
+            self._held_pins.add(id_bytes)
+        tag, val = ser.deserialize(buf)
+        return _unwrap(tag, val)
+
     async def _read_shm(self, ref: ObjectRef, node_id: Optional[str]):
         try:
             buf = self.store.get(ref.binary(), timeout_ms=0)
@@ -797,20 +846,12 @@ class Runtime:
                 buf = self.store.get(ref.binary(), timeout_ms=30_000)
             else:
                 return await self._reconstruct_and_get(ref)
-        try:
-            tag, val = ser.deserialize(buf)
-            return _unwrap(tag, val)
-        finally:
-            self.store.release(ref.binary())
+        return self._deser_pinned(ref.binary(), buf)
 
     async def _get_borrowed(self, ref: ObjectRef):
         if self.store.contains(ref.binary()):
             buf = self.store.get(ref.binary(), timeout_ms=0)
-            try:
-                tag, val = ser.deserialize(buf)
-                return _unwrap(tag, val)
-            finally:
-                self.store.release(ref.binary())
+            return self._deser_pinned(ref.binary(), buf)
         if ref.owner is None:
             raise exc.ObjectLostError(object_id=ref.id)
         reply = await self.noded.call(
@@ -833,11 +874,7 @@ class Runtime:
                     "pull_object", {"id": ref.binary(), "node_id": node_id}
                 )
             buf = self.store.get(ref.binary(), timeout_ms=30_000)
-            try:
-                tag, val = ser.deserialize(buf)
-                return _unwrap(tag, val)
-            finally:
-                self.store.release(ref.binary())
+            return self._deser_pinned(ref.binary(), buf)
         if kind == "error":
             raise _error_from_envelope(reply[1])
         raise exc.ObjectLostError(object_id=ref.id)
@@ -856,6 +893,12 @@ class Runtime:
             st.ready = asyncio.Event()
             st.where = None
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(spec, 0)
+            # completion decrements submitted refs again, so re-pin args
+            for a in spec.args:
+                if isinstance(a, ArgRef):
+                    rc = self.refs.get(a.id_bytes)
+                    if rc:
+                        rc.submitted += 1
         logger.info("reconstructing %s via lineage resubmit", ref.hex())
         self._push_or_queue(spec)
         await st.ready.wait()

@@ -1,0 +1,33 @@
+"""Parallelism layer: meshes, shardings, collectives, sequence parallelism."""
+
+from ray_tpu.parallel.mesh import (
+    AXES,
+    MeshSpec,
+    batch_axes,
+    data_sharding,
+    local_batch_size,
+    mesh_from_devices,
+    replicated,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    shard_params,
+    sharding_from_logical,
+    spec_from_logical,
+    tree_shardings,
+)
+
+__all__ = [
+    "AXES",
+    "DEFAULT_RULES",
+    "MeshSpec",
+    "batch_axes",
+    "data_sharding",
+    "local_batch_size",
+    "mesh_from_devices",
+    "replicated",
+    "shard_params",
+    "sharding_from_logical",
+    "spec_from_logical",
+    "tree_shardings",
+]

@@ -1,0 +1,225 @@
+"""Collective communication.
+
+Two tiers, per SURVEY §5.8:
+
+1. **In-program collectives** — inside jit/shard_map, `jax.lax`
+   psum/all_gather/reduce_scatter/ppermute/all_to_all lower to XLA
+   collectives on ICI/DCN.  Thin named wrappers here keep call sites
+   uniform with the host tier.
+
+2. **Host-level actor-group collectives** — the surface of the
+   reference's `ray.util.collective` (`util/collective/collective.py:120`
+   init_collective_group, `:258-615` allreduce/allgather/...), retargeted
+   at numpy/jax host arrays.  Where the reference backs this with NCCL
+   (cupy) or Gloo, here the rendezvous and data movement ride the
+   framework's own object plane (a named rendezvous actor + shm objects)
+   — device-resident arrays should use tier 1 instead, which is the
+   TPU-native fast path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# ---- tier 1: in-program (imported lazily to keep core jax-free) ------
+
+
+def psum(x, axis_name):
+    from jax import lax
+
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    from jax import lax
+
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    from jax import lax
+
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    from jax import lax
+
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+def ppermute(x, axis_name, perm):
+    from jax import lax
+
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    from jax import lax
+
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+# ---- tier 2: host-level actor-group collectives ----------------------
+
+_REDUCERS = {
+    "sum": lambda xs: sum(xs[1:], start=xs[0]),
+    "mean": lambda xs: sum(xs[1:], start=xs[0]) / len(xs),
+    "max": lambda xs: np.maximum.reduce(xs),
+    "min": lambda xs: np.minimum.reduce(xs),
+}
+
+
+class _Rendezvous:
+    """Named actor coordinating one collective group (the reference uses
+    a named store actor for rendezvous the same way —
+    `collective_group/nccl_collective_group.py` Rendezvous)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: Dict[int, Dict[int, Any]] = {}
+        self.results: Dict[int, Any] = {}
+        self.barrier_count: Dict[int, int] = {}
+
+    def contribute(self, round_id: int, rank: int, value, op: str):
+        slot = self.rounds.setdefault(round_id, {})
+        slot[rank] = value
+        if len(slot) == self.world_size:
+            xs = [slot[r] for r in range(self.world_size)]
+            if op == "gather":
+                self.results[round_id] = xs
+            else:
+                self.results[round_id] = _REDUCERS[op](xs)
+            del self.rounds[round_id]
+        return True
+
+    def fetch(self, round_id: int):
+        return self.results.get(round_id, _PENDING)
+
+    def finish(self, round_id: int, rank: int):
+        # last fetcher clears the slot
+        c = self.barrier_count.get(round_id, 0) + 1
+        if c >= self.world_size:
+            self.results.pop(round_id, None)
+            self.barrier_count.pop(round_id, None)
+        else:
+            self.barrier_count[round_id] = c
+
+
+_PENDING = "__rt_pending__"
+
+
+class CollectiveGroup:
+    """Handle held by each member process/actor."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        import ray_tpu as rt
+
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._round = 0
+        name = f"__rt_collective__{group_name}"
+        if rank == 0:
+            self._rdv = rt.remote(_Rendezvous).options(
+                name=name, num_cpus=0, max_concurrency=16
+            ).remote(world_size)
+        else:
+            deadline = time.time() + 60
+            while True:
+                try:
+                    self._rdv = rt.get_actor(name)
+                    break
+                except ValueError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+
+    # -- ops (reference surface: collective.py:258-615) ---------------
+    def _exchange(self, value, op: str):
+        import ray_tpu as rt
+
+        round_id = self._round
+        self._round += 1
+        rt.get(self._rdv.contribute.remote(round_id, self.rank, value, op))
+        while True:
+            out = rt.get(self._rdv.fetch.remote(round_id))
+            if not (isinstance(out, str) and out == _PENDING):
+                break
+            time.sleep(0.002)
+        self._rdv.finish.remote(round_id, self.rank)
+        return out
+
+    def allreduce(self, array, op: str = "sum"):
+        return self._exchange(np.asarray(array), op)
+
+    def allgather(self, array) -> List:
+        return self._exchange(np.asarray(array), "gather")
+
+    def broadcast(self, array, src_rank: int = 0):
+        out = self._exchange(np.asarray(array) if self.rank == src_rank else None,
+                             "gather")
+        return out[src_rank]
+
+    def reducescatter(self, array, op: str = "sum"):
+        full = self._exchange(np.asarray(array), op)
+        chunks = np.array_split(full, self.world_size)
+        return chunks[self.rank]
+
+    def barrier(self):
+        self._exchange(0, "sum")
+
+
+_groups: Dict[str, CollectiveGroup] = {}
+
+
+def init_collective_group(
+    world_size: int, rank: int, group_name: str = "default"
+) -> CollectiveGroup:
+    """Reference: `ray.util.collective.init_collective_group`
+    (`collective.py:120`)."""
+    g = CollectiveGroup(group_name, world_size, rank)
+    _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    return _groups[group_name]
+
+
+def allreduce(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(array, op)
+
+
+def allgather(array, group_name: str = "default"):
+    return get_group(group_name).allgather(array)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank)
+
+
+def reducescatter(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(array, op)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def destroy_collective_group(group_name: str = "default"):
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        import ray_tpu as rt
+
+        try:
+            rt.kill(g._rdv)
+        except Exception:
+            pass

@@ -1,0 +1,124 @@
+"""Device mesh construction: the unit of compute placement.
+
+TPU-first inversion of the reference's resource model (SURVEY §7): where
+Ray schedules against scalar resource counts and approximates TPU pods
+with a `TPU-{pod}-head` custom resource (`_private/accelerators/tpu.py:381`),
+here an ICI-connected device mesh with named parallelism axes is the
+first-class object.  All five parallelism strategies from SURVEY §2.5
+are mesh axes:
+
+    dp    pure data parallelism (params replicated)
+    fsdp  sharded data parallelism (params/opt-state sharded, ZeRO-3)
+    tp    tensor (Megatron-style layer) parallelism
+    sp    sequence/context parallelism (ring attention rides this axis)
+    ep    expert parallelism (MoE all-to-all)
+    pp    pipeline parallelism (stage dimension)
+
+`MeshSpec.build()` lays axes onto devices with `mesh_utils` so that the
+fastest-varying axes (tp, sp) land on adjacent ICI neighbors.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape.  -1 on at most one axis means "all
+    remaining devices"."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "pp": self.pp,
+            "ep": self.ep,
+            "sp": self.sp,
+            "tp": self.tp,
+        }
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh {sizes} needs {fixed} devices, have {n_devices}"
+                )
+        return MeshSpec(**{k: sizes[k] for k in ("dp", "fsdp", "tp", "sp", "ep", "pp")})
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        spec = self.resolve(len(devices))
+        shape = tuple(spec.sizes()[a] for a in AXES)
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except Exception:
+            # CPU/virtual meshes have no topology; plain reshape
+            dev_array = np.array(devices).reshape(shape)
+        return Mesh(dev_array, AXES)
+
+    @staticmethod
+    def data_parallel(n: int = -1) -> "MeshSpec":
+        return MeshSpec(dp=n)
+
+    @staticmethod
+    def fsdp_only(n: int = -1) -> "MeshSpec":
+        return MeshSpec(fsdp=n)
+
+
+# ----------------------------------------------------------------------
+# common shardings over a mesh
+# ----------------------------------------------------------------------
+def batch_axes() -> Tuple[str, ...]:
+    """Axes over which the global batch is split."""
+    return ("dp", "fsdp")
+
+
+def data_sharding(mesh: Mesh, *trailing) -> NamedSharding:
+    """Batch-dim sharded over (dp, fsdp); trailing dims as given."""
+    return NamedSharding(mesh, P(batch_axes(), *trailing))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    n = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n}")
+    return global_batch // n
+
+
+def mesh_from_devices(n: Optional[int] = None, **axis_sizes) -> Mesh:
+    devices = jax.devices()[: n or len(jax.devices())]
+    return MeshSpec(**axis_sizes).build(devices)

@@ -1,0 +1,71 @@
+"""Logical-axis sharding rules.
+
+GSPMD-style: model code annotates parameters with *logical* axis names
+("embed", "mlp", "heads", "vocab", ...), and a rule table maps logical
+axes to mesh axes.  Changing the parallelism strategy is a rule-table
+swap, not a model edit — the TP/FSDP equivalent of what the reference
+only reaches through torch integrations (SURVEY §2.5: FSDP via
+`prepare_model`, no first-class TP).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rules for a transformer sharded Megatron-style over tp with
+# ZeRO-3-style param sharding over fsdp:
+#   - embed dim is sharded over fsdp (params split for memory)
+#   - mlp hidden + attention heads over tp (compute split)
+#   - vocab over tp (output projection)
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    None: None,
+}
+
+
+def spec_from_logical(
+    logical: Tuple[Optional[str], ...], rules: Optional[Dict] = None
+) -> P:
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(ax) for ax in logical))
+
+
+def sharding_from_logical(
+    mesh: Mesh, logical: Tuple[Optional[str], ...], rules: Optional[Dict] = None
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_from_logical(logical, rules))
+
+
+def tree_shardings(
+    mesh: Mesh, logical_tree: Any, rules: Optional[Dict] = None
+) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda logical: sharding_from_logical(mesh, tuple(logical), rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_params(params: Any, mesh: Mesh, logical_tree: Any,
+                 rules: Optional[Dict] = None) -> Any:
+    """Device-put a parameter pytree according to logical rules."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def infer_logical_like(params: Any, fallback=()) -> Any:
+    """Fully-replicated logical tree matching `params` (for opt state
+    scalars and anything without an annotation)."""
+    return jax.tree.map(lambda _: tuple(fallback), params)
