@@ -22,6 +22,8 @@ import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ray_tpu.core.serialization import dumps_oob as _dumps_oob
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
@@ -59,7 +61,14 @@ async def read_frame(reader: asyncio.StreamReader):
 
 
 def frame_bytes(msg) -> bytes:
-    payload = pickle.dumps(msg, protocol=5)
+    # cloudpickle, not stdlib pickle: task args/replies may hold
+    # functions defined in the driver's __main__ (or lambdas/closures),
+    # which stdlib pickle serializes BY REFERENCE — the receiving
+    # worker's __main__ is worker_main, so the load side would fail (or
+    # silently bind the wrong symbol).  cloudpickle serializes such
+    # objects by value.  ~2.7us/frame overhead vs stdlib on small
+    # control messages (measured), bulk data rides the object plane.
+    payload = _dumps_oob(msg)
     return _LEN.pack(len(payload)) + payload
 
 

@@ -1254,7 +1254,11 @@ def _strategy_from_options(options):
         if pg is not None:
             return SchedulingStrategy(
                 kind="placement_group",
-                pg_id=pg.id.binary() if hasattr(pg, "id") else pg,
+                pg_id=(
+                    pg if isinstance(pg, bytes)
+                    else pg.id if isinstance(getattr(pg, "id", None), bytes)
+                    else pg.id.binary()
+                ),
                 pg_bundle_index=options.get("placement_group_bundle_index", -1),
             )
         return SchedulingStrategy()

@@ -16,6 +16,10 @@ import sys
 import threading
 import time
 
+import faulthandler
+
+faulthandler.register(signal.SIGUSR1, all_threads=True)
+
 
 def main():
     logging.basicConfig(

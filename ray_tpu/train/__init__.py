@@ -1,0 +1,59 @@
+"""ray_tpu.train — distributed training orchestration.
+
+Reference surface: `ray.train` (SURVEY §2.4 Ray Train) — trainers,
+worker groups, in-loop session API, checkpoints, failure handling —
+rebuilt JAX/TPU-first (JaxBackend replaces the torch.distributed
+backend; meshes come from the ScalingConfig).
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    TrainingFailedError,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BackendExecutor",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainingFailedError",
+    "TrainingWorkerError",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

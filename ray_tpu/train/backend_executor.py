@@ -1,0 +1,140 @@
+"""BackendExecutor: drives the worker group through a training run.
+
+Reference: `train/_internal/backend_executor.py:68` — start the
+WorkerGroup, run Backend hooks, kick off training on every worker, poll
+per-iteration results, surface worker failures as TrainingWorkerError
+so the trainer can restart the group (reference FailureConfig path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext, _TrainingResult
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingWorkerError(Exception):
+    """A worker failed mid-training; the group must be restarted."""
+
+
+def _split_datasets(
+    datasets: Optional[Dict[str, Any]], n: int
+) -> List[Dict[str, Any]]:
+    """Per-worker dataset shards.  `Dataset`s split via streaming_split
+    (reference `train/_internal/data_config.py`); lists shard
+    round-robin; everything else is replicated."""
+    shards: List[Dict[str, Any]] = [{} for _ in range(n)]
+    for name, ds in (datasets or {}).items():
+        if hasattr(ds, "streaming_split"):
+            for i, shard in enumerate(ds.streaming_split(n)):
+                shards[i][name] = shard
+        elif isinstance(ds, (list, tuple)):
+            for i in range(n):
+                shards[i][name] = list(ds[i::n])
+        else:
+            for i in range(n):
+                shards[i][name] = ds
+    return shards
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        experiment_name: str = "",
+        trial_id: str = "",
+        storage_path: str = "",
+    ):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()
+        self._scaling = scaling_config
+        self._experiment_name = experiment_name
+        self._trial_id = trial_id
+        self._storage_path = storage_path
+        self.worker_group: Optional[WorkerGroup] = None
+        self._training_started = False
+
+    def start(self):
+        self.worker_group = WorkerGroup(
+            num_workers=self._scaling.num_workers,
+            resources_per_worker=self._scaling._resources_per_worker_not_none(),
+            placement_strategy=self._scaling.placement_strategy,
+        )
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Optional[Dict[str, Any]],
+        checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        assert self.worker_group is not None, "call start() first"
+        self._backend.on_training_start(self.worker_group, self._backend_config)
+        n = len(self.worker_group)
+        shards = _split_datasets(datasets, n)
+        refs = []
+        for rank, worker in enumerate(self.worker_group.workers):
+            ctx = TrainContext(
+                world_size=n,
+                world_rank=rank,
+                local_rank=rank,  # single-host group; node packing refines this
+                local_world_size=n,
+                experiment_name=self._experiment_name,
+                trial_id=self._trial_id,
+                mesh_shape=self._scaling.mesh_shape,
+                storage_path=self._storage_path,
+            )
+            refs.append(
+                worker.start_training.remote(
+                    train_fn, config, ctx, checkpoint, shards[rank]
+                )
+            )
+        rt.get(refs)
+        self._training_started = True
+        self._done = [False] * n
+
+    def get_next_results(self) -> Optional[List[_TrainingResult]]:
+        """One result per still-running worker; None once all finished.
+        All workers report in lockstep (same number of report() calls),
+        as the reference requires."""
+        assert self._training_started
+        wg = self.worker_group
+        live = [i for i, d in enumerate(self._done) if not d]
+        if not live:
+            return None
+        refs = [wg.workers[i].get_next_result.remote() for i in live]
+        try:
+            results: List[_TrainingResult] = rt.get(refs)
+        except Exception as e:
+            raise TrainingWorkerError(f"training worker died: {e}") from e
+        out: List[_TrainingResult] = []
+        for i, res in zip(live, results):
+            if res.error is not None:
+                raise TrainingWorkerError(
+                    f"worker {i} failed: {res.error!r}\n"
+                    + getattr(res.error, "_rt_traceback", "")
+                ) from res.error
+            if res.done:
+                self._done[i] = True
+            else:
+                out.append(res)
+        if not out and all(self._done):
+            return None
+        return out if out else self.get_next_results()
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group, self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+        self._training_started = False

@@ -1,0 +1,69 @@
+"""Top-K checkpoint retention (reference:
+`train/_internal/checkpoint_manager.py`)."""
+
+from __future__ import annotations
+
+import shutil
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+@dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any]
+    index: int
+
+
+class CheckpointManager:
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        self._checkpoints: List[_TrackedCheckpoint] = []
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any],
+                 index: int) -> None:
+        self._checkpoints.append(_TrackedCheckpoint(checkpoint, metrics, index))
+        k = self.config.num_to_keep
+        if k is None or len(self._checkpoints) <= k:
+            return
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            evict = self._checkpoints.pop(0)  # oldest
+        else:
+            sign = 1 if self.config.checkpoint_score_order == "max" else -1
+            worst = min(
+                (c for c in self._checkpoints[:-1]),  # never evict the newest
+                key=lambda c: sign * float(c.metrics.get(attr, float("-inf") * sign)),
+                default=None,
+            )
+            if worst is None:
+                return
+            self._checkpoints.remove(worst)
+            evict = worst
+        shutil.rmtree(evict.checkpoint.path, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return None
+        return max(self._checkpoints, key=lambda c: c.index).checkpoint
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        attr = self.config.checkpoint_score_attribute
+        if not self._checkpoints:
+            return None
+        if attr is None:
+            return self.latest
+        sign = 1 if self.config.checkpoint_score_order == "max" else -1
+        return max(
+            self._checkpoints,
+            key=lambda c: sign * float(c.metrics.get(attr, float("-inf") * sign)),
+        ).checkpoint
+
+    @property
+    def best_checkpoints(self) -> List[tuple]:
+        return [(c.checkpoint, c.metrics) for c in self._checkpoints]

@@ -1,0 +1,103 @@
+"""Train configuration dataclasses.
+
+Surface mirrors the reference's `air/config.py` (`ScalingConfig`,
+`RunConfig`, `FailureConfig`, `CheckpointConfig`) so reference users find
+the same knobs — extended TPU-first: `ScalingConfig` speaks chips and
+mesh topology, not GPUs-per-worker.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each gets.
+
+    Reference: `air/config.py` ScalingConfig (num_workers, use_gpu,
+    resources_per_worker, placement_strategy).  TPU-native additions:
+
+    - ``use_tpu`` / ``topology``: ask the scheduler for an
+      ICI-contiguous sub-mesh ("4x4") instead of loose chips.
+    - ``mesh_shape``: logical mesh axes each worker should build over
+      its visible devices, e.g. ``{"dp": 2, "tp": 4}``.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None
+    mesh_shape: Optional[Dict[str, int]] = None
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def _resources_per_worker_not_none(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            res = dict(self.resources_per_worker)
+        else:
+            res = {"CPU": 1.0}
+            if self.use_tpu:
+                res["TPU"] = 1.0
+        return res
+
+    @property
+    def num_tpus_per_worker(self) -> float:
+        return self._resources_per_worker_not_none().get("TPU", 0.0)
+
+
+@dataclass
+class FailureConfig:
+    """Reference: `air/config.py` FailureConfig(max_failures)."""
+
+    max_failures: int = 0
+
+    @property
+    def retries_enabled(self) -> bool:
+        return self.max_failures != 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: `air/config.py` CheckpointConfig — top-K retention by
+    a score attribute."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+def _default_storage_path() -> str:
+    return os.environ.get(
+        "RT_STORAGE_PATH", os.path.expanduser("~/ray_tpu_results")
+    )
+
+
+@dataclass
+class RunConfig:
+    """Reference: `air/config.py` RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = _default_storage_path()
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

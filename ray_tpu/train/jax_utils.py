@@ -1,0 +1,78 @@
+"""JAX helpers for the train loop.
+
+Reference analog: `train/torch/train_loop_utils.py` (prepare_model /
+prepare_data_loader wrap DDP).  Here the cross-worker primitive is
+`sync_gradients`: host-level allreduce of a gradient pytree over the
+worker collective group.  Within one worker, parallelism is in-program
+(pjit over the worker's mesh) — the TPU-native fast path; use this
+host path only to bridge separate JAX runtimes (one per worker).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _flatten_to_vector(tree):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(l).ravel() for l in leaves]
+    shapes = [np.asarray(l).shape for l in leaves]
+    vec = np.concatenate(arrs) if arrs else np.zeros(0, np.float32)
+    return vec, (treedef, shapes, [a.dtype for a in arrs])
+
+
+def _unflatten_from_vector(vec, meta):
+    import jax
+
+    treedef, shapes, dtypes = meta
+    out, off = [], 0
+    for shape, dt in zip(shapes, dtypes):
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out.append(vec[off : off + n].astype(dt).reshape(shape))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def sync_gradients(grads: Any, group_name: str = "train"):
+    """Mean-allreduce a gradient pytree across the worker group.
+
+    Single flattened exchange (not per-leaf) so one rendezvous round
+    carries the whole gradient. No-op when no collective group exists
+    (single-worker runs work unchanged).
+    """
+    from ray_tpu.parallel import collectives
+
+    try:
+        group = collectives.get_group(group_name)
+    except KeyError:
+        return grads
+    vec, meta = _flatten_to_vector(grads)
+    reduced = group.allreduce(vec, op="mean")
+    return _unflatten_from_vector(reduced, meta)
+
+
+def world_mean(value: float, group_name: str = "train") -> float:
+    from ray_tpu.parallel import collectives
+
+    try:
+        group = collectives.get_group(group_name)
+    except KeyError:
+        return float(value)
+    return float(group.allreduce(np.asarray([value], np.float64), op="mean")[0])
+
+
+def prepare_batch(batch, mesh=None, sharding=None):
+    """device_put a host batch with data sharding over the mesh."""
+    import jax
+
+    if sharding is None and mesh is not None:
+        from ray_tpu.parallel import data_sharding
+
+        sharding = data_sharding(mesh)
+    if sharding is None:
+        return jax.device_put(batch)
+    return jax.device_put(batch, sharding)

@@ -1,0 +1,148 @@
+"""Per-worker training session.
+
+Reference: `train/_internal/session.py` — the user's train loop runs in
+a session thread inside each worker actor; `report()` hands
+(metrics, checkpoint) to the actor's result queue, which the
+BackendExecutor polls.  `get_context()` exposes rank/world info
+(reference `train/context.py:26`); TPU-native addition: `get_mesh()`
+builds the worker's device mesh from the ScalingConfig.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class _TrainingResult:
+    """One unit handed from session thread -> actor -> executor."""
+
+    metrics: Optional[Dict[str, Any]] = None
+    checkpoint: Optional[Checkpoint] = None
+    done: bool = False
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class TrainContext:
+    """Reference: `train/context.py` TrainContext."""
+
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    experiment_name: str = ""
+    trial_name: str = ""
+    trial_id: str = ""
+    mesh_shape: Optional[Dict[str, int]] = None
+    storage_path: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+    def get_trial_id(self) -> str:
+        return self.trial_id
+
+    def get_mesh(self):
+        """Build this worker's jax mesh per the ScalingConfig's
+        ``mesh_shape`` (all local devices if unset)."""
+        from ray_tpu.parallel import mesh_from_devices
+
+        shape = self.mesh_shape or {}
+        return mesh_from_devices(**shape)
+
+
+class _Session:
+    """Holds the queue between the user loop thread and the actor."""
+
+    def __init__(
+        self,
+        context: TrainContext,
+        checkpoint: Optional[Checkpoint],
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.context = context
+        self.result_queue: "queue.Queue[_TrainingResult]" = queue.Queue(maxsize=1)
+        self.loaded_checkpoint = checkpoint
+        self.datasets = datasets or {}
+        self.stop_requested = threading.Event()
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        self.iteration += 1
+        # Blocks when the executor is behind — natural backpressure, the
+        # same semantics as the reference's result queue.
+        self.result_queue.put(_TrainingResult(metrics=metrics, checkpoint=checkpoint))
+        if self.stop_requested.is_set():
+            raise StopIteration("training stop requested")
+
+
+_session_local = threading.local()
+
+
+def _set_session(s: Optional[_Session]):
+    _session_local.value = s
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_session_local, "value", None)
+
+
+# ---------------------------------------------------------------------
+# public in-loop API (reference: `train/_internal/session.py:403,667,754`)
+# ---------------------------------------------------------------------
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "train.report() called outside a training session"
+        )
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_checkpoint() called outside a training session")
+    return s.loaded_checkpoint
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        # Outside a session: a degenerate single-worker context, so the
+        # same train loop runs standalone (reference behaves likewise).
+        return TrainContext()
+    return s.context
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() called outside a training session")
+    return s.datasets.get(name)

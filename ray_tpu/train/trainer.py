@@ -1,0 +1,186 @@
+"""Trainers: fit() orchestration over the BackendExecutor.
+
+Reference: `train/base_trainer.py:111` BaseTrainer.fit,
+`train/data_parallel_trainer.py:25` DataParallelTrainer.  Differences
+by design: fit() drives the executor directly (the reference detours
+through Tune — our Tune-equivalent wraps trainers via
+`as_trainable()` the same way, see `ray_tpu/tune`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.checkpoint import Checkpoint, persist_checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+
+
+class TrainingFailedError(RuntimeError):
+    """Raised by fit() when training fails beyond FailureConfig limits."""
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap into a Tune Trainable (reference `base_trainer.py:819`);
+        imported lazily to keep train usable without tune."""
+        from ray_tpu.tune.trainable import wrap_trainer
+
+        return wrap_trainer(self)
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD training: the same train_loop_per_worker on N workers.
+
+    Reference: `train/data_parallel_trainer.py:25,428`.  The loop calls
+    `ray_tpu.train.report(metrics, checkpoint=...)` each iteration; rank
+    0's metrics become the run's reported metrics.
+    """
+
+    _default_backend_config: BackendConfig = BackendConfig()
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.backend_config = backend_config or self._default_backend_config
+
+    # -- storage layout ------------------------------------------------
+    def _run_dir(self) -> str:
+        name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        d = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        stop = self.run_config.stop
+        if not stop:
+            return False
+        for k, v in stop.items():
+            if k == "training_iteration":
+                if metrics.get("training_iteration", 0) >= v:
+                    return True
+            elif k in metrics and metrics[k] >= v:
+                return True
+        return False
+
+    def fit(self) -> Result:
+        run_dir = self._run_dir()
+        ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
+        max_failures = self.run_config.failure_config.max_failures
+        failures = 0
+        latest_checkpoint = self.resume_from_checkpoint
+        history = []
+        last_metrics: Optional[Dict[str, Any]] = None
+        error: Optional[BaseException] = None
+        iteration = 0
+
+        while True:
+            executor = BackendExecutor(
+                self.backend_config,
+                self.scaling_config,
+                experiment_name=os.path.basename(run_dir),
+                trial_id=uuid.uuid4().hex[:8],
+                storage_path=run_dir,
+            )
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_loop_per_worker,
+                    self.train_loop_config,
+                    checkpoint=latest_checkpoint,
+                    datasets=self.datasets,
+                )
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    iteration += 1
+                    rank0 = results[0]
+                    metrics = dict(rank0.metrics or {})
+                    metrics.setdefault("training_iteration", iteration)
+                    metrics.setdefault("timestamp", time.time())
+                    history.append(metrics)
+                    last_metrics = metrics
+                    reported = [r.checkpoint for r in results if r.checkpoint]
+                    if reported:
+                        dest = None
+                        for ck in reported:
+                            dest = persist_checkpoint(ck, run_dir, iteration)
+                        persisted = Checkpoint(dest)
+                        persisted.update_metadata({"iteration": iteration})
+                        ckpt_manager.register(persisted, metrics, iteration)
+                        latest_checkpoint = persisted
+                    if self._should_stop(metrics):
+                        for w in executor.worker_group.workers:
+                            w.request_stop.remote()
+                error = None
+                break
+            except TrainingWorkerError as e:
+                failures += 1
+                if max_failures >= 0 and failures > max_failures:
+                    error = TrainingFailedError(
+                        f"training failed after {failures} failure(s): {e}"
+                    )
+                    break
+                latest_checkpoint = ckpt_manager.latest or latest_checkpoint
+            finally:
+                executor.shutdown()
+
+        return Result(
+            metrics=last_metrics,
+            checkpoint=ckpt_manager.best or latest_checkpoint,
+            error=error,
+            path=run_dir,
+            metrics_history=history,
+            best_checkpoints=ckpt_manager.best_checkpoints,
+        )
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer: JAX SPMD on TPU meshes (the reference's
+    TorchTrainer analog, `train/torch/torch_trainer.py`)."""
+
+    _default_backend_config = JaxConfig()
+
+    def __init__(self, train_loop_per_worker, *, jax_config: Optional[JaxConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config", jax_config or JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

@@ -1,0 +1,170 @@
+"""WorkerGroup: a gang of training worker actors.
+
+Reference: `train/_internal/worker_group.py:102` — N actors created with
+per-worker resources, placed by a placement group, with `execute` /
+`execute_async` / `execute_single` RPC helpers.  The TrainWorker actor
+additionally hosts the training session thread (reference
+`_internal/session.py` `_StartTraining` + result queue).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.train import session as _session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _Session, _TrainingResult
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+
+
+class TrainWorker:
+    """Actor hosting one training session."""
+
+    def __init__(self, env_vars: Optional[Dict[str, str]] = None):
+        for k, v in (env_vars or {}).items():
+            os.environ[k] = v
+        self._session: Optional[_Session] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- generic RPC ---------------------------------------------------
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def set_env(self, env_vars: Dict[str, str]):
+        os.environ.update(env_vars)
+
+    def node_info(self):
+        return {"pid": os.getpid(), "hostname": os.uname().nodename}
+
+    # -- training session ----------------------------------------------
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Optional[Dict[str, Any]],
+        context: TrainContext,
+        checkpoint: Optional[Checkpoint],
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        assert self._thread is None or not self._thread.is_alive(), (
+            "training already running"
+        )
+        sess = _Session(context, checkpoint, datasets)
+        self._session = sess
+
+        import inspect
+
+        try:
+            takes_config = len(inspect.signature(train_fn).parameters) >= 1
+        except (TypeError, ValueError):
+            takes_config = True
+
+        def _run():
+            _session_mod._set_session(sess)
+            try:
+                if takes_config:
+                    train_fn(config if config is not None else {})
+                else:
+                    train_fn()
+                sess.result_queue.put(_TrainingResult(done=True))
+            except StopIteration:
+                sess.result_queue.put(_TrainingResult(done=True))
+            except BaseException as e:  # noqa: BLE001 - forwarded to driver
+                e._rt_traceback = traceback.format_exc()  # type: ignore[attr-defined]
+                sess.result_queue.put(_TrainingResult(done=True, error=e))
+            finally:
+                _session_mod._set_session(None)
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="train_loop")
+        self._thread.start()
+        return True
+
+    def get_next_result(self) -> _TrainingResult:
+        assert self._session is not None, "no training session"
+        return self._session.result_queue.get()
+
+    def request_stop(self):
+        if self._session is not None:
+            self._session.stop_requested.set()
+
+    def finish(self, timeout: float = 10.0) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+
+@dataclass
+class WorkerMetadata:
+    rank: int
+    node_id: Optional[str]
+    pid: int
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        placement_strategy: str = "PACK",
+        env_vars: Optional[Dict[str, str]] = None,
+    ):
+        self.num_workers = num_workers
+        res = dict(resources_per_worker or {"CPU": 1.0})
+        self._pg: Optional[PlacementGroup] = placement_group(
+            [dict(res) for _ in range(num_workers)], strategy=placement_strategy
+        )
+        if not self._pg.ready(timeout=60.0):
+            remove_placement_group(self._pg)
+            raise rt.exceptions.RayTpuError(
+                f"could not reserve {num_workers} x {res} worker bundles"
+            )
+        opts = dict(
+            num_cpus=res.pop("CPU", 0.0),
+            num_tpus=res.pop("TPU", 0.0),
+            resources=res or None,
+            max_concurrency=2,  # get_next_result blocks while the loop runs
+        )
+        cls = rt.remote(TrainWorker)
+        self.workers: List[rt.ActorHandle] = [
+            cls.options(
+                **opts,
+                placement_group=self._pg,
+                placement_group_bundle_index=i,
+            ).remote(env_vars)
+            for i in range(num_workers)
+        ]
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return rt.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return rt.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def __len__(self):
+        return self.num_workers
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None

@@ -34,7 +34,7 @@ def rt_start():
     """Start a fresh single-node runtime for a test, shut down after."""
     import ray_tpu as rt
 
-    rt.init(num_workers=2, ignore_reinit_error=True)
+    rt.init(num_workers=2, num_cpus=4, ignore_reinit_error=True)
     yield rt
     rt.shutdown()
 
@@ -43,6 +43,6 @@ def rt_start():
 def rt_start_4():
     import ray_tpu as rt
 
-    rt.init(num_workers=4, ignore_reinit_error=True)
+    rt.init(num_workers=4, num_cpus=8, ignore_reinit_error=True)
     yield rt
     rt.shutdown()
