@@ -83,9 +83,18 @@ class _RefCount:
     local: int = 0
     submitted: int = 0
     borrowers: int = 0
+    # Binary pin: 1 while an owned ref sits inside some serialized
+    # container (task return / put) that no consumer has registered yet;
+    # released by the first borrow registration or local deserialization.
+    # Simplification of the reference's contained-refs protocol
+    # (`reference_count.h:64`): refs owned by OTHER processes that we
+    # forward are not protected (the in-flight window the full borrower
+    # protocol closes), and a pin on a never-consumed container is only
+    # released when the job exits.
+    contained: int = 0
 
     def total(self):
-        return self.local + self.submitted + self.borrowers
+        return self.local + self.submitted + self.borrowers + self.contained
 
 
 @dataclass
@@ -282,7 +291,8 @@ class Runtime:
         self._put_counter += 1
         scope = getattr(self._task_local, "task_id", None) or TaskID.for_job(self.job_id)
         oid = ObjectID.for_put(scope, self._put_counter)
-        chunks, total, _refs = ser.serialize(value)
+        chunks, total, captured = ser.serialize(value)
+        self._pin_contained(captured)
         st = _ObjectState(ready=asyncio.Event())
         if total <= self.cfg.max_direct_call_object_size:
             buf = bytearray(total)
@@ -961,6 +971,16 @@ class Runtime:
     # ------------------------------------------------------------------
     # reference counting (reference: reference_count.h:64)
     # ------------------------------------------------------------------
+    def _pin_contained(self, captured_refs):
+        """Pin owned refs captured inside a serialized value until a
+        consumer's borrow registration converts the pin."""
+        if not captured_refs:
+            return
+        with self._state_lock:
+            for r in captured_refs:
+                if r.owner is not None and tuple(r.owner) == self.address:
+                    self.refs.setdefault(r.binary(), _RefCount()).contained = 1
+
     def _add_local_ref(self, id_bytes: bytes):
         rc = self.refs.setdefault(id_bytes, _RefCount())
         rc.local += 1
@@ -1066,6 +1086,7 @@ class Runtime:
         with self._state_lock:
             rc = self.refs.setdefault(payload["id"], _RefCount())
             rc.borrowers += 1
+            rc.contained = 0  # pin transfers to the borrower
 
     async def _h_remove_borrow(self, payload, conn):
         with self._state_lock:
@@ -1209,7 +1230,8 @@ class Runtime:
         out = []
         for i, v in enumerate(values):
             oid = ObjectID.for_return(spec.task_id, i + 1)
-            chunks, total, _refs = ser.serialize(v)
+            chunks, total, captured = ser.serialize(v)
+            self._pin_contained(captured)
             if total <= self.cfg.max_direct_call_object_size:
                 buf = bytearray(total)
                 ser.write_chunks(chunks, memoryview(buf))
@@ -1291,6 +1313,8 @@ def on_ref_deserialized(ref: ObjectRef):
     with rt._state_lock:
         rc = rt.refs.setdefault(ref.binary(), _RefCount())
         rc.local += 1
+        if ref.owner is not None and tuple(ref.owner) == rt.address:
+            rc.contained = 0  # owner consumed its own container: pin -> local
         is_new_borrow = (
             rc.local == 1
             and ref.binary() not in rt.objects

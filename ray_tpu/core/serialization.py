@@ -54,7 +54,7 @@ class _Pickler(cloudpickle.CloudPickler):
         if isinstance(obj, ObjectRef):
             if self._captured_refs is not None:
                 self._captured_refs.append(obj)
-            return (ObjectRef._deserialize, obj._serialize_args())
+            return (ObjectRef._deserialize, (obj._serialize_args(),))
         ser = _custom_serializers.get(type(obj))
         if ser is not None:
             serializer, deserializer = ser

@@ -1,0 +1,47 @@
+"""ray_tpu.data — lazy, streaming, distributed datasets.
+
+Reference surface: `ray.data` (SURVEY §2.4 Ray Data): Dataset over
+blocks with a lazy logical plan, map fusion, a streaming executor with
+bounded in-flight work, and per-consumer streaming splits for Train.
+"""
+
+from ray_tpu.data import aggregate
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.dataset import (
+    Dataset,
+    GroupedData,
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Count",
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "Max",
+    "Mean",
+    "Min",
+    "Std",
+    "Sum",
+    "aggregate",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,122 @@
+"""Block: the unit of data held in the object store.
+
+Reference: `python/ray/data/block.py` — there a Block is an Arrow table
+or pandas DataFrame behind a BlockAccessor.  Here the canonical
+representation is a **dict of equal-length numpy arrays** (column-major):
+zero-copy into the shm object plane, directly `device_put`-able for TPU
+feeding, convertible to/from Arrow and pandas at the IO boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def _to_numpy(values: Sequence[Any]) -> np.ndarray:
+    arr = np.asarray(values)
+    if arr.dtype == object and values and isinstance(values[0], str):
+        return np.asarray(values, dtype=np.str_)
+    return arr
+
+
+def from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return {}
+    cols = list(rows[0].keys())
+    return {c: _to_numpy([r[c] for r in rows]) for c in cols}
+
+
+def from_items(items: List[Any]) -> Block:
+    if items and isinstance(items[0], dict):
+        return from_rows(items)
+    return {"item": _to_numpy(items)}
+
+
+def num_rows(block: Block) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def size_bytes(block: Block) -> int:
+    return int(sum(v.nbytes for v in block.values()))
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+def take_indices(block: Block, idx: np.ndarray) -> Block:
+    return {k: v[idx] for k, v in block.items()}
+
+
+def concat(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if num_rows(b) > 0]
+    if not blocks:
+        return {}
+    cols = blocks[0].keys()
+    return {c: np.concatenate([b[c] for b in blocks]) for c in cols}
+
+
+def _item(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray) and v.shape == ():
+        return v.item()
+    return v
+
+
+def iter_rows(block: Block) -> Iterable[Dict[str, Any]]:
+    n = num_rows(block)
+    cols = list(block.keys())
+    for i in range(n):
+        yield {c: _item(block[c][i]) for c in cols}
+
+
+def schema(block: Block) -> Optional[Dict[str, np.dtype]]:
+    if not block:
+        return None
+    return {k: v.dtype for k, v in block.items()}
+
+
+# ---- interop ---------------------------------------------------------
+def to_pandas(block: Block):
+    import pandas as pd
+
+    return pd.DataFrame({
+        k: (list(v) if v.ndim > 1 else v) for k, v in block.items()
+    })
+
+
+def from_pandas(df) -> Block:
+    return {str(c): np.asarray(df[c].values) for c in df.columns}
+
+
+def to_arrow(block: Block):
+    import pyarrow as pa
+
+    return pa.table({k: (v.tolist() if v.ndim > 1 else v) for k, v in block.items()})
+
+
+def from_arrow(table) -> Block:
+    out = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:
+            out[name] = np.asarray(col.to_pylist())
+    return out
+
+
+def format_batch(block: Block, batch_format: str):
+    if batch_format in ("numpy", "default"):
+        return block
+    if batch_format == "pandas":
+        return to_pandas(block)
+    if batch_format in ("pyarrow", "arrow"):
+        return to_arrow(block)
+    raise ValueError(f"unknown batch_format: {batch_format}")

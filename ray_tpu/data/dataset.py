@@ -1,0 +1,523 @@
+"""Dataset: lazy, streaming, distributed datasets.
+
+Reference surface: `python/ray/data/dataset.py` (`Dataset`) — the same
+transform/consume contract, executed by `ray_tpu.data.executor`'s
+streaming pipeline over this framework's tasks + object plane.
+TPU-native addition: `iter_jax_batches` device-puts batches with an
+optional `NamedSharding` so a data-parallel mesh consumes host data
+without an extra hop.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data import aggregate as agg_mod
+from ray_tpu.data import block as B
+from ray_tpu.data import datasource as ds_mod
+from ray_tpu.data.executor import StreamingExecutor
+from ray_tpu.data.plan import AllToAllOp, LimitOp, LogicalPlan, MapOp, ReadOp
+
+DEFAULT_PARALLELISM = 8
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan):
+        self._plan = plan
+        self._cached_pairs: Optional[List] = None  # materialized (ref, meta)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(self._plan.with_op(op))
+
+    def _pairs(self) -> Iterator:
+        if self._cached_pairs is not None:
+            return iter(self._cached_pairs)
+        return StreamingExecutor(self._plan).execute()
+
+    def _iter_blocks(self) -> Iterator[B.Block]:
+        import ray_tpu as rt
+
+        for block_ref, _ in self._pairs():
+            yield rt.get(block_ref)
+
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+    # ------------------------------------------------------------------
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            return [B.from_rows([fn(r) for r in B.iter_rows(blk)])]
+
+        return self._with_op(MapOp(op, name="Map(map)"))
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        **_kwargs,
+    ) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            out: List[B.Block] = []
+            n = B.num_rows(blk)
+            size = batch_size or n or 1
+            for s in builtins.range(0, max(n, 1), size):
+                piece = B.slice_block(blk, s, min(s + size, n))
+                res = fn(B.format_batch(piece, batch_format))
+                out.append(_coerce_batch(res))
+            return out
+
+        return self._with_op(MapOp(op, name="Map(map_batches)"))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            rows: List[Dict] = []
+            for r in B.iter_rows(blk):
+                rows.extend(fn(r))
+            return [B.from_rows(rows)]
+
+        return self._with_op(MapOp(op, name="Map(flat_map)"))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            mask = np.fromiter(
+                (bool(fn(r)) for r in B.iter_rows(blk)),
+                dtype=bool,
+                count=B.num_rows(blk),
+            )
+            return [B.take_indices(blk, np.nonzero(mask)[0])]
+
+        return self._with_op(MapOp(op, name="Map(filter)"))
+
+    def add_column(self, name: str, fn: Callable[[B.Block], np.ndarray]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            out = dict(blk)
+            out[name] = np.asarray(fn(blk))
+            return [out]
+
+        return self._with_op(MapOp(op, name="Map(add_column)"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            return [{k: v for k, v in blk.items() if k not in cols}]
+
+        return self._with_op(MapOp(op, name="Map(drop_columns)"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            return [{k: blk[k] for k in cols}]
+
+        return self._with_op(MapOp(op, name="Map(select_columns)"))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def op(blk: B.Block) -> List[B.Block]:
+            return [{mapping.get(k, k): v for k, v in blk.items()}]
+
+        return self._with_op(MapOp(op, name="Map(rename_columns)"))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(LimitOp(n))
+
+    # ---- all-to-all ---------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def op(blocks: List[B.Block]) -> List[B.Block]:
+            full = B.concat(blocks)
+            n = B.num_rows(full)
+            bounds = np.linspace(0, n, num_blocks + 1, dtype=np.int64)
+            return [
+                B.slice_block(full, int(bounds[i]), int(bounds[i + 1]))
+                for i in builtins.range(num_blocks)
+            ]
+
+        return self._with_op(AllToAllOp(op, name="AllToAll(repartition)"))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def op(blocks: List[B.Block]) -> List[B.Block]:
+            k = max(1, len(blocks))
+            full = B.concat(blocks)
+            n = B.num_rows(full)
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(n)
+            shuffled = B.take_indices(full, perm)
+            bounds = np.linspace(0, n, k + 1, dtype=np.int64)
+            return [
+                B.slice_block(shuffled, int(bounds[i]), int(bounds[i + 1]))
+                for i in builtins.range(k)
+            ]
+
+        return self._with_op(AllToAllOp(op, name="AllToAll(random_shuffle)"))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def op(blocks: List[B.Block]) -> List[B.Block]:
+            k = max(1, len(blocks))
+            full = B.concat(blocks)
+            order = np.argsort(full[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            out = B.take_indices(full, order)
+            n = B.num_rows(out)
+            bounds = np.linspace(0, n, k + 1, dtype=np.int64)
+            return [
+                B.slice_block(out, int(bounds[i]), int(bounds[i + 1]))
+                for i in builtins.range(k)
+            ]
+
+        return self._with_op(AllToAllOp(op, name="AllToAll(sort)"))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        # pure metadata concat: block refs stay where they are
+        pairs = list(self.materialize()._cached_pairs)
+        for o in others:
+            pairs.extend(o.materialize()._cached_pairs)
+        out = Dataset(LogicalPlan([ReadOp([], name="Union")]))
+        out._cached_pairs = pairs
+        return out
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate two datasets row-aligned.  Runs as one
+        remote task over block REFS — payloads never touch the driver."""
+        import ray_tpu as rt
+
+        left = [p[0] for p in self.materialize()._cached_pairs]
+        right = [p[0] for p in other.materialize()._cached_pairs]
+        zip_remote = rt.remote(_zip_task).options(num_cpus=1)
+        pairs = rt.get(zip_remote.remote(len(left), *left, *right))
+        out = Dataset(LogicalPlan([ReadOp([], name="Zip")]))
+        out._cached_pairs = pairs
+        return out
+
+    # ------------------------------------------------------------------
+    # consumption
+    # ------------------------------------------------------------------
+    def iter_rows(self) -> Iterator[Dict]:
+        for blk in self._iter_blocks():
+            yield from B.iter_rows(blk)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        from ray_tpu.data.iterator import rebatch, shuffle_buffer
+
+        blocks = self._iter_blocks()
+        if local_shuffle_buffer_size:
+            blocks = shuffle_buffer(
+                blocks, local_shuffle_buffer_size, local_shuffle_seed
+            )
+        yield from rebatch(
+            blocks,
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+        )
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        sharding=None,
+        dtype=None,
+        drop_last: bool = True,
+    ) -> Iterator[Any]:
+        """Batches as device-resident jax arrays (TPU feed path)."""
+        import jax
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            arrs = {
+                k: (jnp.asarray(v, dtype=dtype) if dtype else jnp.asarray(v))
+                for k, v in batch.items()
+            }
+            if sharding is not None:
+                arrs = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
+            yield arrs
+
+    def take(self, n: int = 20) -> List[Dict]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        import ray_tpu as rt
+
+        total = 0
+        for _, meta in self._pairs():
+            m = meta if isinstance(meta, dict) else rt.get(meta)
+            total += m["num_rows"]
+        return total
+
+    def schema(self) -> Optional[Dict[str, np.dtype]]:
+        for blk in self._iter_blocks():
+            s = B.schema(blk)
+            if s:
+                return s
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.keys()) if s else []
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._pairs())
+
+    def size_bytes(self) -> int:
+        import ray_tpu as rt
+
+        total = 0
+        for _, meta in self._pairs():
+            m = meta if isinstance(meta, dict) else rt.get(meta)
+            total += m.get("size_bytes", 0)
+        return total
+
+    def to_pandas(self):
+        return B.to_pandas(B.concat(list(self._iter_blocks())))
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds block refs (reference:
+        `Dataset.materialize` -> MaterializedDataset)."""
+        import ray_tpu as rt
+
+        pairs = []
+        for ref, meta in self._pairs():
+            m = meta if isinstance(meta, dict) else rt.get(meta)
+            pairs.append((ref, m))
+        out = Dataset(LogicalPlan([ReadOp([], name="Materialized")]))
+        out._cached_pairs = pairs
+        return out
+
+    def stats(self) -> str:
+        ex = StreamingExecutor(self._plan)
+        return f"plan: {ex.plan.describe()}"
+
+    # ---- splits -------------------------------------------------------
+    def split(self, n: int) -> List["Dataset"]:
+        import ray_tpu as rt
+
+        pairs = self.materialize()._cached_pairs
+        out = []
+        for i in builtins.range(n):
+            d = Dataset(LogicalPlan([ReadOp([], name="Split")]))
+            d._cached_pairs = pairs[i::n]
+            out.append(d)
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
+        from ray_tpu.data.iterator import make_streaming_split
+
+        return make_streaming_split(self, n, equal=equal)
+
+    # ---- writes -------------------------------------------------------
+    def _write(self, write_factory, path: str) -> int:
+        results = self._with_op(
+            MapOp(write_factory(path), name="Map(write)")
+        ).take_all()
+        return builtins.sum(int(r["num_rows"]) for r in results)
+
+    def write_parquet(self, path: str) -> int:
+        return self._write(ds_mod.write_parquet_block, path)
+
+    def write_csv(self, path: str) -> int:
+        return self._write(ds_mod.write_csv_block, path)
+
+    def write_json(self, path: str) -> int:
+        return self._write(ds_mod.write_json_block, path)
+
+    # ---- global aggregates -------------------------------------------
+    def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dict[str, Any]:
+        states = [a.init() for a in aggs]
+        for blk in self._iter_blocks():
+            n = B.num_rows(blk)
+            for i, a in enumerate(aggs):
+                col = blk[a.on] if a.on else np.empty(n)
+                states[i] = a.accumulate_block(states[i], col)
+        return {a.name: a.finalize(s) for a, s in zip(aggs, states)}
+
+    def sum(self, on: str):
+        return self.aggregate(agg_mod.Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        return self.aggregate(agg_mod.Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        return self.aggregate(agg_mod.Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        return self.aggregate(agg_mod.Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(agg_mod.Std(on, ddof))[f"std({on})"]
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan.describe()})"
+
+
+class GroupedData:
+    """Reference: `data/grouped_data.py` — partial per-block aggregation
+    merged in an all-to-all reduce."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dataset:
+        key = self._key
+
+        def op(blocks: List[B.Block]) -> List[B.Block]:
+            groups: Dict[Any, List[Any]] = {}
+            for blk in blocks:
+                keys = blk[key]
+                for g in np.unique(keys):
+                    idx = np.nonzero(keys == g)[0]
+                    sub = B.take_indices(blk, idx)
+                    gk = g.item() if hasattr(g, "item") else g
+                    st = groups.setdefault(gk, [a.init() for a in aggs])
+                    for i, a in enumerate(aggs):
+                        col = sub[a.on] if a.on else np.empty(B.num_rows(sub))
+                        st[i] = a.accumulate_block(st[i], col)
+            rows = []
+            for gk in sorted(groups):
+                row = {key: gk}
+                for a, s in zip(aggs, groups[gk]):
+                    row[a.name] = a.finalize(s)
+                rows.append(row)
+            return [B.from_rows(rows)]
+
+        return self._ds._with_op(AllToAllOp(op, name="AllToAll(groupby)"))
+
+    def count(self) -> Dataset:
+        return self.aggregate(agg_mod.Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Sum(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Mean(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Max(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        return self.aggregate(agg_mod.Std(on, ddof))
+
+    def map_groups(self, fn: Callable[[B.Block], Any]) -> Dataset:
+        key = self._key
+
+        def op(blocks: List[B.Block]) -> List[B.Block]:
+            full = B.concat(blocks)
+            keys = full[key]
+            out: List[B.Block] = []
+            for g in np.unique(keys):
+                sub = B.take_indices(full, np.nonzero(keys == g)[0])
+                out.append(_coerce_batch(fn(sub)))
+            return out
+
+        return self._ds._with_op(AllToAllOp(op, name="AllToAll(map_groups)"))
+
+
+def _zip_task(n_left: int, *blocks):
+    """Remote: zip left/right block lists; returns (ref, meta) pairs."""
+    import ray_tpu as rt
+
+    left = B.concat(list(blocks[:n_left]))
+    right = B.concat(list(blocks[n_left:]))
+    if B.num_rows(left) != B.num_rows(right):
+        raise ValueError("zip requires equal row counts")
+    merged = dict(left)
+    for k, v in right.items():
+        merged[k if k not in merged else f"{k}_1"] = v
+    ref = rt.put(merged)
+    return [(ref, {"num_rows": B.num_rows(merged), "size_bytes": B.size_bytes(merged)})]
+
+
+def _coerce_batch(res) -> B.Block:
+    if isinstance(res, dict):
+        return {k: np.asarray(v) for k, v in res.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(res, pd.DataFrame):
+            return B.from_pandas(res)
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(res, pa.Table):
+            return B.from_arrow(res)
+    except ImportError:
+        pass
+    raise TypeError(
+        f"map_batches fn must return dict/DataFrame/Table, got {type(res)}"
+    )
+
+
+# ---------------------------------------------------------------------
+# read API (reference: `ray.data.read_*` / `from_*` in data/read_api.py)
+# ---------------------------------------------------------------------
+def _read_ds(tasks, name) -> Dataset:
+    return Dataset(LogicalPlan([ReadOp(tasks, name=name)]))
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    return _read_ds(ds_mod.range_tasks(n, parallelism), f"Read(range[{n}])")
+
+
+def from_items(items: List[Any], *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return _read_ds(ds_mod.items_tasks(list(items), parallelism), "Read(items)")
+
+
+def from_blocks(blocks: List[B.Block]) -> Dataset:
+    return _read_ds(ds_mod.blocks_tasks(blocks), "Read(blocks)")
+
+
+def from_numpy(arr: np.ndarray, column: str = "data",
+               *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    chunks = np.array_split(arr, max(1, min(parallelism, len(arr))))
+    return from_blocks([{column: c} for c in chunks if len(c)])
+
+
+def from_pandas(df) -> Dataset:
+    return from_blocks([B.from_pandas(df)])
+
+
+def from_arrow(table) -> Dataset:
+    return from_blocks([B.from_arrow(table)])
+
+
+def read_parquet(paths) -> Dataset:
+    return _read_ds(ds_mod.parquet_tasks(paths), "Read(parquet)")
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return _read_ds(ds_mod.csv_tasks(paths, **kwargs), "Read(csv)")
+
+
+def read_json(paths) -> Dataset:
+    return _read_ds(ds_mod.json_tasks(paths), "Read(json)")
+
+
+def read_text(paths) -> Dataset:
+    return _read_ds(ds_mod.text_tasks(paths), "Read(text)")

@@ -1,0 +1,169 @@
+"""Datasources: read-task factories and write helpers.
+
+Reference: `data/datasource/` + `_internal/datasource/` (parquet/csv/
+json/numpy/range datasources).  A datasource here is simply a list of
+zero-arg callables, each producing blocks — the executor turns each
+into one remote read task (the reference's ReadTask contract).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")
+            ))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched: {paths}")
+    return out
+
+
+def range_tasks(n: int, parallelism: int) -> List[Callable[[], List[B.Block]]]:
+    parallelism = max(1, min(parallelism, n) if n else 1)
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        return lambda: [{"id": np.arange(lo, hi, dtype=np.int64)}]
+
+    return [make(int(bounds[i]), int(bounds[i + 1])) for i in range(parallelism)]
+
+
+def items_tasks(items: List[Any], parallelism: int) -> List[Callable[[], List[B.Block]]]:
+    n = len(items)
+    parallelism = max(1, min(parallelism, n) if n else 1)
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(chunk: List[Any]):
+        return lambda: [B.from_items(chunk)]
+
+    return [
+        make(items[int(bounds[i]): int(bounds[i + 1])])
+        for i in range(parallelism)
+    ]
+
+
+def blocks_tasks(blocks: List[B.Block]) -> List[Callable[[], List[B.Block]]]:
+    def make(b: B.Block):
+        return lambda: [b]
+
+    return [make(b) for b in blocks]
+
+
+def parquet_tasks(paths) -> List[Callable[[], List[B.Block]]]:
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            import pyarrow.parquet as pq
+
+            return [B.from_arrow(pq.read_table(f))]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def csv_tasks(paths, **read_kwargs) -> List[Callable[[], List[B.Block]]]:
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            import pyarrow.csv as pacsv
+
+            return [B.from_arrow(pacsv.read_csv(f, **read_kwargs))]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def json_tasks(paths) -> List[Callable[[], List[B.Block]]]:
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            import json
+
+            with open(f) as fh:
+                first = fh.read(1)
+                fh.seek(0)
+                if first == "[":
+                    rows = json.load(fh)
+                else:  # JSONL
+                    rows = [json.loads(line) for line in fh if line.strip()]
+            return [B.from_rows(rows)]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def text_tasks(paths) -> List[Callable[[], List[B.Block]]]:
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            with open(f) as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            return [{"text": np.asarray(lines, dtype=np.str_)}]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+# ---- writers (run as map tasks) --------------------------------------
+def write_parquet_block(path_dir: str):
+    def write(blk: B.Block) -> List[B.Block]:
+        import uuid
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path_dir, exist_ok=True)
+        f = os.path.join(path_dir, f"part-{uuid.uuid4().hex[:12]}.parquet")
+        pq.write_table(B.to_arrow(blk), f)
+        return [{"path": np.asarray([f]), "num_rows": np.asarray([B.num_rows(blk)])}]
+
+    return write
+
+
+def write_csv_block(path_dir: str):
+    def write(blk: B.Block) -> List[B.Block]:
+        import uuid
+
+        os.makedirs(path_dir, exist_ok=True)
+        f = os.path.join(path_dir, f"part-{uuid.uuid4().hex[:12]}.csv")
+        B.to_pandas(blk).to_csv(f, index=False)
+        return [{"path": np.asarray([f]), "num_rows": np.asarray([B.num_rows(blk)])}]
+
+    return write
+
+
+def write_json_block(path_dir: str):
+    def write(blk: B.Block) -> List[B.Block]:
+        import uuid
+
+        os.makedirs(path_dir, exist_ok=True)
+        f = os.path.join(path_dir, f"part-{uuid.uuid4().hex[:12]}.json")
+        B.to_pandas(blk).to_json(f, orient="records", lines=True)
+        return [{"path": np.asarray([f]), "num_rows": np.asarray([B.num_rows(blk)])}]
+
+    return write

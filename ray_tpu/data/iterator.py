@@ -1,0 +1,177 @@
+"""DataIterator + streaming split.
+
+Reference: `data/iterator.py` DataIterator and
+`Dataset.streaming_split` — N concurrent consumers (Train workers)
+each pull blocks from one shared streaming execution.  A coordinator
+actor owns the execution generator; shards pull blocks
+first-come-first-served, which load-balances uneven consumers (the
+reference's output-splitter operator behaves the same way for
+equal=False).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+def rebatch(
+    blocks: Iterator[B.Block],
+    *,
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator[Any]:
+    carry: Optional[B.Block] = None
+    for blk in blocks:
+        carry = blk if carry is None else B.concat([carry, blk])
+        if batch_size is None:
+            if B.num_rows(carry):
+                yield B.format_batch(carry, batch_format)
+            carry = None
+            continue
+        while carry is not None and B.num_rows(carry) >= batch_size:
+            yield B.format_batch(B.slice_block(carry, 0, batch_size), batch_format)
+            rest = B.slice_block(carry, batch_size, B.num_rows(carry))
+            carry = rest if B.num_rows(rest) else None
+    if carry is not None and B.num_rows(carry) and not drop_last:
+        yield B.format_batch(carry, batch_format)
+
+
+def shuffle_buffer(
+    blocks: Iterator[B.Block], buffer_size: int, seed: Optional[int] = None
+) -> Iterator[B.Block]:
+    """Moving-window shuffle: accumulate rows into a buffer; once it
+    holds >= buffer_size rows, emit a random half and keep refilling —
+    rows mix ACROSS block boundaries up to the buffer size (reference:
+    iter_batches local_shuffle_buffer_size semantics)."""
+    rng = np.random.default_rng(seed)
+    buf: Optional[B.Block] = None
+    for blk in blocks:
+        buf = blk if buf is None else B.concat([buf, blk])
+        n = B.num_rows(buf)
+        while n >= buffer_size:
+            perm = rng.permutation(n)
+            emit = max(1, n - buffer_size // 2)
+            yield B.take_indices(buf, perm[:emit])
+            buf = B.take_indices(buf, perm[emit:])
+            n = B.num_rows(buf)
+    if buf is not None and B.num_rows(buf):
+        yield B.take_indices(buf, rng.permutation(B.num_rows(buf)))
+
+
+class _SplitCoordinator:
+    """Owns one streaming execution per epoch; shards pull blocks.
+
+    The generator is only replaced once the current one is EXHAUSTED —
+    a shard asks for epoch N+1 only after it drained epoch N (got None),
+    and None implies exhaustion, so a fast shard looping around can
+    never truncate a slow shard's in-progress epoch.
+    """
+
+    def __init__(self, dataset, n: int):
+        import asyncio
+
+        self._dataset = dataset
+        self._n = n
+        self._epoch = -1
+        self._gen = None
+        self._done = True
+        self._cond = asyncio.Condition()
+
+    async def start_epoch(self, shard: int, epoch: int) -> bool:
+        async with self._cond:
+            if epoch <= self._epoch:
+                return True
+            # wait for exhaustion (only reachable if a caller skips
+            # ahead without draining; normal iterators never wait here)
+            await self._cond.wait_for(lambda: self._done)
+            if epoch > self._epoch:
+                self._epoch = epoch
+                self._gen = self._dataset._pairs()
+                self._done = False
+        return True
+
+    async def next_block(self, shard: int, epoch: int):
+        if epoch != self._epoch or self._gen is None or self._done:
+            return None
+        try:
+            return next(self._gen)
+        except StopIteration:
+            async with self._cond:
+                self._done = True
+                self._cond.notify_all()
+            return None
+
+
+class DataIterator:
+    """Per-shard handle (reference: `data/iterator.py` DataIterator)."""
+
+    def __init__(self, coordinator, index: int, world: int):
+        self._coord = coordinator
+        self._index = index
+        self._world = world
+        self._epoch = -1
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        **_kwargs,
+    ) -> Iterator[Any]:
+        import ray_tpu as rt
+
+        self._epoch += 1
+        epoch = self._epoch
+        rt.get(self._coord.start_epoch.remote(self._index, epoch))
+
+        def blocks() -> Iterator[B.Block]:
+            while True:
+                pair = rt.get(self._coord.next_block.remote(self._index, epoch))
+                if pair is None:
+                    return
+                yield rt.get(pair[0])
+
+        yield from rebatch(
+            blocks(),
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+        )
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for batch in self.iter_batches(batch_size=None):
+            yield from B.iter_rows(batch)
+
+    def iter_jax_batches(self, *, batch_size: int = 256, sharding=None,
+                         dtype=None, drop_last: bool = True) -> Iterator[Any]:
+        import jax
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            arrs = {
+                k: (jnp.asarray(v, dtype=dtype) if dtype else jnp.asarray(v))
+                for k, v in batch.items()
+            }
+            if sharding is not None:
+                arrs = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
+            yield arrs
+
+
+def make_streaming_split(dataset, n: int, *, equal: bool = False) -> List[DataIterator]:
+    import ray_tpu as rt
+
+    if equal:
+        raise NotImplementedError(
+            "streaming_split(equal=True) is not implemented yet; use "
+            "equal=False (first-come-first-served shards)"
+        )
+    coord = rt.remote(_SplitCoordinator).options(
+        num_cpus=0, max_concurrency=max(2, n + 1)
+    ).remote(dataset, n)
+    return [DataIterator(coord, i, n) for i in range(n)]

@@ -1,0 +1,89 @@
+"""Logical plan: lazy operator chain with map fusion.
+
+Reference: Ray Data's logical plan + optimizer
+(`data/_internal/logical/`, planner `_internal/planner/`).  The
+capability kept: datasets are lazy; chained row/batch transforms fuse
+into single tasks (the reference's MapFusion rule); all-to-all ops
+(shuffle/sort/repartition/groupby) are explicit barrier stages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.data import block as B
+
+
+@dataclass
+class ReadOp:
+    """Source: a list of zero-arg callables, each returning a list of
+    blocks (one read task per callable)."""
+
+    read_tasks: List[Callable[[], List[B.Block]]]
+    name: str = "Read"
+
+
+@dataclass
+class MapOp:
+    """Per-block transform: Block -> List[Block].  map/map_batches/
+    filter/flat_map/limit all lower to this shape."""
+
+    fn: Callable[[B.Block], List[B.Block]]
+    name: str = "Map"
+
+
+@dataclass
+class AllToAllOp:
+    """Barrier: List[Block] -> List[Block] (repartition, shuffle, sort,
+    groupby reduce)."""
+
+    fn: Callable[[List[B.Block]], List[B.Block]]
+    name: str = "AllToAll"
+
+
+@dataclass
+class LimitOp:
+    limit: int
+    name: str = "Limit"
+
+
+Op = Any  # ReadOp | MapOp | AllToAllOp | LimitOp
+
+
+@dataclass
+class LogicalPlan:
+    ops: List[Op] = field(default_factory=list)
+
+    def with_op(self, op: Op) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def optimized(self) -> "LogicalPlan":
+        """Fuse consecutive MapOps (reference MapFusion)."""
+        fused: List[Op] = []
+        for op in self.ops:
+            if (
+                isinstance(op, MapOp)
+                and fused
+                and isinstance(fused[-1], MapOp)
+            ):
+                prev = fused.pop()
+                fused.append(_fuse(prev, op))
+            else:
+                fused.append(op)
+        return LogicalPlan(fused)
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops)
+
+
+def _fuse(a: MapOp, b: MapOp) -> MapOp:
+    fa, fb = a.fn, b.fn
+
+    def fused(block: B.Block) -> List[B.Block]:
+        out: List[B.Block] = []
+        for mid in fa(block):
+            out.extend(fb(mid))
+        return out
+
+    return MapOp(fn=fused, name=f"{a.name}->{b.name}")
