@@ -27,6 +27,11 @@ class TrainingFailedError(RuntimeError):
 
 
 class BaseTrainer:
+    # per-iteration hook (metrics, persisted_checkpoint|None) used by the
+    # Tune integration to forward reports to the trial (reference: the
+    # trainable wrapper re-reporting, base_trainer.py:819)
+    _result_callback = None
+
     def __init__(
         self,
         *,
@@ -140,6 +145,7 @@ class DataParallelTrainer(BaseTrainer):
                     history.append(metrics)
                     last_metrics = metrics
                     reported = [r.checkpoint for r in results if r.checkpoint]
+                    persisted = None
                     if reported:
                         dest = None
                         for ck in reported:
@@ -148,6 +154,8 @@ class DataParallelTrainer(BaseTrainer):
                         persisted.update_metadata({"iteration": iteration})
                         ckpt_manager.register(persisted, metrics, iteration)
                         latest_checkpoint = persisted
+                    if self._result_callback is not None:
+                        self._result_callback(metrics, persisted)
                     if self._should_stop(metrics):
                         for w in executor.worker_group.workers:
                             w.request_stop.remote()

@@ -1,0 +1,52 @@
+"""ray_tpu.tune — hyperparameter tuning.
+
+Reference surface: `ray.tune` (SURVEY §2.4 Ray Tune): Tuner over trial
+actors, search spaces, ASHA/median-stop/PBT schedulers, experiment
+checkpoint/resume.  Trainers integrate via `Tuner(JaxTrainer(...))`.
+"""
+
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import FunctionTrainable, Trainable
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "FunctionTrainable",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Trainable",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,190 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference: `tune/schedulers/` — ASHA (`async_hyperband.py`), median
+stopping (`median_stopping_rule.py`), PBT (`pbt.py`), FIFO.
+Decisions: CONTINUE (keep going), STOP (terminate trial).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+    def choose_exploit(self, trial, trials) -> Optional[Any]:
+        """PBT hook: return a donor trial to exploit, or None."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving (reference:
+    `schedulers/async_hyperband.py` AsyncHyperBandScheduler).
+
+    Rungs at grace_period * reduction_factor^k up to max_t; at each rung
+    a trial continues only if its metric is in the top 1/reduction_factor
+    of results recorded at that rung so far.
+    """
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        max_t: int = 100,
+        time_attr: str = "training_iteration",
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values
+        self._recorded: Dict[int, List[float]] = defaultdict(list)
+
+    def _better(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if self.metric not in result:
+            return CONTINUE
+        v = self._better(float(result[self.metric]))
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t >= rung and rung not in trial.rungs_passed:
+                trial.rungs_passed.add(rung)
+                recorded = self._recorded[rung]
+                recorded.append(v)
+                k = max(1, math.ceil(len(recorded) / self.rf))
+                threshold = sorted(recorded, reverse=True)[k - 1]
+                if v < threshold:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Reference: `schedulers/median_stopping_rule.py` — stop a trial
+    whose best result is worse than the median of other trials' running
+    averages at the same point."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self._avgs: Dict[Any, List[float]] = defaultdict(list)
+
+    def _better(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        t = result.get(self.time_attr, 0)
+        v = self._better(float(result[self.metric]))
+        self._avgs[trial.trial_id].append(v)
+        if t < self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        others = [
+            sum(vals) / len(vals)
+            for tid, vals in self._avgs.items()
+            if tid != trial.trial_id and vals
+        ]
+        if len(others) + 1 < self.min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        best = max(self._avgs[trial.trial_id])
+        if best < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """Simplified PBT (reference: `schedulers/pbt.py`): every
+    perturbation_interval iterations, bottom-quantile trials exploit a
+    top-quantile donor (copy its checkpoint) and explore (perturb
+    hyperparams by 1.2/0.8 or resample)."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        seed: Optional[int] = None,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._last: Dict[Any, float] = {}
+
+    def _score(self, trial) -> Optional[float]:
+        if trial.last_result is None or self.metric not in trial.last_result:
+            return None
+        v = float(trial.last_result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def choose_exploit(self, trial, trials) -> Optional[Any]:
+        t = (trial.last_result or {}).get(self.time_attr, 0)
+        if t == 0 or t % self.interval != 0:
+            return None
+        scored = [(self._score(x), x) for x in trials]
+        scored = [(s, x) for s, x in scored if s is not None]
+        if len(scored) < 2:
+            return None
+        scored.sort(key=lambda p: p[0])
+        k = max(1, int(len(scored) * self.quantile))
+        bottom = [x for _, x in scored[:k]]
+        top = [x for _, x in scored[-k:]]
+        if trial in bottom and trial not in top:
+            return self._rng.choice(top)
+        return None
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for k, spec in self.mutations.items():
+            if isinstance(spec, list):
+                out[k] = self._rng.choice(spec)
+            elif isinstance(spec, Domain):
+                out[k] = spec.sample(self._rng)
+            elif callable(spec):
+                out[k] = spec()
+            elif isinstance(out.get(k), (int, float)):
+                out[k] = out[k] * self._rng.choice([0.8, 1.2])
+        return out

@@ -1,0 +1,187 @@
+"""Search spaces and variant generation.
+
+Reference: `tune/search/sample.py` (Domain/Float/Integer/Categorical),
+`tune/search/basic_variant.py` (BasicVariantGenerator: grid expansion x
+num_samples with random sampling), `tune/search/variant_generator.py`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int  # exclusive
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class Quantized(Domain):
+    inner: Domain
+    q: float
+
+    def sample(self, rng):
+        v = self.inner.sample(rng)
+        return round(v / self.q) * self.q
+
+
+# -- public constructors (reference: `ray.tune.uniform` etc.) ----------
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def quniform(low: float, high: float, q: float) -> Quantized:
+    return Quantized(Uniform(low, high), q)
+
+
+def sample_from(fn: Callable[[Dict], Any]) -> "SampleFrom":
+    return SampleFrom(fn)
+
+
+@dataclass
+class SampleFrom(Domain):
+    fn: Callable[[Dict], Any]
+
+    def sample(self, rng):  # resolved against the config later
+        raise NotImplementedError
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Reference: `ray.tune.grid_search` marker dict."""
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _walk(space: Dict[str, Any], path=()):
+    """Yield (path, leaf) for every leaf, recursing into nested plain
+    dicts (so `{"train_loop_config": {"lr": grid_search(...)}}` works,
+    as in the reference's nested variant resolution)."""
+    for k, v in space.items():
+        p = path + (k,)
+        if isinstance(v, dict) and not _is_grid(v):
+            yield from _walk(v, p)
+        else:
+            yield p, v
+
+
+def _set_in(cfg: Dict[str, Any], path, value):
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Cross-product of grid_search entries x num_samples random draws
+    of Domain entries (reference BasicVariantGenerator semantics:
+    num_samples multiplies the grid)."""
+    rng = random.Random(seed)
+    entries = list(_walk(param_space))
+    grid_paths = [p for p, v in entries if _is_grid(v)]
+    grid_values = [v["grid_search"] for p, v in entries if _is_grid(v)]
+    variants: List[Dict[str, Any]] = []
+    combos = list(itertools.product(*grid_values)) if grid_paths else [()]
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg: Dict[str, Any] = {}
+            deferred = []
+            for p, v in entries:
+                if p in grid_paths:
+                    _set_in(cfg, p, combo[grid_paths.index(p)])
+                elif isinstance(v, SampleFrom):
+                    deferred.append((p, v))
+                elif isinstance(v, Domain):
+                    _set_in(cfg, p, v.sample(rng))
+                else:
+                    _set_in(cfg, p, v)
+            for p, v in deferred:
+                _set_in(cfg, p, v.fn(cfg))
+            variants.append(cfg)
+    return variants
+
+
+class Searcher:
+    """Pluggable searcher interface (reference: `tune/search/searcher.py`).
+    suggest() returns a config or None when exhausted."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._variants = generate_variants(param_space, num_samples, seed)
+        self._i = 0
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg
+
+    def total(self) -> int:
+        return len(self._variants)

@@ -1,0 +1,205 @@
+"""Tune library tests, modeled on the reference's `tune/tests/`
+(variant generation, trial scheduling decisions, experiment resume,
+trainer integration)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import train, tune
+from ray_tpu.tune import (
+    ASHAScheduler,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+
+
+def test_generate_variants_grid_and_samples():
+    from ray_tpu.tune.search import generate_variants
+
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "bs": 32,
+    }
+    vs = generate_variants(space, num_samples=3, seed=0)
+    assert len(vs) == 6  # 2 grid x 3 samples
+    assert {v["lr"] for v in vs} == {0.1, 0.01}
+    assert all(0.0 <= v["wd"] <= 1.0 for v in vs)
+    assert all(v["bs"] == 32 for v in vs)
+
+    assert tune.choice([1, 2, 3]).sample(__import__("random").Random(0)) in (1, 2, 3)
+    assert 1 <= tune.randint(1, 5).sample(__import__("random").Random(0)) < 5
+    lo = tune.loguniform(1e-4, 1e-1).sample(__import__("random").Random(0))
+    assert 1e-4 <= lo <= 1e-1
+
+
+def test_tuner_function_trainable(rt_start, tmp_path):
+    def objective(config):
+        score = 0.0
+        for i in range(4):
+            score += config["lr"]
+            tune.report({"score": score})
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([1.0, 2.0, 3.0])},
+        tune_config=TuneConfig(metric="score", mode="max", max_concurrent_trials=2),
+        run_config=train.RunConfig(name="fn", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["score"] == pytest.approx(12.0)
+    assert best.metrics["config"]["lr"] == 3.0
+
+
+def test_tuner_class_trainable_with_checkpoint(rt_start, tmp_path):
+    class Quad(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.val = 0.0
+
+        def step(self):
+            self.val += self.x
+            return {"val": self.val}
+
+        def save_checkpoint(self, d):
+            return {"val": self.val}
+
+        def load_checkpoint(self, state):
+            if isinstance(state, dict):
+                self.val = state["val"]
+
+    results = Tuner(
+        Quad,
+        param_space={"x": tune.grid_search([1.0, 5.0])},
+        tune_config=TuneConfig(metric="val", mode="max", checkpoint_frequency=2),
+        run_config=train.RunConfig(
+            name="cls", storage_path=str(tmp_path), stop={"training_iteration": 4}
+        ),
+    ).fit()
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["val"] == pytest.approx(20.0)
+    assert best.checkpoint is not None
+    assert best.checkpoint.to_dict()["val"] == pytest.approx(20.0)
+
+
+def test_asha_stops_bad_trials(rt_start, tmp_path):
+    def objective(config):
+        for i in range(16):
+            tune.report({"acc": config["q"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=ASHAScheduler(
+                metric="acc", mode="max", grace_period=2,
+                reduction_factor=2, max_t=16,
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=train.RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    iters = {
+        r.metrics["config"]["q"]: r.metrics.get("training_iteration", 0)
+        for r in results
+    }
+    # the best trial ran to max_t (stopped at 16); at least one poor
+    # trial was culled early
+    assert max(iters.values()) >= 15
+    assert min(iters.values()) < 15
+
+
+def test_tuner_restore_resumes(rt_start, tmp_path):
+    marker = str(tmp_path / "crash_once")
+
+    def objective(config):
+        ck = tune.get_checkpoint()
+        start = ck.to_dict()["i"] + 1 if ck else 0
+        for i in range(start, 6):
+            if i == 3 and config["tag"] == "crashy" and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("boom")
+            tune.report(
+                {"i": i}, checkpoint=train.Checkpoint.from_dict({"i": i})
+            )
+
+    tuner = Tuner(
+        objective,
+        param_space={"tag": tune.grid_search(["ok", "crashy"])},
+        tune_config=TuneConfig(metric="i", mode="max"),
+        run_config=train.RunConfig(name="resume", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 1  # crashy failed
+
+    restored = Tuner.restore(str(tmp_path / "resume"), objective).fit()
+    assert restored.num_errors == 0
+    for r in restored:
+        assert r.metrics["i"] == 5
+
+
+def test_pbt_exploits(rt_start, tmp_path):
+    def objective(config):
+        v = 0.0
+        for i in range(12):
+            ck = tune.get_checkpoint()
+            if i == 0 and ck is not None:
+                v = ck.to_dict()["v"]
+            v += config["lr"]
+            tune.report(
+                {"fitness": v}, checkpoint=train.Checkpoint.from_dict({"v": v})
+            )
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.0, 1.0])},
+        tune_config=TuneConfig(
+            metric="fitness",
+            mode="max",
+            scheduler=PopulationBasedTraining(
+                metric="fitness", mode="max", perturbation_interval=4,
+                quantile_fraction=0.5, seed=0,
+                hyperparam_mutations={"lr": [0.5, 1.0, 2.0]},
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=train.RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    # the lr=0 trial must have exploited the better trial at least once:
+    # its final fitness can't still be 0
+    fits = sorted(r.metrics["fitness"] for r in results)
+    assert fits[0] > 0.0
+
+
+def test_tuner_over_jax_trainer(rt_start, tmp_path):
+    def loop(config):
+        m = 0.0
+        for i in range(3):
+            m += config["delta"]
+            train.report({"m": m})
+
+    trainer = train.JaxTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(name="inner", storage_path=str(tmp_path / "inner")),
+    )
+    results = Tuner(
+        trainer,
+        param_space={"train_loop_config": {"delta": tune.grid_search([1.0, 2.0])}},
+        tune_config=TuneConfig(metric="m", mode="max",
+                               resources_per_trial={"CPU": 0.5}),
+        run_config=train.RunConfig(name="outer", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    assert results.get_best_result().metrics["m"] == pytest.approx(6.0)
