@@ -949,6 +949,11 @@ class Runtime:
             done_flags[i] = True
 
         tasks = [asyncio.create_task(_one(i, r)) for i, r in enumerate(refs)]
+        # one scheduling pass so each waiter observes already-ready
+        # objects — without it `wait(timeout=0)` (the non-blocking poll
+        # used by controllers) would always report nothing ready
+        await asyncio.sleep(0)
+        tasks = [t for t in tasks if not t.done()]
         try:
             deadline = None if timeout is None else time.monotonic() + timeout
             while sum(done_flags) < num_returns:

@@ -49,17 +49,33 @@ class _TrialActor:
                 except Exception:
                     pass
                 self._t.load_checkpoint(state if state is not None else ckpt.path)
+        if restore_from:
+            # training_iteration continues from where the checkpoint was
+            # taken (reference: Trainable.restore replays _iteration
+            # from the checkpoint metadata) — otherwise stop criteria,
+            # checkpoint numbering, and ASHA rungs would run backwards
+            # after fault-tolerance restore
+            meta = os.path.join(restore_from, ".tune_metadata")
+            try:
+                with open(meta) as f:
+                    self._t.iteration = json.load(f).get("iteration", 0)
+            except (OSError, ValueError):
+                # missing or corrupt metadata degrades to a reset
+                # counter — never to a failed restore
+                pass
 
     def step(self) -> Dict[str, Any]:
-        out = self._t.step()
-        out.setdefault("training_iteration", self._t.iteration)
-        return out
+        return self._t.train()
 
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         state = self._t.save_checkpoint(checkpoint_dir)
         if state is not None:
             Checkpoint.from_dict(state).to_directory(checkpoint_dir)
+        meta = os.path.join(checkpoint_dir, ".tune_metadata")
+        with open(meta + ".tmp", "w") as f:
+            json.dump({"iteration": self._t.checkpoint_iteration}, f)
+        os.replace(meta + ".tmp", meta)
         return checkpoint_dir
 
     def cleanup(self):
@@ -80,6 +96,7 @@ class Trial:
     checkpoint_path: Optional[str] = None
     error: Optional[str] = None
     rungs_passed: Set[int] = field(default_factory=set)
+    rung_values: Dict[int, float] = field(default_factory=dict)
     restore_from: Optional[str] = None
     actor: Any = None
     inflight: Any = None
@@ -184,6 +201,7 @@ class TuneController:
         trial.config = new_config
         trial.restore_from = donor_ckpt
         trial.rungs_passed = set()
+        trial.rung_values = {}
         return True
 
     def _save_trial_checkpoint_for(self, donor: Trial) -> Optional[str]:
@@ -230,6 +248,7 @@ class TuneController:
                     self._stop_trial(t, ERROR, f"failed to start: {e}")
             refs = [t.inflight for t in running if t.inflight is not None]
             if not refs:
+                time.sleep(0.01)
                 continue
             ready, _ = rt.wait(refs, num_returns=1, timeout=5.0)
             for ref in ready:

@@ -75,14 +75,25 @@ class ASHAScheduler(TrialScheduler):
         v = self._better(float(result[self.metric]))
         if t >= self.max_t:
             return STOP
-        for rung in reversed(self.rungs):
+        for rung in self.rungs:
             if t >= rung and rung not in trial.rungs_passed:
                 trial.rungs_passed.add(rung)
-                recorded = self._recorded[rung]
-                recorded.append(v)
+                trial.rung_values[rung] = v
+                self._recorded[rung].append(v)
+        # Re-evaluate the trial's LATEST rung against that rung's
+        # *current* population: textbook ASHA decides only on rung
+        # arrival, which under lockstep arrival (weakest first) never
+        # culls; a deferred re-check keeps the asynchrony but recovers
+        # the culling power of synchronous successive halving.  Only the
+        # most recent rung is re-checked so an improving trial is judged
+        # by its freshest snapshot, not a noisy early one.
+        if trial.rung_values:
+            rung = max(trial.rung_values)
+            recorded = self._recorded[rung]
+            if len(recorded) >= 2:
                 k = max(1, math.ceil(len(recorded) / self.rf))
                 threshold = sorted(recorded, reverse=True)[k - 1]
-                if v < threshold:
+                if trial.rung_values[rung] < threshold:
                     return STOP
         return CONTINUE
 

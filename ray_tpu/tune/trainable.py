@@ -34,6 +34,21 @@ class Trainable:
     def step(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def train(self) -> Dict[str, Any]:
+        """One training call: runs step() and maintains the iteration
+        counter (reference: `trainable.py:290` Trainable.train)."""
+        result = self.step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        result.setdefault("done", False)
+        return result
+
+    @property
+    def checkpoint_iteration(self) -> int:
+        """Iteration the next save_checkpoint() reflects — for the class
+        API that is the live counter (checkpoints snapshot live state)."""
+        return self.iteration
+
     def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
         return None
 
@@ -62,6 +77,7 @@ class FunctionTrainable:
         self._session = _Session(TrainContext(trial_name=os.path.basename(trial_dir)),
                                  checkpoint)
         self._last_checkpoint: Optional[Checkpoint] = checkpoint
+        self._last_checkpoint_iteration = 0
         self._fn = fn
         self._thread: Optional[threading.Thread] = None
 
@@ -97,9 +113,24 @@ class FunctionTrainable:
         self.iteration += 1
         if res.checkpoint is not None:
             self._last_checkpoint = res.checkpoint
+            self._last_checkpoint_iteration = self.iteration
         out = dict(res.metrics or {})
         out.setdefault("done", False)
         return out
+
+    def train(self) -> Dict[str, Any]:
+        # unlike Trainable.train(), no increment here: step() already
+        # advanced the counter when it pulled the session report
+        out = self.step()
+        out.setdefault("training_iteration", self.iteration)
+        return out
+
+    @property
+    def checkpoint_iteration(self) -> int:
+        """Iteration of the checkpoint save_checkpoint() will persist —
+        the last one the user fn attached, NOT the live counter (the fn
+        may report several iterations between checkpoints)."""
+        return self._last_checkpoint_iteration
 
     def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
         if self._last_checkpoint is not None:
