@@ -1,0 +1,51 @@
+"""Model serving library (reference: `python/ray/serve/`).
+
+Control plane: one `ServeController` actor reconciles replica sets,
+health-checks them, and autoscales from replica metrics.  Data plane:
+`DeploymentHandle` (Python) and `HTTPProxy` (HTTP) route requests to
+replica actors with power-of-two-choices load balancing.  Replicas wrap
+the user callable; `@serve.batch` batches requests into fixed-size
+MXU-friendly groups so XLA-compiled inference programs are reused.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    http_address,
+    ingress,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.request import Request, Response
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "Request",
+    "Response",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_address",
+    "ingress",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,343 @@
+"""Serve public API: @deployment, bind, run, status, shutdown.
+
+Reference: `python/ray/serve/api.py` (`@serve.deployment:244`,
+`serve.run:510`) — deployments are declared with a decorator, composed
+into applications with `.bind()`, and deployed by `serve.run`, which
+returns a handle to the ingress deployment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu as rt
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.controller import (
+    CONTROLLER_NAME,
+    CONTROLLER_NAMESPACE,
+    ServeController,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+_state: Dict[str, Any] = {}
+_state_lock = threading.Lock()
+
+
+# ----------------------------------------------------------------------
+# deployment declaration
+# ----------------------------------------------------------------------
+class Application:
+    """A bound deployment graph node (reference: the object returned by
+    `Deployment.bind`, `serve/deployment.py`)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    """Reference: `serve/deployment.py` Deployment."""
+
+    def __init__(self, func_or_class, name: str, config: DeploymentConfig,
+                 resources: Optional[Dict[str, float]] = None):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+        self.resources = resources or {}
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **kwargs) -> "Deployment":
+        import copy
+
+        cfg = copy.deepcopy(self.config)
+        name = kwargs.pop("name", self.name)
+        resources = kwargs.pop("ray_actor_options", None) or kwargs.pop(
+            "resources", None
+        )
+        for k, v in kwargs.items():
+            if k == "autoscaling_config":
+                v = _coerce_autoscaling(v)
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+            else:
+                raise TypeError(f"unknown deployment option {k!r}")
+        return Deployment(
+            self.func_or_class, name, cfg,
+            dict(resources) if resources else dict(self.resources),
+        )
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            "deployments are not directly callable; use .bind() and serve.run"
+        )
+
+
+def _coerce_autoscaling(v) -> Optional[AutoscalingConfig]:
+    if v is None or isinstance(v, AutoscalingConfig):
+        return v
+    return AutoscalingConfig(**v)
+
+
+def deployment(
+    _func_or_class: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Union[int, str, None] = None,
+    max_ongoing_requests: int = 16,
+    max_queued_requests: int = -1,
+    autoscaling_config: Union[AutoscalingConfig, dict, None] = None,
+    user_config: Optional[Any] = None,
+    health_check_period_s: float = 2.0,
+    health_check_timeout_s: float = 10.0,
+    graceful_shutdown_timeout_s: float = 5.0,
+    ray_actor_options: Optional[Dict[str, float]] = None,
+):
+    """Reference: `serve/api.py:244` @serve.deployment."""
+
+    def _wrap(func_or_class):
+        n = num_replicas
+        auto = _coerce_autoscaling(autoscaling_config)
+        if n == "auto":
+            auto = auto or AutoscalingConfig(min_replicas=1, max_replicas=8)
+            n = None
+        cfg = DeploymentConfig(
+            num_replicas=n or 1,
+            max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            autoscaling_config=auto,
+            user_config=user_config,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+        )
+        return Deployment(
+            func_or_class,
+            name or getattr(func_or_class, "__name__", "deployment"),
+            cfg,
+            ray_actor_options,
+        )
+
+    if _func_or_class is not None:
+        return _wrap(_func_or_class)
+    return _wrap
+
+
+def ingress(_app=None, **_kwargs):
+    """FastAPI-style ingress adapter is out of scope; the proxy hands
+    plain `serve.Request` objects to the ingress deployment."""
+
+    def _wrap(cls):
+        return cls
+
+    return _wrap if _app is None else _app
+
+
+# ----------------------------------------------------------------------
+# controller / proxy lifecycle
+# ----------------------------------------------------------------------
+def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True):
+    """Start the serve control plane (reference: `serve/api.py` serve.start)."""
+    with _state_lock:
+        if "controller" not in _state:
+            try:
+                controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
+            except ValueError:
+                controller = (
+                    rt.remote(ServeController)
+                    .options(
+                        name=CONTROLLER_NAME,
+                        namespace=CONTROLLER_NAMESPACE,
+                        max_concurrency=16,
+                        num_cpus=0,
+                    )
+                    .remote()
+                )
+                rt.get(controller.ping.remote())
+            _state["controller"] = controller
+        if proxy and "proxy" not in _state:
+            from ray_tpu.serve.proxy import HTTPProxy
+
+            opts = http_options or HTTPOptions(port=0)
+            p = (
+                rt.remote(HTTPProxy)
+                .options(
+                    name="SERVE_PROXY",
+                    namespace=CONTROLLER_NAMESPACE,
+                    max_concurrency=16,
+                    num_cpus=0,
+                )
+                .remote(opts.host, opts.port)
+            )
+            port = rt.get(p.start.remote())
+            _state["proxy"] = p
+            _state["http_address"] = (opts.host, port)
+    return _state["controller"]
+
+
+def _get_controller():
+    c = _state.get("controller")
+    if c is not None:
+        return c
+    c = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
+    _state["controller"] = c
+    return c
+
+
+async def _get_controller_async():
+    """Loop-thread-safe controller lookup (used by routers/proxies from
+    the runtime's io loop, where blocking `rt.get_actor` would deadlock)."""
+    c = _state.get("controller")
+    if c is not None:
+        return c
+    from ray_tpu.api import ActorHandle
+    from ray_tpu.core.ids import ActorID
+    from ray_tpu.core.runtime import get_runtime
+
+    info = await get_runtime().controller.call(
+        "get_actor", {"name": CONTROLLER_NAME, "namespace": CONTROLLER_NAMESPACE}
+    )
+    if info is None or info.get("state") == "DEAD":
+        raise RuntimeError("serve controller is not running")
+    c = ActorHandle(
+        ActorID(info["actor_id"]), info["address"], CONTROLLER_NAME,
+        info.get("max_task_retries", 0),
+    )
+    _state["controller"] = c
+    return c
+
+
+def http_address() -> Optional[tuple]:
+    return _state.get("http_address")
+
+
+# ----------------------------------------------------------------------
+# run / shutdown
+# ----------------------------------------------------------------------
+def _collect_deployments(app: Application, out: Dict[str, dict]):
+    """Post-order walk of the bound graph: nested Applications become
+    DeploymentHandles passed to the parent's constructor (reference:
+    build_app in `serve/_private/build_app.py`)."""
+
+    def _convert(v, app_name):
+        if isinstance(v, Application):
+            _collect(v)
+            return DeploymentHandle(v.deployment.name, app_name)
+        return v
+
+    app_name = out["__app_name__"]
+
+    def _collect(node: Application):
+        d = node.deployment
+        args = tuple(_convert(a, app_name) for a in node.args)
+        kwargs = {k: _convert(v, app_name) for k, v in node.kwargs.items()}
+        if d.name in out and out[d.name]["callable_def"] is not d.func_or_class:
+            raise ValueError(f"duplicate deployment name {d.name!r}")
+        out[d.name] = {
+            "name": d.name,
+            "callable_def": d.func_or_class,
+            "init_args": args,
+            "init_kwargs": kwargs,
+            "config": d.config,
+            "resources": d.resources,
+        }
+
+    _collect(app)
+
+
+def run(
+    target: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    wait_for_ready: bool = True,
+    timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress
+    (reference: `serve/api.py:510` serve.run)."""
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects the Application from .bind()")
+    controller = start(proxy=True)
+    collected: Dict[str, Any] = {"__app_name__": name}
+    _collect_deployments(target, collected)
+    collected.pop("__app_name__")
+    app_config = {
+        "name": name,
+        "route_prefix": route_prefix,
+        "ingress": target.deployment.name,
+        "deployments": list(collected.values()),
+    }
+    rt.get(controller.deploy_application.remote(app_config), timeout=timeout_s)
+    if wait_for_ready:
+        _wait_for_app(controller, name, timeout_s)
+    return DeploymentHandle(target.deployment.name, name)
+
+
+def _wait_for_app(controller, name: str, timeout_s: float):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status = rt.get(controller.get_serve_status.remote())
+        app = status.get(name, {})
+        if app and all(
+            d["running"] >= 1 and d["running"] >= d["target_replicas"]
+            for d in app.values()
+        ):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"application {name!r} did not become ready")
+
+
+def delete(name: str):
+    controller = _get_controller()
+    rt.get(controller.delete_application.remote(name))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller()
+    return rt.get(controller.get_serve_status.remote())
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _get_controller()
+    ingress = rt.get(controller.get_ingress.remote(name))
+    if ingress is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(ingress, name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"):
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def shutdown():
+    """Tear down all applications, the proxy, and the controller."""
+    with _state_lock:
+        controller = _state.pop("controller", None)
+        proxy = _state.pop("proxy", None)
+        _state.pop("http_address", None)
+    if proxy is not None:
+        try:
+            rt.get(proxy.stop.remote(), timeout=5)
+        except Exception:
+            pass
+        try:
+            rt.kill(proxy)
+        except Exception:
+            pass
+    if controller is not None:
+        try:
+            rt.get(controller.shutdown.remote(), timeout=30)
+        except Exception:
+            pass
+        try:
+            rt.kill(controller)
+        except Exception:
+            pass
+    from ray_tpu.serve import handle as _h
+
+    with _h._routers_lock:
+        _h._routers.clear()

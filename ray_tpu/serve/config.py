@@ -1,0 +1,75 @@
+"""Serve configuration dataclasses.
+
+Mirrors the reference's `python/ray/serve/config.py` (`DeploymentConfig`,
+`AutoscalingConfig`, `HTTPOptions`) so users find the same knobs; kept as
+plain dataclasses (the reference uses pydantic — a validation detail, not
+a capability).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: `serve/config.py` AutoscalingConfig — replica count is
+    driven by the average number of ongoing requests per replica."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+    look_back_period_s: float = 2.0
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current <= 0:
+            return max(self.min_replicas, 1)
+        per_replica = total_ongoing / current
+        desired = current * per_replica / max(self.target_ongoing_requests, 1e-9)
+        import math
+
+        desired = int(math.ceil(desired))
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class DeploymentConfig:
+    """Reference: `serve/config.py` DeploymentConfig."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    max_queued_requests: int = -1  # -1 == unbounded
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    user_config: Optional[Any] = None
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 1)
+        return self.num_replicas
+
+
+@dataclass
+class ReplicaConfig:
+    """What it takes to construct one replica: the callable plus its init
+    args and per-replica resources (reference: `serve/config.py`
+    ReplicaConfig)."""
+
+    import_blob: bytes = b""  # cloudpickled class or function
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    resources: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class HTTPOptions:
+    """Reference: `serve/config.py` HTTPOptions."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,378 @@
+"""ServeController: the serve control plane, as one named actor.
+
+Reference: `python/ray/serve/_private/controller.py` (`ServeController:86`,
+`deploy_application:722`) + `application_state.py:119` +
+`deployment_state.py`: a reconcile loop drives each deployment's replica
+set toward its target (create/kill replica actors, replace unhealthy
+ones), autoscaling adjusts targets from replica-reported metrics, and
+routers poll versioned routing tables (reference pushes them via
+`long_poll.py`; polling is the same contract with simpler failure modes).
+
+The controller's methods are synchronous on purpose: sync actor methods
+execute on the worker's thread pool where blocking `rt.get/wait` calls
+are safe, while the reconcile loop runs on a dedicated daemon thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve.replica import Replica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+CONTROLLER_NAMESPACE = "serve"
+
+# replica lifecycle states (reference: deployment_state.py ReplicaState)
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+
+
+class _ReplicaState:
+    def __init__(self, replica_id: str, handle, max_ongoing: int):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.max_ongoing = max_ongoing
+        self.state = STARTING
+        self.health_ref = None
+        self.health_sent = 0.0
+
+
+class _DeploymentState:
+    """Reconciler state for one deployment (reference:
+    `deployment_state.py` DeploymentState)."""
+
+    def __init__(self, app_name: str, name: str, callable_def, init_args,
+                 init_kwargs, config: DeploymentConfig, resources: Dict[str, float]):
+        self.app_name = app_name
+        self.name = name
+        self.callable_def = callable_def
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.resources = resources or {}
+        self.target_replicas = config.initial_replicas()
+        self.replicas: Dict[str, _ReplicaState] = {}
+        self.version = 0
+        self.next_replica_idx = 0
+        self.last_scale_change = 0.0
+        self.samples: list = []  # (ts, total_ongoing) autoscaler window
+        self.deleted = False
+
+    def routing_table(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "replicas": {
+                r.replica_id: (r.handle, r.max_ongoing)
+                for r in self.replicas.values()
+                if r.state == RUNNING
+            },
+        }
+
+
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._ingress: Dict[str, str] = {}  # app name -> ingress deployment
+        self._routes: Dict[str, str] = {}  # route prefix -> app name
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-controller"
+        )
+        self._thread.start()
+
+    # -- deploy API ---------------------------------------------------
+    def deploy_application(self, app_config: Dict[str, Any]) -> bool:
+        """app_config: {name, route_prefix, ingress, deployments: [
+        {name, callable_def, init_args, init_kwargs, config, resources}]}
+        (reference: `controller.py:722` deploy_application)."""
+        app_name = app_config["name"]
+        with self._lock:
+            deployments: Dict[str, _DeploymentState] = {}
+            old = self._apps.get(app_name, {})
+            stale: List[_ReplicaState] = []
+            for d in app_config["deployments"]:
+                ds = _DeploymentState(
+                    app_name, d["name"], d["callable_def"],
+                    d.get("init_args", ()), d.get("init_kwargs", {}),
+                    d.get("config") or DeploymentConfig(), d.get("resources"),
+                )
+                prev = old.pop(d["name"], None)
+                if prev is not None:
+                    # rolling redeploy: old replicas are torn down and a
+                    # fresh set started at a bumped table version
+                    prev.deleted = True
+                    stale.extend(prev.replicas.values())
+                    prev.replicas = {}
+                    ds.version = prev.version + 1
+                    ds.next_replica_idx = prev.next_replica_idx
+                deployments[d["name"]] = ds
+            for prev in old.values():  # deployments dropped by the update
+                prev.deleted = True
+                stale.extend(prev.replicas.values())
+                prev.replicas = {}
+            self._apps[app_name] = deployments
+            self._ingress[app_name] = app_config.get(
+                "ingress", app_config["deployments"][-1]["name"]
+            )
+            route = app_config.get("route_prefix") or f"/{app_name}"
+            self._routes = {
+                k: v for k, v in self._routes.items() if v != app_name
+            }
+            self._routes[route] = app_name
+        for r in stale:
+            self._stop_replica(r, timeout_s=5.0)
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            deployments = self._apps.pop(app_name, {})
+            self._ingress.pop(app_name, None)
+            self._routes = {k: v for k, v in self._routes.items() if v != app_name}
+            victims: List[tuple] = []
+            for ds in deployments.values():
+                ds.deleted = True  # reconcile snapshots may still hold ds
+                victims.extend(
+                    (r, ds.config.graceful_shutdown_timeout_s)
+                    for r in ds.replicas.values()
+                )
+                ds.replicas = {}
+        for r, timeout_s in victims:
+            self._stop_replica(r, timeout_s=timeout_s)
+        return True
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        for app in list(self._apps):
+            self.delete_application(app)
+        return True
+
+    # -- routing ------------------------------------------------------
+    def get_routing_table(self, app_name: str, deployment_name: str):
+        with self._lock:
+            ds = self._apps.get(app_name, {}).get(deployment_name)
+            if ds is None:
+                return {"version": -1, "replicas": {}}
+            return ds.routing_table()
+
+    def get_app_for_route(self, path: str) -> Optional[Dict[str, str]]:
+        with self._lock:
+            best = None
+            for prefix, app in self._routes.items():
+                norm = prefix.rstrip("/") or "/"
+                if path == norm or path.startswith(norm + "/") or norm == "/":
+                    if best is None or len(norm) > len(best[0]):
+                        best = (norm, app)
+            if best is None:
+                return None
+            prefix, app = best
+            return {"app": app, "ingress": self._ingress[app], "prefix": prefix}
+
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return list(self._apps)
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            return self._ingress.get(app_name)
+
+    def get_serve_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                app_name: {
+                    name: {
+                        "target_replicas": ds.target_replicas,
+                        "running": sum(
+                            1 for r in ds.replicas.values() if r.state == RUNNING
+                        ),
+                        "version": ds.version,
+                    }
+                    for name, ds in deployments.items()
+                }
+                for app_name, deployments in self._apps.items()
+            }
+
+    def ping(self) -> bool:
+        return True
+
+    # -- reconcile loop ----------------------------------------------
+    def _control_loop(self):
+        """Reference: the controller's run_control_loop — reconcile +
+        health checks + autoscaling on a short period."""
+        while not self._stop.is_set():
+            try:
+                self._reconcile_once()
+                self._autoscale()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                traceback.print_exc()
+            self._stop.wait(0.2)
+
+    def _reconcile_once(self):
+        with self._lock:
+            all_ds = [
+                ds
+                for deployments in self._apps.values()
+                for ds in deployments.values()
+            ]
+        for ds in all_ds:
+            try:
+                self._reconcile_deployment(ds)
+            except Exception:
+                traceback.print_exc()
+
+    def _reconcile_deployment(self, ds: _DeploymentState):
+        now = time.monotonic()
+        with self._lock:
+            if ds.deleted:
+                return
+            changed = False
+            # 1. health-check replicas; replace dead/unresponsive ones
+            for rid, r in list(ds.replicas.items()):
+                if r.health_ref is None:
+                    due = (
+                        r.state == STARTING
+                        or now - r.health_sent >= ds.config.health_check_period_s
+                    )
+                    if due:
+                        r.health_ref = r.handle.check_health.remote()
+                        r.health_sent = now
+                    continue
+                done, _ = rt.wait([r.health_ref], timeout=0)
+                if done:
+                    try:
+                        rt.get(r.health_ref)
+                        if r.state == STARTING:
+                            r.state = RUNNING
+                            changed = True
+                    except Exception:
+                        del ds.replicas[rid]
+                        changed = True
+                        self._kill_quietly(r)
+                    r.health_ref = None
+                elif now - r.health_sent > ds.config.health_check_timeout_s:
+                    del ds.replicas[rid]
+                    changed = True
+                    self._kill_quietly(r)
+            # 2. scale up to target
+            while len(ds.replicas) < ds.target_replicas:
+                self._start_replica(ds)
+                changed = True
+            # 3. scale down from target (newest first)
+            excess = len(ds.replicas) - ds.target_replicas
+            victims: List[_ReplicaState] = []
+            if excess > 0:
+                order = sorted(
+                    ds.replicas, key=lambda rid: int(rid.rsplit("#", 1)[1])
+                )
+                for rid in order[-excess:]:
+                    victims.append(ds.replicas.pop(rid))
+                changed = True
+            if changed:
+                ds.version += 1
+        for r in victims:
+            self._stop_replica(r, timeout_s=ds.config.graceful_shutdown_timeout_s)
+
+    def _start_replica(self, ds: _DeploymentState):
+        rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_idx}"
+        ds.next_replica_idx += 1
+        opts = dict(ds.resources)
+        opts.setdefault("num_cpus", 0)
+        handle = (
+            rt.remote(Replica)
+            .options(
+                # headroom over max_ongoing_requests so control-plane
+                # methods (health checks, metrics, drain) never starve
+                # behind a full complement of user requests — the data
+                # plane is already capped by the router's per-replica
+                # in-flight accounting
+                max_concurrency=ds.config.max_ongoing_requests + 4,
+                **opts,
+            )
+            .remote(
+                ds.name,
+                rid,
+                ds.callable_def,
+                tuple(ds.init_args),
+                dict(ds.init_kwargs),
+                user_config=ds.config.user_config,
+                max_ongoing_requests=ds.config.max_ongoing_requests,
+            )
+        )
+        ds.replicas[rid] = _ReplicaState(rid, handle, ds.config.max_ongoing_requests)
+
+    def _stop_replica(self, r: _ReplicaState, timeout_s: float):
+        try:
+            ref = r.handle.drain.remote(timeout_s)
+            rt.wait([ref], timeout=timeout_s + 1.0)
+        except Exception:
+            pass
+        self._kill_quietly(r)
+
+    def _kill_quietly(self, r: _ReplicaState):
+        try:
+            rt.kill(r.handle)
+        except Exception:
+            pass
+
+    # -- autoscaling --------------------------------------------------
+    def _autoscale(self):
+        """Reference: `autoscaling_state.py` + `serve/autoscaling_policy.py`
+        — desired = ceil(current * (ongoing/replica) / target_ongoing)."""
+        with self._lock:
+            all_ds = [
+                ds
+                for deployments in self._apps.values()
+                for ds in deployments.values()
+            ]
+        for ds in all_ds:
+            ac = ds.config.autoscaling_config
+            if ac is None:
+                continue
+            with self._lock:
+                running = [
+                    r for r in ds.replicas.values() if r.state == RUNNING
+                ]
+            if not running:
+                continue
+            refs = [r.handle.get_metrics.remote() for r in running]
+            done, _ = rt.wait(refs, num_returns=len(refs), timeout=1.0)
+            if not done:
+                # no metrics observed (busy/unreachable replicas) is not
+                # evidence of zero load — hold the current target
+                continue
+            total_ongoing = 0.0
+            for ref in done:
+                try:
+                    total_ongoing += rt.get(ref)["ongoing"]
+                except Exception:
+                    pass
+            now = time.monotonic()
+            # smooth over look_back_period_s (reference: the autoscaling
+            # policy averages handle metrics over a look-back window) so
+            # a single idle instant between request waves can't trigger
+            # a downscale
+            window = ds.samples = [
+                (ts, v)
+                for ts, v in ds.samples
+                if now - ts < ac.look_back_period_s
+            ] + [(now, total_ongoing)]
+            avg_ongoing = sum(v for _, v in window) / len(window)
+            desired = ac.desired_replicas(avg_ongoing, len(running))
+            with self._lock:
+                delay = (
+                    ac.upscale_delay_s
+                    if desired > ds.target_replicas
+                    else ac.downscale_delay_s
+                )
+                if desired != ds.target_replicas:
+                    if now - ds.last_scale_change >= delay:
+                        ds.target_replicas = desired
+                        ds.last_scale_change = now
+                else:
+                    ds.last_scale_change = now

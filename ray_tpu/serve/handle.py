@@ -1,0 +1,195 @@
+"""DeploymentHandle: Python-level calls into a deployment.
+
+Reference: `python/ray/serve/handle.py` (`DeploymentHandle.remote:710,782`):
+the composition primitive — deployments hold handles to other
+deployments and call them like functions.  `.remote()` returns a
+`DeploymentResponse`: `.result()` blocks (sync callers), `await response`
+resolves on the event loop (async callers), and responses passed as
+arguments to further `.remote()` calls resolve to their values before
+the downstream request executes (the reference converts them to
+ObjectRefs; the runtime's ObjectRef capture does the same here).
+
+Submission is lazy: the replica is chosen when the response is first
+awaited/resolved/passed on, which lets one `.remote()` API serve both
+the blocking and the event-loop path without ever blocking the runtime's
+io loop from inside it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu as rt
+from ray_tpu.serve.router import Router
+
+_routers: Dict[tuple, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _on_runtime_loop() -> bool:
+    """True when running on the runtime's io-loop thread, where blocking
+    runtime calls would deadlock."""
+    from ray_tpu.core.runtime import get_runtime, is_initialized
+
+    if not is_initialized():
+        return False
+    try:
+        loop = get_runtime().loop
+        import asyncio
+
+        return asyncio.get_running_loop() is loop
+    except RuntimeError:
+        return False
+
+
+async def _await_ready(ref):
+    """Await an owned ref's readiness before submission so the runtime's
+    synchronous dependency-resolution fast path applies (submitting a
+    pending ref from the io loop would otherwise fall into the blocking
+    resolver and deadlock the loop)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    st = get_runtime().objects.get(ref.binary())
+    if st is not None:
+        await st.ready.wait()
+
+
+def _router_for(app_name: str, deployment_name: str) -> Router:
+    key = (app_name, deployment_name)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None:
+            r = Router(deployment_name, app_name)
+            _routers[key] = r
+        return r
+
+
+class DeploymentResponse:
+    """Future-like result of a handle call (reference:
+    `serve/handle.py` DeploymentResponse)."""
+
+    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict):
+        self._router = router
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._lock = threading.Lock()
+        self._ref = None
+        # Eager submission off the runtime's io loop (drivers, sync
+        # replicas): requests overlap the way the reference's do.  On
+        # the loop (async replicas, proxy) submission stays lazy and
+        # happens at first await, which is async-safe.
+        if not _on_runtime_loop():
+            self._ensure_submitted()
+
+    # -- submission ---------------------------------------------------
+    def _ensure_submitted(self):
+        with self._lock:
+            if self._ref is None:
+                args = tuple(
+                    a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+                    for a in self._args
+                )
+                kwargs = {
+                    k: (
+                        v._to_object_ref()
+                        if isinstance(v, DeploymentResponse)
+                        else v
+                    )
+                    for k, v in self._kwargs.items()
+                }
+                self._ref = self._router.assign_request(
+                    self._method, args, kwargs
+                )
+        return self._ref
+
+    async def _ensure_submitted_async(self):
+        if self._ref is None:
+            args = []
+            for a in self._args:
+                if isinstance(a, DeploymentResponse):
+                    a = await a._to_object_ref_async()
+                    await _await_ready(a)
+                args.append(a)
+            kwargs = {}
+            for k, v in self._kwargs.items():
+                if isinstance(v, DeploymentResponse):
+                    v = await v._to_object_ref_async()
+                    await _await_ready(v)
+                kwargs[k] = v
+            ref = await self._router.assign_request_async(
+                self._method, tuple(args), kwargs
+            )
+            with self._lock:
+                if self._ref is None:
+                    self._ref = ref
+        return self._ref
+
+    # -- resolution ---------------------------------------------------
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        """Blocking resolution; must not be called from inside an async
+        replica method — `await` the response there instead (same rule
+        as the reference's handle API)."""
+        ref = self._ensure_submitted()
+        return rt.get(ref, timeout=timeout_s)
+
+    def __await__(self):
+        from ray_tpu.core.runtime import get_runtime
+
+        async def _resolve():
+            ref = await self._ensure_submitted_async()
+            return await get_runtime()._get_one(ref)
+
+        return _resolve().__await__()
+
+    def _to_object_ref(self):
+        return self._ensure_submitted()
+
+    async def _to_object_ref_async(self):
+        return await self._ensure_submitted_async()
+
+    def __reduce__(self):
+        # A response captured inside task/actor args travels as its
+        # underlying ObjectRef, so the downstream task awaits the value.
+        return (_identity, (self._to_object_ref(),))
+
+
+def _identity(x):
+    return x
+
+
+class _HandleMethod:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+    def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        router = _router_for(self.app_name, self.deployment_name)
+        return DeploymentResponse(router, method, args, kwargs)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _HandleMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _HandleMethod(self, name)
+
+    def options(self, **_opts) -> "DeploymentHandle":
+        return self
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.app_name}/{self.deployment_name})"

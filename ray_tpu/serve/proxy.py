@@ -1,0 +1,188 @@
+"""HTTP proxy actor: the serve data-plane ingress.
+
+Reference: `python/ray/serve/_private/proxy.py` (`ProxyActor:1140`,
+`HTTPProxy:766`) — one proxy actor serves HTTP, resolves the route
+prefix to an application via the controller's route table, and forwards
+the request to the app's ingress deployment through the same router the
+Python handles use (pow-2 choice, `router.py`).  The reference rides
+uvicorn/Starlette; here a dependency-free asyncio HTTP/1.1 server runs
+directly on the worker's io loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.request import Request, Response
+
+_MAX_BODY = 256 * 1024 * 1024
+
+
+class HTTPProxy:
+    """Async actor; the listen socket lives on the actor's event loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._route_cache: Dict[str, Tuple[float, Optional[Dict]]] = {}
+        self._num_requests = 0
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def num_requests(self) -> int:
+        return self._num_requests
+
+    async def stop(self) -> bool:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        return True
+
+    # -- connection handling ------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                self._num_requests += 1
+                keep_alive = req.headers.get("connection", "keep-alive") != "close"
+                try:
+                    status, ctype, body, extra = await self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — boundary to HTTP
+                    tb = traceback.format_exc()
+                    status, ctype, extra = 500, "text/plain", {}
+                    body = f"Internal Server Error: {e}\n{tb}".encode()
+                await self._write_response(
+                    writer, status, ctype, body, extra, keep_alive
+                )
+                if not keep_alive:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader) -> Optional[Request]:
+        try:
+            line = await reader.readline()
+        except (ConnectionResetError, asyncio.LimitOverrunError):
+            return None
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        parts = line.decode("latin1").strip().split()
+        if len(parts) < 2:
+            return None
+        method, target = parts[0], parts[1]
+        headers: Dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            if b":" in line:
+                k, v = line.decode("latin1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        if length > _MAX_BODY:
+            return None
+        body = await reader.readexactly(length) if length else b""
+        return Request(method, target, headers, body)
+
+    # -- routing + dispatch -------------------------------------------
+    async def _route(self, path: str) -> Optional[Dict]:
+        hit = self._route_cache.get(path)
+        now = time.monotonic()
+        if hit is not None and now - hit[0] < 1.0:
+            return hit[1]
+        if len(self._route_cache) > 1024:  # drop expired entries
+            self._route_cache = {
+                p: (ts, r)
+                for p, (ts, r) in self._route_cache.items()
+                if now - ts < 1.0
+            }
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.serve.api import _get_controller_async
+
+        controller = await _get_controller_async()
+        ref = controller.get_app_for_route.remote(path)
+        route = await get_runtime()._get_one(ref)
+        self._route_cache[path] = (now, route)
+        return route
+
+    async def _dispatch(self, req: Request):
+        if req.path == "/-/healthz":
+            return 200, "text/plain", b"ok", {}
+        if req.path == "/-/routes":
+            from ray_tpu.core.runtime import get_runtime
+            from ray_tpu.serve.api import _get_controller_async
+
+            controller = await _get_controller_async()
+            ref = controller.get_serve_status.remote()
+            status = await get_runtime()._get_one(ref)
+            return 200, "application/json", json.dumps(status).encode(), {}
+        route = await self._route(req.path)
+        if route is None:
+            return 404, "text/plain", b"no application for route", {}
+        handle = DeploymentHandle(route["ingress"], route["app"])
+        value = await handle.remote(req)
+        return self._encode(value)
+
+    def _encode(self, value: Any):
+        if isinstance(value, Response):
+            body = value.content
+            ctype = value.content_type
+            if isinstance(body, (dict, list)):
+                body = json.dumps(body).encode()
+                ctype = ctype or "application/json"
+            elif isinstance(body, str):
+                body = body.encode()
+                ctype = ctype or "text/plain; charset=utf-8"
+            elif not isinstance(body, (bytes, bytearray)):
+                body = json.dumps(body).encode()
+                ctype = ctype or "application/json"
+            return value.status_code, ctype or "application/octet-stream", bytes(
+                body
+            ), value.headers
+        if isinstance(value, (dict, list, int, float, bool)) or value is None:
+            return 200, "application/json", json.dumps(value).encode(), {}
+        if isinstance(value, str):
+            return 200, "text/plain; charset=utf-8", value.encode(), {}
+        if isinstance(value, (bytes, bytearray)):
+            return 200, "application/octet-stream", bytes(value), {}
+        return 200, "text/plain; charset=utf-8", str(value).encode(), {}
+
+    async def _write_response(self, writer, status: int, ctype: str,
+                              body: bytes, extra: Dict[str, str],
+                              keep_alive: bool):
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
+            status, "Status"
+        )
+        head = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}",
+        ]
+        head += [f"{k}: {v}" for k, v in extra.items()]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await writer.drain()

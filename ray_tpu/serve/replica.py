@@ -1,0 +1,129 @@
+"""Replica actor: wraps the user callable and executes requests.
+
+Reference: `python/ray/serve/_private/replica.py` (`ReplicaActor:231`,
+`UserCallableWrapper:756`) — each replica is one actor hosting one
+instance of the user's deployment class (or function), executing
+requests concurrently up to `max_ongoing_requests`, reporting its queue
+length for power-of-two routing and autoscaling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+async def _ensure_coro(awaitable):
+    return await awaitable
+
+
+class Replica:
+    """Created with `max_concurrency > 1` so requests interleave on the
+    actor's event loop, the same execution model as the reference's
+    asyncio replica."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        replica_id: str,
+        callable_def: Any,
+        init_args: tuple,
+        init_kwargs: Dict[str, Any],
+        user_config: Any = None,
+        max_ongoing_requests: int = 16,
+    ):
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        if isinstance(callable_def, type):
+            self._callable = callable_def(*init_args, **init_kwargs)
+        else:
+            self._callable = callable_def
+        self._is_function = not isinstance(callable_def, type)
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config):
+        rc = getattr(self._callable, "reconfigure", None)
+        if rc is None:
+            raise RuntimeError(
+                f"user_config provided but {self._deployment_name} has no "
+                "reconfigure() method"
+            )
+        rc(user_config)
+
+    # -- data plane ---------------------------------------------------
+    async def handle_request(self, method_name: str, *args, **kwargs):
+        """Execute one request (reference: `replica.py:463`
+        `handle_request`).
+
+        Async user code runs on the event loop (and must use async
+        handle composition); sync user code runs on the worker thread
+        pool where blocking `.result()` composition is safe — the same
+        split the reference makes between async and sync callables.
+        """
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name or "__call__")
+            if asyncio.iscoroutinefunction(target):
+                out = await target(*args, **kwargs)
+            else:
+                from ray_tpu.core.runtime import get_runtime
+
+                loop = asyncio.get_running_loop()
+                out = await loop.run_in_executor(
+                    get_runtime()._exec_pool,
+                    functools.partial(target, *args, **kwargs),
+                )
+                if inspect.isawaitable(out):
+                    out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    # -- control plane ------------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self._replica_id,
+            "ongoing": self._ongoing,
+            "total": self._total,
+        }
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def check_health(self) -> bool:
+        """Runs on the worker thread pool (sync method); async user
+        health checks are driven to completion on the actor's loop."""
+        hc = getattr(self._callable, "check_health", None)
+        if hc is not None:
+            out = hc()
+            if inspect.isawaitable(out):
+                from ray_tpu.core.runtime import get_runtime
+
+                out = asyncio.run_coroutine_threadsafe(
+                    _ensure_coro(out), get_runtime().loop
+                ).result(10)
+            return bool(out) if out is not None else True
+        return True
+
+    def reconfigure(self, user_config) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def drain(self, timeout_s: float = 5.0) -> bool:
+        """Wait for in-flight requests before shutdown (reference:
+        graceful_shutdown_timeout_s handling in `replica.py`)."""
+        deadline = time.monotonic() + timeout_s
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return self._ongoing == 0

@@ -1,0 +1,50 @@
+"""HTTP request/response types handed to ingress deployments.
+
+The reference hands Starlette `Request` objects to HTTP deployments
+(`serve/_private/proxy.py`, `http_util.py`); this framework keeps the
+same shape (method/url/headers/query_params/json()/body()) on a
+dependency-free class.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+
+class Request:
+    def __init__(self, method: str, path: str, headers: Dict[str, str],
+                 body: bytes = b""):
+        self.method = method.upper()
+        split = urlsplit(path)
+        self.path = split.path
+        self.query_params: Dict[str, str] = dict(parse_qsl(split.query))
+        self.headers = {k.lower(): v for k, v in headers.items()}
+        self._body = body
+
+    def body(self) -> bytes:
+        return self._body
+
+    def json(self) -> Any:
+        return _json.loads(self._body or b"null")
+
+    @property
+    def text(self) -> str:
+        return self._body.decode("utf-8", errors="replace")
+
+    def __repr__(self):
+        return f"Request({self.method} {self.path})"
+
+
+class Response:
+    """Optional explicit response (status + headers); plain return
+    values are encoded as JSON/text/bytes by the proxy."""
+
+    def __init__(self, content: Any = b"", status_code: int = 200,
+                 content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.content = content
+        self.status_code = status_code
+        self.content_type = content_type
+        self.headers = headers or {}

@@ -1,0 +1,186 @@
+"""Request router: power-of-two-choices replica selection.
+
+Reference: `python/ray/serve/_private/router.py` (`Router:321`) and
+`replica_scheduler/pow_2_scheduler.py` (`PowerOfTwoChoicesReplicaScheduler:51`,
+`choose_replica_for_request:773`): sample two candidate replicas, compare
+queue lengths, send to the shorter queue; respect `max_ongoing_requests`
+by retrying with backoff while all candidates are saturated.  Queue
+lengths are the locally tracked in-flight counts, matching the
+reference's local queue-len cache.
+
+Two complete code paths: the sync one blocks (used from driver threads
+and sync replicas) and the async one awaits on the runtime's io loop
+(used from async replicas and the HTTP proxy) — mirroring the
+reference's asyncio router embedded in handles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from typing import Any, Dict, List
+
+import ray_tpu as rt
+
+
+class _ReplicaInfo:
+    __slots__ = ("replica_id", "handle", "max_ongoing", "local_inflight")
+
+    def __init__(self, replica_id: str, handle, max_ongoing: int):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.max_ongoing = max_ongoing
+        self.local_inflight = 0
+
+
+class Router:
+    """One per process per deployment (handles share it)."""
+
+    REFRESH_PERIOD_S = 0.25
+
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self._deployment = deployment_name
+        self._app = app_name
+        self._replicas: Dict[str, _ReplicaInfo] = {}
+        self._version = -1
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    # -- routing table maintenance ------------------------------------
+    def _install_table(self, table):
+        with self._lock:
+            if table["version"] != self._version:
+                # surviving replicas keep their _ReplicaInfo identity:
+                # completion callbacks hold references to these objects,
+                # and recreating them would orphan in-flight decrements
+                # (leaking capacity until the replica looks saturated)
+                new: Dict[str, _ReplicaInfo] = {}
+                for rid, (handle, max_ongoing) in table["replicas"].items():
+                    info = self._replicas.get(rid)
+                    if info is None:
+                        info = _ReplicaInfo(rid, handle, max_ongoing)
+                    else:
+                        info.handle = handle
+                        info.max_ongoing = max_ongoing
+                    new[rid] = info
+                self._replicas = new
+                self._version = table["version"]
+            self._last_refresh = time.monotonic()
+
+    def _needs_refresh(self, force: bool) -> bool:
+        return (
+            force
+            or not self._replicas
+            or time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S
+        )
+
+    def _refresh(self, force: bool = False):
+        if not self._needs_refresh(force):
+            return
+        from ray_tpu.serve.api import _get_controller
+
+        controller = _get_controller()
+        table = rt.get(
+            controller.get_routing_table.remote(self._app, self._deployment)
+        )
+        self._install_table(table)
+
+    async def _refresh_async(self, force: bool = False):
+        if not self._needs_refresh(force):
+            return
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.serve.api import _get_controller_async
+
+        controller = await _get_controller_async()
+        ref = controller.get_routing_table.remote(self._app, self._deployment)
+        table = await get_runtime()._get_one(ref)
+        self._install_table(table)
+
+    # -- replica choice ----------------------------------------------
+    def _try_pick(self):
+        with self._lock:
+            cands = list(self._replicas.values())
+            if not cands:
+                return None
+            if len(cands) == 1:
+                pick = cands[0]
+            else:
+                a, b = random.sample(cands, 2)
+                pick = a if a.local_inflight <= b.local_inflight else b
+            if pick.local_inflight < pick.max_ongoing:
+                pick.local_inflight += 1
+                return pick
+            return None
+
+    def _submit(self, info: _ReplicaInfo, method_name, args, kwargs):
+        # args flattened to top-level task args so ObjectRefs among them
+        # (composed responses) are materialized by the runtime before
+        # the replica method runs
+        ref = info.handle.handle_request.remote(method_name, *args, **kwargs)
+
+        def _done():
+            with self._lock:
+                info.local_inflight = max(0, info.local_inflight - 1)
+
+        # capacity frees when the replica replies, not when the caller
+        # resolves the response (reference: the router decrements its
+        # queue-len tracker on reply) — watch completion on the io loop
+        import asyncio
+
+        from ray_tpu.core.runtime import get_runtime
+
+        rt_ = get_runtime()
+
+        async def _watch():
+            try:
+                st = rt_.objects.get(ref.binary())
+                if st is not None:
+                    await st.ready.wait()
+            finally:
+                _done()
+
+        asyncio.run_coroutine_threadsafe(_watch(), rt_.loop)
+        return ref
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       timeout_s: float = 30.0):
+        """Pick a replica and submit; returns the reply ObjectRef."""
+        deadline = time.monotonic() + timeout_s
+        backoff = 0.005
+        while True:
+            self._refresh()
+            info = self._try_pick()
+            if info is not None:
+                return self._submit(info, method_name, args, kwargs)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no available replica for {self._deployment} "
+                    f"within {timeout_s}s"
+                )
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.25)
+            self._refresh(force=True)
+
+    async def assign_request_async(self, method_name: str, args: tuple,
+                                   kwargs: dict, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        backoff = 0.005
+        while True:
+            await self._refresh_async()
+            info = self._try_pick()
+            if info is not None:
+                return self._submit(info, method_name, args, kwargs)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no available replica for {self._deployment} "
+                    f"within {timeout_s}s"
+                )
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 0.25)
+            await self._refresh_async(force=True)
+
+    def ongoing_requests(self) -> int:
+        with self._lock:
+            return sum(r.local_inflight for r in self._replicas.values())
