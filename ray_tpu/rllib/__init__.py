@@ -1,0 +1,32 @@
+"""RL library (reference: top-level `rllib/`, new API stack only).
+
+EnvRunner actors sample with pure-numpy policies on CPU; the Learner
+owns a jax parameter pytree and a jitted update — scaled SPMD over a
+device mesh (the TPU path) or via DDP learner actors with
+host-collective gradient allreduce (the CPU-fleet path).  PPO is the
+first algorithm (reference: `rllib/algorithms/ppo/`).
+"""
+
+from ray_tpu.rllib.algorithms import PPO, Algorithm, AlgorithmConfig, PPOConfig
+from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
+from ray_tpu.rllib.env import (
+    CartPoleVectorEnv,
+    EnvRunner,
+    EnvRunnerGroup,
+    VectorEnv,
+)
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleVectorEnv",
+    "EnvRunner",
+    "EnvRunnerGroup",
+    "Learner",
+    "LearnerGroup",
+    "MLPModule",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "VectorEnv",
+]

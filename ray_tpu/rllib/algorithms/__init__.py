@@ -1,0 +1,5 @@
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig"]

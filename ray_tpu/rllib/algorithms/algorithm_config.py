@@ -1,0 +1,100 @@
+"""AlgorithmConfig: fluent config-as-object.
+
+Reference: `rllib/algorithms/algorithm_config.py` — the chained
+`.environment().env_runners().training().learners()` builder surface.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Tuple, Type
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env: Any = "CartPole-v1"
+        self.env_kwargs: Dict[str, Any] = {}
+        self.num_env_runners: int = 2
+        self.num_envs_per_env_runner: int = 8
+        self.rollout_fragment_length: int = 64
+        self.num_learners: int = 0
+        self.lr: float = 3e-4
+        self.grad_clip: Optional[float] = 0.5
+        self.train_batch_size: int = 0  # derived if 0
+        self.minibatch_size: int = 256
+        self.num_epochs: int = 4
+        self.gamma: float = 0.99
+        self.seed: int = 0
+        self.model: Dict[str, Any] = {"hidden": (64, 64)}
+        self.mesh: Any = None  # jax Mesh for SPMD learner sharding
+
+    # -- fluent sections (each returns self, reference-style) ----------
+    def environment(self, env: Any = None, *, env_config: Optional[Dict] = None,
+                    **kwargs) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config:
+            self.env_kwargs.update(env_config)
+        self._apply(kwargs)
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    **kwargs) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        self._apply(kwargs)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        self._apply(kwargs)
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        self._apply(kwargs)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None, **kwargs):
+        if seed is not None:
+            self.seed = seed
+        self._apply(kwargs)
+        return self
+
+    def rl_module(self, *, model_config: Optional[Dict] = None, **kwargs):
+        if model_config:
+            self.model.update(model_config)
+        self._apply(kwargs)
+        return self
+
+    def _apply(self, kwargs: Dict[str, Any]):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise TypeError(f"unknown config key {k!r}")
+            setattr(self, k, v)
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    @property
+    def algo_class(self) -> Type:
+        raise NotImplementedError
+
+    def build(self):
+        """Reference: `AlgorithmConfig.build_algo`."""
+        if self.train_batch_size <= 0:
+            self.train_batch_size = (
+                self.num_env_runners
+                * self.num_envs_per_env_runner
+                * self.rollout_fragment_length
+            )
+        return self.algo_class(self.copy())
+
+    build_algo = build

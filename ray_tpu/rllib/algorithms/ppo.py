@@ -1,0 +1,210 @@
+"""PPO on the new API stack.
+
+Reference: `rllib/algorithms/ppo/ppo.py` (`training_step:402`) +
+`ppo/torch/ppo_torch_learner.py` (clipped surrogate + value clip +
+entropy bonus) — re-expressed as a pure-jax loss compiled once per
+minibatch shape.  GAE (`rllib/evaluation/postprocessing.py` in the old
+stack, connectors in the new) runs as vectorized numpy on the driver:
+it is O(T·B) pointer-chasing, not MXU work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_clip_param: float = 10.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.lr = 3e-4
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+def make_ppo_loss(clip_param: float = 0.2, vf_clip_param: float = 10.0,
+                  vf_loss_coeff: float = 0.5, entropy_coeff: float = 0.01):
+    """Clipped-surrogate PPO loss with hyperparameters bound as
+    jit-time constants (they never change after config build, so they
+    fold into the compiled update instead of riding every batch)."""
+
+    def ppo_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = module.forward_train(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        actions = batch["actions"].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv,
+        )
+        policy_loss = -jnp.mean(surrogate)
+
+        # value loss, clipped to stabilize (reference vf_clip_param)
+        vf_err = jnp.clip(
+            values - batch["value_targets"], -vf_clip_param, vf_clip_param
+        )
+        vf_loss = jnp.mean(vf_err**2)
+
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+        metrics = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": jnp.mean(batch["logp"] - logp),
+        }
+        return total, metrics
+
+    return ppo_loss
+
+
+ppo_loss = make_ppo_loss()  # default-hyperparameter loss (tests, docs)
+
+
+def compute_gae(sample: Dict[str, np.ndarray], gamma: float,
+                lambda_: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized GAE over a time-major rollout [T, B].
+
+    Termination zeroes the bootstrap; truncation bootstraps from
+    V(final_obs) (`bootstrap_values`) and resets the lambda chain —
+    time limits are not failures (reference: the new stack's GAE
+    connector bootstraps truncated episodes the same way).
+    """
+    rewards, values = sample["rewards"], sample["values"]
+    terminated = sample["terminated"].astype(np.float32)
+    truncated = sample["truncated"].astype(np.float32)
+    boot = sample["bootstrap_values"]
+    T, B = rewards.shape
+    adv = np.zeros((T, B), np.float32)
+    next_value = sample["final_value"]
+    gae = np.zeros(B, np.float32)
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - terminated[t]
+        chain = nonterminal * (1.0 - truncated[t])
+        next_v = np.where(truncated[t] > 0, boot[t], next_value)
+        delta = rewards[t] + gamma * next_v * nonterminal - values[t]
+        gae = delta + gamma * lambda_ * chain * gae
+        adv[t] = gae
+        next_value = values[t]
+    targets = adv + values
+    return adv, targets
+
+
+class PPO(Algorithm):
+    def setup_components(self):
+        cfg = self.config
+        self.env_runner_group = EnvRunnerGroup(
+            cfg.env, cfg.num_env_runners, cfg.num_envs_per_env_runner,
+            cfg.rollout_fragment_length, seed=cfg.seed,
+            env_kwargs=cfg.env_kwargs,
+        )
+        spec = self.env_runner_group.env_spec()
+        self.module = MLPModule(
+            spec["observation_size"], spec["num_actions"],
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        if cfg.num_epochs < 1:
+            raise ValueError("num_epochs must be >= 1")
+        loss = make_ppo_loss(
+            cfg.clip_param, cfg.vf_clip_param, cfg.vf_loss_coeff,
+            cfg.entropy_coeff,
+        )
+        self.learner_group = LearnerGroup(
+            self.module, loss, num_learners=cfg.num_learners,
+            lr=cfg.lr, grad_clip=cfg.grad_clip, seed=cfg.seed, mesh=cfg.mesh,
+        )
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        samples = self.env_runner_group.sample(self.module)
+
+        # postprocess: GAE per runner batch, then flatten to [N, ...]
+        obs, actions, logp, adv_l, tgt_l = [], [], [], [], []
+        for s in samples:
+            a, tg = compute_gae(s, cfg.gamma, cfg.lambda_)
+            T, B = s["actions"].shape
+            obs.append(s["obs"].reshape(T * B, -1))
+            actions.append(s["actions"].reshape(-1))
+            logp.append(s["logp"].reshape(-1))
+            adv_l.append(a.reshape(-1))
+            tgt_l.append(tg.reshape(-1))
+        obs = np.concatenate(obs)
+        actions = np.concatenate(actions)
+        logp = np.concatenate(logp)
+        advantages = np.concatenate(adv_l)
+        targets = np.concatenate(tgt_l)
+        advantages = (advantages - advantages.mean()) / (
+            advantages.std() + 1e-8
+        )
+
+        n = obs.shape[0]
+        mb = min(cfg.minibatch_size, n)
+        n_even = (n // mb) * mb  # static minibatch shape → one compile
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics_acc: List[Dict[str, float]] = []
+        for _epoch in range(cfg.num_epochs):
+            perm = rng.permutation(n)[:n_even]
+            for start in range(0, n_even, mb):
+                idx = perm[start:start + mb]
+                batch = {
+                    "obs": obs[idx],
+                    "actions": actions[idx],
+                    "logp": logp[idx],
+                    "advantages": advantages[idx],
+                    "value_targets": targets[idx],
+                }
+                metrics_acc.append(self.learner_group.update_minibatch(batch))
+
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+        result: Dict[str, Any] = {
+            k: float(np.mean([m[k] for m in metrics_acc]))
+            for k in metrics_acc[0]
+        }
+        result["num_env_steps_sampled"] = n
+        self._track_episode_metrics(
+            self.env_runner_group.pop_metrics(), result
+        )
+        return result
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "learner": self.learner_group.get_state(),
+            "recent_returns": list(self._recent_returns),
+            "iteration": self.iteration,
+        }
+
+    def set_state(self, state: Dict[str, Any]):
+        self.learner_group.set_state(state["learner"])
+        self._recent_returns = list(state.get("recent_returns", []))
+        self.iteration = state.get("iteration", self.iteration)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule
+
+__all__ = ["Learner", "LearnerGroup", "MLPModule", "RLModule"]

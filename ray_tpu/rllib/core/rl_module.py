@@ -1,0 +1,95 @@
+"""RLModule: the neural-network abstraction of the new API stack.
+
+Reference: `rllib/core/rl_module/rl_module.py` — one object owning the
+policy/value networks with three forward modes (exploration, inference,
+train).  TPU-native split: parameters are a jax pytree owned by the
+Learner; env runners receive *numpy* copies and run `forward_numpy`
+(rollout inference is tiny MLP math on CPU actors — no jax, no device
+contention with the learner's compiled programs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+class RLModule:
+    """Interface: subclass for custom architectures."""
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def forward_train(self, params, obs):
+        """jax path (inside the learner's jitted loss): returns
+        (logits, value)."""
+        raise NotImplementedError
+
+    def forward_numpy(self, params_np, obs: np.ndarray):
+        """numpy path (env runners): returns (logits, value)."""
+        raise NotImplementedError
+
+
+class MLPModule(RLModule):
+    """Separate policy and value MLP towers (reference default:
+    `rllib/core/rl_module/default_model_config.py` fcnet)."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def _tower_dims(self, out_dim: int) -> List[Tuple[int, int]]:
+        dims = [self.observation_size, *self.hidden, out_dim]
+        return list(zip(dims[:-1], dims[1:]))
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        params: Dict[str, Any] = {}
+        for tower, out_dim in (("pi", self.num_actions), ("vf", 1)):
+            layers = []
+            for i, (m, n) in enumerate(self._tower_dims(out_dim)):
+                rng, k = jax.random.split(rng)
+                scale = float(np.sqrt(2.0 / m)) if i < len(self.hidden) else 0.01
+                layers.append({
+                    "w": jax.random.normal(k, (m, n), jnp.float32) * scale,
+                    "b": jnp.zeros((n,), jnp.float32),
+                })
+            params[tower] = layers
+        return params
+
+    def forward_train(self, params, obs):
+        import jax.numpy as jnp
+
+        def tower(layers, x):
+            for i, lyr in enumerate(layers):
+                x = x @ lyr["w"] + lyr["b"]
+                if i < len(layers) - 1:
+                    x = jnp.tanh(x)
+            return x
+
+        logits = tower(params["pi"], obs)
+        value = tower(params["vf"], obs)[..., 0]
+        return logits, value
+
+    def forward_numpy(self, params_np, obs: np.ndarray):
+        def tower(layers, x):
+            for i, lyr in enumerate(layers):
+                x = x @ lyr["w"] + lyr["b"]
+                if i < len(layers) - 1:
+                    x = np.tanh(x)
+            return x
+
+        logits = tower(params_np["pi"], obs)
+        value = tower(params_np["vf"], obs)[..., 0]
+        return logits, value
+
+
+def params_to_numpy(params) -> Any:
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), params)

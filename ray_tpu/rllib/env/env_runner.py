@@ -1,0 +1,127 @@
+"""EnvRunner: CPU sampling actors.
+
+Reference: `rllib/env/single_agent_env_runner.py:61` (`sample():131`) —
+each runner steps a vectorized env with the current policy and returns
+fixed-shape rollout batches.  TPU-native split: runners are numpy-only
+(see rl_module.py); fixed rollout length T keeps downstream learner
+batch shapes static so the PPO update compiles once.
+
+Batch layout (time-major): obs[T,B,D], actions[T,B], logp[T,B],
+values[T,B], rewards[T,B], dones[T,B], final_obs[B] for bootstrap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.envs import make_vector_env
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    z = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class EnvRunner:
+    """One sampling actor (hosts the vector env + numpy policy copy)."""
+
+    def __init__(self, env: Any, num_envs: int, rollout_length: int,
+                 seed: int = 0, env_kwargs: Optional[Dict] = None):
+        self._env = make_vector_env(env, num_envs, seed=seed,
+                                    **(env_kwargs or {}))
+        self._T = rollout_length
+        self._rng = np.random.default_rng(seed + 1)
+        self._obs = self._env.reset(seed=seed)
+        self._params: Any = None
+        self._weights_version = -1
+        # per-sub-env running episode accounting for metrics
+        self._ep_return = np.zeros(self._env.num_envs, dtype=np.float64)
+        self._ep_len = np.zeros(self._env.num_envs, dtype=np.int64)
+        self._completed: List[Dict[str, float]] = []
+
+    # -- control ------------------------------------------------------
+    def set_weights(self, params_np: Any, version: int) -> bool:
+        self._params = params_np
+        self._weights_version = version
+        return True
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    def env_spec(self) -> Dict[str, int]:
+        return {
+            "observation_size": self._env.observation_size,
+            "num_actions": self._env.num_actions,
+            "num_envs": self._env.num_envs,
+        }
+
+    # -- sampling (HOT LOOP of the RL stack) --------------------------
+    def sample(self, module_def) -> Dict[str, np.ndarray]:
+        assert self._params is not None, "set_weights before sample"
+        T, B = self._T, self._env.num_envs
+        D = self._env.observation_size
+        obs_buf = np.empty((T, B, D), np.float32)
+        act_buf = np.empty((T, B), np.int32)
+        logp_buf = np.empty((T, B), np.float32)
+        val_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), np.bool_)
+        trunc_buf = np.empty((T, B), np.bool_)
+        # V(final_obs) where an episode was truncated this step — the
+        # bootstrap GAE uses instead of zero (truncation is not failure)
+        boot_buf = np.zeros((T, B), np.float32)
+
+        obs = self._obs
+        for t in range(T):
+            logits, value = module_def.forward_numpy(self._params, obs)
+            probs = _softmax(logits)
+            u = self._rng.random((B, 1))
+            actions = (probs.cumsum(axis=-1) > u).argmax(axis=-1).astype(np.int32)
+            logp = np.log(np.take_along_axis(
+                probs, actions[:, None], axis=-1
+            )[:, 0] + 1e-10)
+            next_obs, rewards, terminated, truncated, info = self._env.step(actions)
+            done = terminated | truncated
+            obs_buf[t], act_buf[t] = obs, actions
+            logp_buf[t], val_buf[t] = logp, value
+            rew_buf[t] = rewards
+            term_buf[t], trunc_buf[t] = terminated, truncated
+            if truncated.any():
+                final = info["final_observation"][truncated]
+                _, fv = module_def.forward_numpy(self._params, final)
+                boot_buf[t, truncated] = fv
+            # episode metrics
+            self._ep_return += rewards
+            self._ep_len += 1
+            if done.any():
+                for i in np.flatnonzero(done):
+                    self._completed.append({
+                        "episode_return": float(self._ep_return[i]),
+                        "episode_len": float(self._ep_len[i]),
+                    })
+                self._ep_return[done] = 0.0
+                self._ep_len[done] = 0
+            obs = next_obs
+        self._obs = obs
+        _, final_value = module_def.forward_numpy(self._params, obs)
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "terminated": term_buf,
+            "truncated": trunc_buf,
+            "bootstrap_values": boot_buf,
+            "final_value": final_value.astype(np.float32),
+        }
+
+    def pop_metrics(self) -> List[Dict[str, float]]:
+        out, self._completed = self._completed, []
+        return out
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,88 @@
+"""EnvRunnerGroup: fleet of sampling actors with fault tolerance.
+
+Reference: `rllib/env/env_runner_group.py:71` — owns N remote EnvRunner
+actors, broadcasts weights, gathers samples, and restores failed runners
+(reference: `algorithm.py:235` restore_workers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as rt
+from ray_tpu.rllib.env.env_runner import EnvRunner
+
+
+class EnvRunnerGroup:
+    def __init__(self, env: Any, num_runners: int, num_envs_per_runner: int,
+                 rollout_length: int, seed: int = 0,
+                 env_kwargs: Optional[Dict] = None):
+        self._env = env
+        self._num_runners = num_runners
+        self._num_envs = num_envs_per_runner
+        self._T = rollout_length
+        self._seed = seed
+        self._env_kwargs = env_kwargs or {}
+        self._runners: List = []
+        self._weights: Any = None
+        self._weights_version = 0
+        for i in range(num_runners):
+            self._runners.append(self._make_runner(i))
+
+    def _make_runner(self, idx: int):
+        return rt.remote(EnvRunner).options(num_cpus=1).remote(
+            self._env, self._num_envs, self._T,
+            seed=self._seed + idx * 10_000, env_kwargs=self._env_kwargs,
+        )
+
+    def env_spec(self) -> Dict[str, int]:
+        return rt.get(self._runners[0].env_spec.remote())
+
+    def sync_weights(self, params_np: Any):
+        self._weights = params_np
+        self._weights_version += 1
+        refs = [
+            r.set_weights.remote(params_np, self._weights_version)
+            for r in self._runners
+        ]
+        rt.wait(refs, num_returns=len(refs), timeout=30)
+
+    def sample(self, module_def) -> List[Dict[str, np.ndarray]]:
+        """One rollout from every healthy runner; failed runners are
+        replaced and their sample skipped this round (reference:
+        EnvRunnerGroup fault tolerance)."""
+        refs = [r.sample.remote(module_def) for r in self._runners]
+        out: List[Dict[str, np.ndarray]] = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(rt.get(ref, timeout=120))
+            except Exception:
+                self._runners[i] = self._make_runner(i)
+                rt.get(self._runners[i].set_weights.remote(
+                    self._weights, self._weights_version))
+        if not out:
+            raise RuntimeError("all env runners failed")
+        return out
+
+    def pop_metrics(self) -> List[Dict[str, float]]:
+        metrics: List[Dict[str, float]] = []
+        refs = [r.pop_metrics.remote() for r in self._runners]
+        for ref in refs:
+            try:
+                metrics.extend(rt.get(ref, timeout=30))
+            except Exception:
+                pass
+        return metrics
+
+    @property
+    def num_runners(self) -> int:
+        return self._num_runners
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                rt.kill(r)
+            except Exception:
+                pass

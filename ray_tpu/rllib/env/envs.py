@@ -1,0 +1,162 @@
+"""Vectorized environments for env runners.
+
+Reference: the new API stack samples with gymnasium *vector* envs inside
+`SingleAgentEnvRunner` (`rllib/env/single_agent_env_runner.py:61`).
+Env runners here are pure-numpy CPU actors — rollout workers never touch
+jax or the TPU; all compiled numeric work lives in the Learner.  A
+built-in vectorized CartPole (classic Barto-Sutton-Anderson dynamics,
+matching gymnasium's CartPole-v1 constants) keeps the stack
+self-contained; any gymnasium env id works through `GymnasiumVectorEnv`
+when the package is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    """Batch-of-envs interface with same-step auto-reset:
+    reset() -> obs[B, ...];
+    step(actions[B]) -> (obs, rewards, terminated, truncated, info).
+    For sub-envs that finished this step, `obs` is the RESET observation
+    and info["final_observation"][i] carries the true last observation —
+    the value-bootstrap source for truncated episodes."""
+
+    num_envs: int
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """Vectorized CartPole-v1 with auto-reset on termination."""
+
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_size = 4
+        self.num_actions = 2
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), dtype=np.float64)
+        self._steps = np.zeros(num_envs, dtype=np.int64)
+        # physics constants (gymnasium cartpole.py)
+        self._gravity = 9.8
+        self._masscart = 1.0
+        self._masspole = 0.1
+        self._length = 0.5
+        self._force_mag = 10.0
+        self._tau = 0.02
+        self._theta_limit = 12 * 2 * np.pi / 360
+        self._x_limit = 2.4
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self._rng.uniform(-0.05, 0.05, size=(n, 4))
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._sample_state(self.num_envs)
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self._force_mag, -self._force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self._masscart + self._masspole
+        polemass_length = self._masspole * self._length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self._gravity * sintheta - costheta * temp) / (
+            self._length * (4.0 / 3.0 - self._masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self._tau * x_dot
+        x_dot = x_dot + self._tau * xacc
+        theta = theta + self._tau * theta_dot
+        theta_dot = theta_dot + self._tau * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+
+        terminated = (
+            (np.abs(x) > self._x_limit) | (np.abs(theta) > self._theta_limit)
+        )
+        truncated = (self._steps >= self.MAX_STEPS) & ~terminated
+        rewards = np.ones(self.num_envs, dtype=np.float32)
+        done = terminated | truncated
+        info: Dict[str, Any] = {}
+        if done.any():  # same-step auto-reset of finished sub-envs
+            info["final_observation"] = self._state.astype(np.float32)
+            self._state[done] = self._sample_state(int(done.sum()))
+            self._steps[done] = 0
+        return (
+            self._state.astype(np.float32),
+            rewards,
+            terminated,
+            truncated,
+            info,
+        )
+
+
+class GymnasiumVectorEnv(VectorEnv):
+    """Vectorization over N single gymnasium envs, owned here rather
+    than via `gym.make_vec`: gymnasium's vector autoreset modes changed
+    semantics across versions (next-step autoreset inserts a no-op
+    transition after terminals), while rollout batches need same-step
+    autoreset with the true final observation exposed."""
+
+    def __init__(self, env_id: str, num_envs: int = 8, seed: int = 0, **kwargs):
+        import gymnasium as gym
+
+        self._envs = [gym.make(env_id, **kwargs) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        space = self._envs[0].observation_space
+        self.observation_size = int(np.prod(space.shape))
+        self.num_actions = int(self._envs[0].action_space.n)
+        self._seed = seed
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        base = seed if seed is not None else self._seed
+        obs = [e.reset(seed=base + i)[0] for i, e in enumerate(self._envs)]
+        return np.stack(obs).reshape(self.num_envs, -1).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        B = self.num_envs
+        obs = np.empty((B, self.observation_size), np.float32)
+        rewards = np.empty(B, np.float32)
+        terminated = np.zeros(B, np.bool_)
+        truncated = np.zeros(B, np.bool_)
+        final_obs = None
+        for i, e in enumerate(self._envs):
+            o, r, term, trunc, _ = e.step(int(actions[i]))
+            rewards[i], terminated[i], truncated[i] = r, term, trunc
+            if term or trunc:
+                if final_obs is None:
+                    final_obs = np.zeros((B, self.observation_size), np.float32)
+                final_obs[i] = np.asarray(o, np.float32).reshape(-1)
+                o = e.reset()[0]  # same-step autoreset
+            obs[i] = np.asarray(o, np.float32).reshape(-1)
+        info: Dict[str, Any] = {}
+        if final_obs is not None:
+            info["final_observation"] = final_obs
+        return obs, rewards, terminated, truncated, info
+
+
+_BUILTIN = {"CartPole-v1": CartPoleVectorEnv}
+
+
+def make_vector_env(env: Any, num_envs: int, seed: int = 0, **kwargs) -> VectorEnv:
+    """env may be a builtin id, a gymnasium id, or a VectorEnv factory."""
+    if callable(env):
+        return env(num_envs=num_envs, seed=seed, **kwargs)
+    if env in _BUILTIN:
+        return _BUILTIN[env](num_envs=num_envs, seed=seed)
+    return GymnasiumVectorEnv(env, num_envs=num_envs, seed=seed, **kwargs)

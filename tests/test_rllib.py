@@ -1,0 +1,213 @@
+"""RLlib tests.
+
+Coverage modeled on the reference's `rllib/` test strategy: env
+correctness, learner update math, PPO end-to-end learning on CartPole
+(reference: `rllib/algorithms/ppo/tests/test_ppo.py` trains CartPole),
+checkpoint save/restore, multi-learner parity.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.rllib import CartPoleVectorEnv, MLPModule, PPOConfig
+from ray_tpu.rllib.algorithms.ppo import compute_gae, ppo_loss
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import params_to_numpy
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=16, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_cartpole_vector_env():
+    env = CartPoleVectorEnv(num_envs=4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 4) and obs.dtype == np.float32
+    total_done = 0
+    for _ in range(600):
+        obs, rew, term, trunc, info = env.step(np.ones(4, dtype=np.int64))
+        assert rew.shape == (4,) and (rew == 1.0).all()
+        done = term | trunc
+        if done.any():
+            assert "final_observation" in info
+        total_done += int(done.sum())
+        assert np.isfinite(obs).all()
+    # always pushing right must topple the pole repeatedly (auto-reset)
+    assert total_done > 4
+
+
+def test_module_numpy_and_jax_forward_agree():
+    import jax
+
+    mod = MLPModule(4, 2, hidden=(16,))
+    params = mod.init_params(jax.random.PRNGKey(0))
+    obs = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    jl, jv = mod.forward_train(params, obs)
+    nl, nv = mod.forward_numpy(params_to_numpy(params), obs)
+    np.testing.assert_allclose(np.asarray(jl), nl, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jv), nv, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_matches_reference_recursion():
+    rng = np.random.default_rng(0)
+    terminated = rng.random((5, 3)) < 0.2
+    truncated = (rng.random((5, 3)) < 0.15) & ~terminated
+    sample = {
+        "rewards": rng.normal(size=(5, 3)).astype(np.float32),
+        "values": rng.normal(size=(5, 3)).astype(np.float32),
+        "terminated": terminated,
+        "truncated": truncated,
+        "bootstrap_values": rng.normal(size=(5, 3)).astype(np.float32),
+        "final_value": rng.normal(size=(3,)).astype(np.float32),
+    }
+    adv, tgt = compute_gae(sample, gamma=0.9, lambda_=0.8)
+    # brute-force single-env recursion
+    for b in range(3):
+        gae = 0.0
+        nv = sample["final_value"][b]
+        for t in range(4, -1, -1):
+            nonterm = 0.0 if terminated[t, b] else 1.0
+            chain = nonterm * (0.0 if truncated[t, b] else 1.0)
+            nv_eff = sample["bootstrap_values"][t, b] if truncated[t, b] else nv
+            delta = (
+                sample["rewards"][t, b] + 0.9 * nv_eff * nonterm
+                - sample["values"][t, b]
+            )
+            gae = delta + 0.9 * 0.8 * chain * gae
+            assert np.isclose(adv[t, b], gae, rtol=1e-5, atol=1e-5)
+            nv = sample["values"][t, b]
+    np.testing.assert_allclose(tgt, adv + sample["values"], rtol=1e-5)
+
+
+def test_gymnasium_vector_env_adapter():
+    from ray_tpu.rllib.env.envs import GymnasiumVectorEnv
+
+    env = GymnasiumVectorEnv("CartPole-v1", num_envs=2, seed=0)
+    obs = env.reset()
+    assert obs.shape == (2, 4)
+    saw_final = False
+    for _ in range(400):
+        obs, rew, term, trunc, info = env.step(np.ones(2, dtype=np.int64))
+        if (term | trunc).any():
+            saw_final = "final_observation" in info
+            break
+    assert saw_final
+
+
+def _synthetic_batch(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.normal(size=(n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, n).astype(np.int32),
+        "logp": np.log(np.full(n, 0.5, np.float32)),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "value_targets": rng.normal(size=n).astype(np.float32),
+        "clip_param": np.full(n, 0.2, np.float32),
+        "vf_clip_param": np.full(n, 10.0, np.float32),
+        "vf_loss_coeff": np.full(n, 0.5, np.float32),
+        "entropy_coeff": np.full(n, 0.0, np.float32),
+    }
+
+
+def test_learner_update_reduces_loss():
+    mod = MLPModule(4, 2, hidden=(32,))
+    lrn = Learner(mod, ppo_loss, lr=1e-2, seed=0)
+    batch = _synthetic_batch()
+    first = lrn.update_minibatch(batch)["total_loss"]
+    for _ in range(30):
+        last = lrn.update_minibatch(batch)["total_loss"]
+    assert last < first
+
+
+def test_ppo_learns_cartpole(cluster):
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=4)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        results = [algo.train() for _ in range(20)]
+        early = results[0]["episode_return_mean"]
+        late = results[-1]["episode_return_mean"]
+        assert np.isfinite(results[-1]["total_loss"])
+        assert results[-1]["num_env_steps_sampled"] == 2 * 8 * 64
+        # CartPole from-scratch: ~19 at init, >60 after 20 iterations
+        assert late > max(40.0, early + 15.0), (early, late)
+    finally:
+        algo.stop()
+
+
+def test_ppo_checkpoint_roundtrip(cluster, tmp_path):
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .training(minibatch_size=128, num_epochs=1)
+    )
+    algo = cfg.build()
+    try:
+        algo.train()
+        d = str(tmp_path / "ckpt")
+        import os
+
+        os.makedirs(d, exist_ok=True)
+        algo.save_checkpoint(d)
+        w_before = algo.learner_group.get_weights_numpy()
+
+        algo2 = cfg.copy().build()
+        try:
+            algo2.load_checkpoint(d)
+            w_after = algo2.learner_group.get_weights_numpy()
+            np.testing.assert_allclose(
+                w_before["pi"][0]["w"], w_after["pi"][0]["w"], rtol=1e-6
+            )
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_mesh_sharded_learner_matches_local():
+    """SPMD learner: minibatch sharded over a 'data' mesh axis must
+    produce the same update as the unsharded learner (XLA inserts the
+    gradient psum)."""
+    import jax
+    from jax.sharding import Mesh
+
+    mod = MLPModule(4, 2, hidden=(16,))
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(8), ("data",))
+    local = Learner(mod, ppo_loss, lr=1e-2, seed=0)
+    sharded = Learner(mod, ppo_loss, lr=1e-2, seed=0, mesh=mesh)
+    batch = _synthetic_batch(n=128)
+    m1 = local.update_minibatch(batch)
+    m2 = sharded.update_minibatch(batch)
+    assert np.isclose(m1["total_loss"], m2["total_loss"], rtol=1e-4)
+    w1 = local.get_weights_numpy()["pi"][0]["w"]
+    w2 = sharded.get_weights_numpy()["pi"][0]["w"]
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_learner_ddp_runs(cluster):
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .learners(num_learners=2)
+        .training(minibatch_size=64, num_epochs=1)
+        .build()
+    )
+    try:
+        r = algo.train()
+        assert np.isfinite(r["total_loss"])
+    finally:
+        algo.stop()
