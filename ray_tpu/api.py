@@ -227,6 +227,13 @@ class ActorMethod:
         )
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a compiled-graph node instead of executing (reference:
+        `dag/dag_node.py:29` DAGNode.bind)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def options(self, num_returns: int = 1, **_opts):
         return ActorMethod(self._handle, self._name, num_returns)
 

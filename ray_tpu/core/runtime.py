@@ -1180,7 +1180,20 @@ class Runtime:
             self._task_local.task_id = spec.task_id
 
             if spec.actor_id is not None:
-                method = getattr(self.actor_instance, spec.kwargs["__rt_method__"])
+                mname = spec.kwargs["__rt_method__"]
+                if mname == "__rt_dag_exec_loop__":
+                    # framework-reserved: resident exec loop of a
+                    # compiled DAG (dag/execution.py) hosted by this
+                    # actor — not a method of the user class
+                    import functools
+
+                    from ray_tpu.dag.execution import dag_exec_loop
+
+                    method = functools.partial(
+                        dag_exec_loop, self.actor_instance
+                    )
+                else:
+                    method = getattr(self.actor_instance, mname)
                 if asyncio.iscoroutinefunction(method):
                     value = await method(*args, **kwargs)
                 else:

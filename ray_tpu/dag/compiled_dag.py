@@ -1,0 +1,271 @@
+"""DAG compilation and driver-side execution.
+
+Reference: `python/ray/dag/compiled_dag_node.py` (CompiledDAG) and
+`python/ray/experimental/compiled_dag_ref.py` (CompiledDAGRef).
+
+Compilation walks the bound graph, groups nodes by actor, allocates a
+channel per cross-actor edge, and launches one resident exec loop per
+actor (execution.py).  execute() writes the input channels and returns a
+CompiledDAGRef that reads the output channels — per-execution cost is
+channel ops only.  Ring-buffered channels bound pipelined in-flight
+executions the way the reference's buffered channels do.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag import execution as ex
+from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_exec_counter = itertools.count()
+
+
+class CompiledDAGRef:
+    """Future for one execute() call (reference:
+    `experimental/compiled_dag_ref.py`); get() may be called once per
+    execution, in order."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._done = False
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def get(self, timeout: Optional[float] = 30.0):
+        if not self._done:
+            self._dag._collect_until(self._idx, timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_inflight: int = 4):
+        self._id = uuid.uuid4().hex[:8]
+        self._max_inflight = max_inflight
+        self._torn_down = False
+        self._next_exec = 0
+        self._next_collect = 0
+        self._pending: Dict[int, CompiledDAGRef] = {}
+        self._partial: List[Any] = []  # outputs read so far for the
+        # execution currently being collected (resume after timeout)
+
+        if isinstance(root, MultiOutputNode):
+            self._outputs: List[DAGNode] = root.outputs
+            self._multi = True
+        else:
+            self._outputs = [root]
+            self._multi = False
+        for o in self._outputs:
+            if not isinstance(o, ClassMethodNode):
+                raise TypeError("DAG leaves must be actor method nodes")
+
+        self._compile()
+
+    # -- compilation ---------------------------------------------------
+    def _chan_name(self, producer: int, consumer: str) -> str:
+        return f"dag{self._id}_e{producer}_{consumer}"
+
+    def _compile(self):
+        import ray_tpu as rt
+
+        # topological order over the method nodes
+        order: List[ClassMethodNode] = []
+        seen = set()
+
+        def visit(n: DAGNode):
+            if n._id in seen:
+                return
+            seen.add(n._id)
+            for u in n._upstream():
+                visit(u)
+            if isinstance(n, ClassMethodNode):
+                order.append(n)
+
+        for o in self._outputs:
+            visit(o)
+        self._order = order
+
+        by_actor: Dict[bytes, List[ClassMethodNode]] = {}
+        actor_handles: Dict[bytes, Any] = {}
+        node_actor: Dict[int, bytes] = {}
+        for n in order:
+            aid = n.actor._actor_id.binary()
+            by_actor.setdefault(aid, []).append(n)
+            actor_handles[aid] = n.actor
+            node_actor[n._id] = aid
+
+        # channels are node-local shm rings: every participant (and the
+        # driver) must live on one node — fail at compile time rather
+        # than hang at the first cross-node read
+        from ray_tpu.core.runtime import get_runtime
+
+        driver_node = get_runtime().node_id
+        for aid, h in actor_handles.items():
+            addr = h._address
+            if addr is not None and addr[0] != driver_node:
+                raise NotImplementedError(
+                    "compiled DAGs currently require all actors on the "
+                    f"driver's node (actor {aid.hex()[:12]} is on node "
+                    f"{addr[0][:12]}); cross-node stages should use "
+                    "ordinary actor calls"
+                )
+
+        # consumers per produced node, to know which edges cross actors
+        plans: Dict[bytes, Dict] = {
+            aid: {"input_channel": None, "steps": []} for aid in by_actor
+        }
+        self._input_channels: List[Channel] = []
+
+        def arg_source(consumer: ClassMethodNode, arg) -> Tuple[str, Any]:
+            if isinstance(arg, InputNode):
+                aid = node_actor[consumer._id]
+                if plans[aid]["input_channel"] is None:
+                    # full actor id: ids embed a shared job prefix, so a
+                    # short prefix collides across actors
+                    name = f"dag{self._id}_in_{aid.hex()}"
+                    plans[aid]["input_channel"] = name
+                    self._input_channels.append(Channel(name))
+                return (ex.SRC_INPUT, None)
+            if isinstance(arg, ClassMethodNode):
+                if node_actor[arg._id] == node_actor[consumer._id]:
+                    return (ex.SRC_LOCAL, arg._id)
+                name = self._chan_name(arg._id, f"n{consumer._id}")
+                # register the edge on the producer's step
+                producer_step[arg._id]["out_channels"].append(name)
+                return (ex.SRC_CHAN, name)
+            if isinstance(arg, DAGNode):
+                raise TypeError(f"unsupported node type {type(arg)}")
+            return (ex.SRC_CONST, arg)
+
+        producer_step: Dict[int, Dict] = {}
+        for n in order:
+            step = {
+                "node_id": n._id,
+                "method": n.method_name,
+                "args": [],
+                "kwargs": {},
+                "out_channels": [],
+            }
+            producer_step[n._id] = step
+            plans[node_actor[n._id]]["steps"].append(step)
+        for n in order:
+            step = producer_step[n._id]
+            step["args"] = [arg_source(n, a) for a in n.args]
+            step["kwargs"] = {k: arg_source(n, v) for k, v in n.kwargs.items()}
+
+        # output channels: leaves -> driver
+        self._output_channels: List[Channel] = []
+        for i, o in enumerate(self._outputs):
+            name = self._chan_name(o._id, f"out{i}")
+            producer_step[o._id]["out_channels"].append(name)
+            self._output_channels.append(Channel(name))
+
+        # launch one resident loop per actor (framework-reserved method;
+        # the runtime routes it to execution.dag_exec_loop)
+        for aid, plan in plans.items():
+            if plan["input_channel"] is None and not any(
+                src == ex.SRC_CHAN
+                for step in plan["steps"]
+                for src, _ in [*step["args"], *step["kwargs"].values()]
+            ):
+                raise ValueError(
+                    "every actor in a compiled DAG must be driven by the "
+                    "InputNode or an upstream channel (unbounded source "
+                    "loops are not allowed)"
+                )
+
+        from ray_tpu.api import ActorMethod
+
+        self._loop_refs = []
+        self._actors = list(actor_handles.values())
+        for aid, plan in plans.items():
+            h = actor_handles[aid]
+            self._loop_refs.append(
+                ActorMethod(h, "__rt_dag_exec_loop__").remote(plan)
+            )
+
+    # -- execution -----------------------------------------------------
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if len(self._pending) >= self._max_inflight:
+            self._collect_until(self._next_collect, timeout=120.0)
+        if self._input_channels:
+            if len(args) != 1:
+                raise TypeError(
+                    "execute() takes exactly one input (the InputNode value)"
+                )
+            for ch in self._input_channels:
+                ch.write(args[0])
+        elif args:
+            raise TypeError("this DAG has no InputNode; execute() takes no args")
+        idx = self._next_exec
+        self._next_exec += 1
+        ref = CompiledDAGRef(self, idx)
+        self._pending[idx] = ref
+        return ref
+
+    def _collect_until(self, idx: int, timeout: Optional[float]):
+        """Reads results in execution order up to and including idx.
+
+        A read timeout leaves collection state untouched (the channel
+        read_seq only advances on success, and `_partial` resumes where
+        it left off), so a slow execution can be re-polled without
+        shifting later results by one.
+        """
+        while self._next_collect <= idx:
+            ref = self._pending.get(self._next_collect)
+            error = None
+            while len(self._partial) < len(self._output_channels):
+                ch = self._output_channels[len(self._partial)]
+                try:
+                    self._partial.append(ch.read(timeout_s=timeout))
+                except ChannelClosed:
+                    self._partial.append(None)
+                    error = RuntimeError("DAG torn down mid-execution")
+                except TimeoutError:
+                    raise  # caller may retry; nothing was consumed
+                except BaseException as e:  # noqa: BLE001 — stored below
+                    self._partial.append(None)
+                    error = e
+            values, self._partial = self._partial, []
+            self._pending.pop(self._next_collect, None)
+            self._next_collect += 1
+            if ref is not None:
+                ref._done = True
+                ref._error = error
+                ref._value = (
+                    values if self._multi else (values[0] if values else None)
+                )
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import ray_tpu as rt
+
+        for ch in self._input_channels:
+            ch.close()
+        # loops forward the sentinel; wait for them to exit
+        try:
+            rt.wait(self._loop_refs, num_returns=len(self._loop_refs),
+                    timeout=10)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

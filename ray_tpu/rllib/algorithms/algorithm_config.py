@@ -89,12 +89,21 @@ class AlgorithmConfig:
 
     def build(self):
         """Reference: `AlgorithmConfig.build_algo`."""
-        if self.train_batch_size <= 0:
-            self.train_batch_size = (
-                self.num_env_runners
-                * self.num_envs_per_env_runner
-                * self.rollout_fragment_length
-            )
+        per_step = self.num_env_runners * self.num_envs_per_env_runner
+        if self.train_batch_size > 0:
+            # user-specified total rollout per iteration: derive the
+            # fragment length from it (the quantity sampling actually
+            # consumes), so the setting has effect instead of being
+            # silently ignored
+            if self.train_batch_size % per_step:
+                raise ValueError(
+                    f"train_batch_size={self.train_batch_size} must be a "
+                    f"multiple of num_env_runners*num_envs_per_env_runner "
+                    f"({per_step})"
+                )
+            self.rollout_fragment_length = self.train_batch_size // per_step
+        else:
+            self.train_batch_size = per_step * self.rollout_fragment_length
         return self.algo_class(self.copy())
 
     build_algo = build

@@ -1,14 +1,18 @@
 """ray_tpu.util — public utilities (reference: `ray.util`)."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     placement_group,
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.queue import Queue
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
+    "Queue",
     "placement_group",
     "placement_group_table",
     "remove_placement_group",

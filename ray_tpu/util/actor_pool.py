@@ -1,0 +1,99 @@
+"""ActorPool: multiplex work over a fixed set of actors.
+
+Reference: `python/ray/util/actor_pool.py` — same surface
+(map/map_unordered/submit/get_next/get_next_unordered/has_next).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, TypeVar
+
+import ray_tpu as rt
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef (reference: ActorPool.submit)."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order.  On timeout the future stays
+        queued and the actor stays busy, so a retry sees the same task
+        (reference: `actor_pool.py` keeps state on TimeoutError)."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = rt.wait([ref], num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out")
+        idx, actor = self._future_to_actor.pop(ref)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        try:
+            return rt.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in completion order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = rt.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        idx, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(idx, None)
+        try:
+            return rt.get(ref)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn: Callable, values: Iterable[V]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[V]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._return_actor(actor)

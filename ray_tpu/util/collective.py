@@ -1,0 +1,30 @@
+"""ray_tpu.util.collective — the reference's `ray.util.collective`
+surface (`util/collective/collective.py:120,151,258-615`), re-exported
+from the parallel layer where the implementation lives (SURVEY §5.8:
+in-program `jax.lax` collectives are the TPU fast path; the host tier
+rides the framework's own object plane instead of NCCL/Gloo).
+"""
+
+from ray_tpu.parallel.collectives import (
+    CollectiveGroup,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_group,
+    init_collective_group,
+    reducescatter,
+)
+
+__all__ = [
+    "CollectiveGroup",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "destroy_collective_group",
+    "get_group",
+    "init_collective_group",
+    "reducescatter",
+]

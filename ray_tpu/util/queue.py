@@ -1,0 +1,147 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference: `python/ray/util/queue.py` — same surface (put/get with
+block/timeout, put_nowait/get_nowait, qsize/empty/full), implemented on
+an async actor so blocked getters don't pin worker threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu as rt
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            await self._q.put(item)
+            return True
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return (True, await self._q.get())
+        try:
+            return (True, await asyncio.wait_for(self._q.get(), timeout))
+        except asyncio.TimeoutError:
+            return (False, None)
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def get_nowait(self):
+        try:
+            return (True, self._q.get_nowait())
+        except asyncio.QueueEmpty:
+            return (False, None)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+    def put_batch_nowait(self, items: List[Any]) -> bool:
+        """All-or-nothing insert (capacity checked before any put)."""
+        maxsize = self._q.maxsize
+        if maxsize > 0 and self._q.qsize() + len(items) > maxsize:
+            return False
+        for it in items:
+            self._q.put_nowait(it)
+        return True
+
+    def get_batch_nowait(self, n: int):
+        """All-or-nothing removal of n items."""
+        if self._q.qsize() < n:
+            return (False, None)
+        return (True, [self._q.get_nowait() for _ in range(n)])
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency", 64)
+        self.actor = rt.remote(_QueueActor).options(**opts).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            if not rt.get(self.actor.put_nowait.remote(item)):
+                raise Full("queue is full")
+            return
+        ok = rt.get(self.actor.put.remote(item, timeout))
+        if not ok:
+            raise Full(f"put timed out after {timeout}s")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = rt.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue is empty")
+            return item
+        ok, item = rt.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty(f"get timed out after {timeout}s")
+        return item
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]):
+        """Atomic: raises Full without inserting anything if the batch
+        does not fit (reference: `util/queue.py` put_nowait_batch)."""
+        if not rt.get(self.actor.put_batch_nowait.remote(list(items))):
+            raise Full(f"batch of {len(items)} does not fit")
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        """Atomic: raises Empty without consuming anything if fewer than
+        num_items are queued."""
+        ok, items = rt.get(self.actor.get_batch_nowait.remote(num_items))
+        if not ok:
+            raise Empty(f"fewer than {num_items} items queued")
+        return items
+
+    def qsize(self) -> int:
+        return rt.get(self.actor.qsize.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return rt.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return rt.get(self.actor.full.remote())
+
+    def shutdown(self):
+        try:
+            rt.kill(self.actor)
+        except Exception:
+            pass

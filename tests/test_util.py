@@ -1,0 +1,97 @@
+"""Utility tests: ActorPool, Queue, collective re-export.
+
+Reference: `python/ray/tests/test_actor_pool.py`, `test_queue.py`.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util.queue import Empty, Full
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=3, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+@rt.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        time.sleep(0.05 * (x % 3))
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, v: a.slow_double.remote(v), range(9)))
+    assert sorted(out) == [2 * i for i in range(9)]
+
+
+def test_actor_pool_submit_get_next(cluster):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)  # queued: 1 actor
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_queue_fifo_and_nowait(cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_blocking_get_across_threads(cluster):
+    q = Queue()
+    got = []
+
+    def consumer():
+        got.append(q.get(timeout=10))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.2)
+    q.put("hello")
+    t.join(timeout=10)
+    assert got == ["hello"]
+    q.shutdown()
+
+
+def test_queue_get_timeout(cluster):
+    q = Queue()
+    t0 = time.time()
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+    assert time.time() - t0 >= 0.25
+    q.shutdown()
+
+
+def test_collective_reexport():
+    import ray_tpu.util.collective as col
+
+    assert callable(col.init_collective_group)
+    assert callable(col.allreduce)
