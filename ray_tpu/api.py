@@ -12,15 +12,13 @@ import atexit
 import json
 import os
 import subprocess
-import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu import exceptions as exc
-from ray_tpu.core import runtime as _rtmod
-from ray_tpu.core.config import Config, get_config, set_config
-from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.config import Config, set_config
+from ray_tpu.core.ids import ActorID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import Runtime, get_runtime, is_initialized, set_runtime
 
@@ -64,35 +62,18 @@ def init(
         set_config(cfg)
 
         if address is None:
+            from ray_tpu.core.node_launcher import launch_noded
+
             session_dir = _make_session_dir()
-            ready_file = os.path.join(session_dir, "ready.json")
-            cmd = [
-                sys.executable,
-                "-m",
-                "ray_tpu.core.noded",
-                "--session-dir",
+            proc, info = launch_noded(
                 session_dir,
-                "--head",
-                "--ready-file",
-                ready_file,
-            ]
-            if num_cpus is not None:
-                cmd += ["--num-cpus", str(num_cpus)]
-            if num_tpus is not None:
-                cmd += ["--num-tpus", str(num_tpus)]
-            if resources:
-                cmd += ["--resources", json.dumps(resources)]
-            if num_workers:
-                cmd += ["--num-workers", str(num_workers)]
-            env = dict(os.environ)
-            env.update(cfg.to_env())
-            proc = subprocess.Popen(
-                cmd,
-                env=env,
-                stdout=open(os.path.join(session_dir, "noded.out"), "wb"),
-                stderr=subprocess.STDOUT,
+                head=True,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                num_workers=num_workers or 0,
+                env_extra=cfg.to_env(),
             )
-            info = _wait_ready(ready_file, proc)
             _session["noded_proc"] = proc
             _session["session_dir"] = session_dir
         else:
@@ -197,6 +178,13 @@ class RemoteFunction:
         refs = get_runtime().submit_task(self._fn, list(args), kwargs, **self._options)
         n = self._options.get("num_returns", 1)
         return refs[0] if n == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        """Build a task-DAG node instead of executing (reference:
+        `dag/dag_node.py:29`; workflows execute these durably)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
 
     def options(self, **opts) -> "RemoteFunction":
         merged = dict(self._options)

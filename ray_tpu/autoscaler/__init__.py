@@ -1,0 +1,19 @@
+"""Autoscaler: demand-driven node lifecycle.
+
+Reference: `python/ray/autoscaler/` — v2 architecture
+(`v2/autoscaler.py:42`: declarative reconcile from GCS autoscaler
+state) with the v1 `StandardAutoscaler.update()` loop shape
+(`_private/autoscaler.py:172,374`) and a `FakeMultiNodeProvider`-style
+local provider (`_private/fake_multi_node/node_provider.py:236`) as the
+test backend.
+"""
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerConfig",
+    "LocalNodeProvider",
+    "NodeProvider",
+    "StandardAutoscaler",
+]

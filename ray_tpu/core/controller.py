@@ -61,6 +61,7 @@ class Controller:
         self.kv: Dict[str, bytes] = {}
         self.jobs: Dict[str, Dict] = {}
         self.placement_groups: Dict[bytes, Any] = {}  # filled by placement module
+        self.pending_demand: Dict[tuple, float] = {}  # demand sig -> last ts
         self._pg_manager = None  # set by placement module
         self._health_task: Optional[asyncio.Task] = None
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
@@ -355,6 +356,56 @@ class Controller:
         ]
 
     # ---- jobs --------------------------------------------------------
+    async def handle_report_pending_demand(self, payload, conn):
+        """Demand ledger for the autoscaler (reference:
+        `gcs_autoscaler_state_manager.h` pending resource demand)."""
+        sig = tuple(sorted(payload["resources"].items()))
+        import time as _t
+
+        self.pending_demand[sig] = _t.time()
+        return {"ok": True}
+
+    async def handle_report_node_load(self, payload, conn):
+        n = self.nodes.get(payload["node_id"])
+        if n is not None:
+            import time as _t
+
+            n.load = {
+                "used": payload.get("used", {}),
+                "busy": payload.get("busy", False),
+                "queued": payload.get("queued", 0),
+                "ts": _t.time(),
+            }
+        return {"ok": True}
+
+    async def handle_get_autoscaler_state(self, payload, conn):
+        import time as _t
+
+        now = _t.time()
+        fresh = {
+            sig: ts
+            for sig, ts in self.pending_demand.items()
+            if now - ts < 5.0
+        }
+        self.pending_demand = fresh
+        return {
+            "pending_demands": [dict(sig) for sig in fresh],
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "resources": n.resources,
+                    "alive": n.alive,
+                    "is_head": n.is_head,
+                    "busy": bool(
+                        getattr(n, "load", None)
+                        and n.load.get("busy")
+                        and now - n.load.get("ts", 0) < 5.0
+                    ),
+                }
+                for n in self.nodes.values()
+            ],
+        }
+
     async def handle_register_job(self, payload, conn):
         self.jobs[payload["job_id"]] = {
             "start_time": time.time(),

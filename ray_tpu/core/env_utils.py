@@ -1,0 +1,39 @@
+"""Process-spawn environment hygiene.
+
+The deployment image's sitecustomize registers the TPU PJRT plugin —
+importing jax — in EVERY interpreter whose env carries the axon pool
+marker.  That is a ~10s (worse under load) import tax per process, paid
+even by infrastructure daemons that never touch jax.  Node daemons
+always strip it; worker processes keep it unless the session is pinned
+to CPU (tests), since workers may execute TPU compute.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_AXON_MARKER = "PALLAS_AXON_POOL_IPS"
+_STASH = "RT_STASHED_AXON_POOL_IPS"
+
+
+def infra_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env for spawning a node daemon: the axon marker is stashed so the
+    daemon itself skips the jax-importing sitecustomize path but can
+    still hand it back to workers."""
+    env = dict(base if base is not None else os.environ)
+    marker = env.pop(_AXON_MARKER, None)
+    if marker:
+        env[_STASH] = marker
+    return env
+
+
+def worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env for spawning a worker: restore the axon marker unless the
+    session runs on CPU (JAX_PLATFORMS=cpu — the test configuration),
+    where the TPU plugin import would be pure overhead."""
+    env = dict(base if base is not None else os.environ)
+    stashed = env.pop(_STASH, None)
+    if stashed and env.get("JAX_PLATFORMS", "").lower() != "cpu":
+        env[_AXON_MARKER] = stashed
+    return env

@@ -1,0 +1,75 @@
+"""Spawn a node daemon process and wait for its ready file.
+
+Single source of truth for the noded CLI protocol — used by
+`ray_tpu.init` (head auto-start), `cluster_utils.Cluster.add_node`, and
+the autoscaler's `LocalNodeProvider`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core.env_utils import infra_env
+
+
+def launch_noded(
+    session_dir: str,
+    *,
+    head: bool = False,
+    controller_addr: Optional[Tuple[str, int]] = None,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    num_workers: int = 0,
+    env_extra: Optional[Dict[str, str]] = None,
+    timeout: float = 60.0,
+) -> Tuple[subprocess.Popen, Dict[str, Any]]:
+    """Returns (process, ready-file contents)."""
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    ready_file = os.path.join(session_dir, "ready.json")
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.noded",
+        "--session-dir", session_dir,
+        "--ready-file", ready_file,
+        "--num-workers", str(num_workers),
+    ]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if num_tpus is not None:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    if head:
+        cmd += ["--head"]
+    else:
+        if controller_addr is None:
+            raise exc.RayTpuError("worker nodes need a controller address")
+        cmd += ["--controller", f"{controller_addr[0]}:{controller_addr[1]}"]
+    env = infra_env()
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        stdout=open(os.path.join(session_dir, "noded.out"), "wb"),
+        stderr=subprocess.STDOUT,
+    )
+    deadline = time.time() + timeout
+    while not os.path.exists(ready_file):
+        if proc.poll() is not None:
+            raise exc.RayTpuError(
+                f"node daemon exited with {proc.returncode}; see "
+                f"{session_dir}/noded.out"
+            )
+        if time.time() > deadline:
+            proc.kill()
+            raise exc.RayTpuError("timed out starting node daemon")
+        time.sleep(0.02)
+    with open(ready_file) as f:
+        return proc, json.load(f)

@@ -145,6 +145,7 @@ class NodeDaemon:
         )
         for _ in range(self.num_workers):
             self._spawn_worker()
+        asyncio.ensure_future(self._retry_queue_loop())
         logger.info(
             "noded %s up: %d workers, resources=%s",
             self.node_name,
@@ -181,8 +182,10 @@ class NodeDaemon:
     _pending_spawns = 0
 
     def _spawn_worker(self) -> None:
+        from ray_tpu.core.env_utils import worker_env
+
         self._pending_spawns += 1
-        env = dict(os.environ)
+        env = worker_env()
         env.update(self.cfg.to_env())
         env["RT_NODE_SOCKET"] = self.socket_path
         env["RT_CONTROLLER"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
@@ -366,6 +369,34 @@ class NodeDaemon:
                 self.available[k] = self.available.get(k, 0.0) + v
             w.lease = None
 
+    async def _retry_queue_loop(self):
+        """Periodic housekeeping: re-attempt queued-but-infeasible tasks
+        (cluster membership changes arrive asynchronously and nothing
+        else re-triggers the scan) and report load to the controller
+        (the RaySyncer-style resource gossip the autoscaler's idle
+        detection reads — reference: `ray_syncer.h:88`)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self.task_queue:
+                self._schedule()
+            try:
+                used = {
+                    k: self.total_resources.get(k, 0.0) - v
+                    for k, v in self.available.items()
+                    if self.total_resources.get(k, 0.0) - v > 0
+                }
+                busy = bool(used) or bool(self.task_queue) or any(
+                    w.in_flight or w.actor_id is not None
+                    for w in self.workers.values()
+                )
+                self.controller_conn.send(
+                    "report_node_load",
+                    {"node_id": self.node_id, "used": used, "busy": busy,
+                     "queued": len(self.task_queue)},
+                )
+            except Exception:
+                pass
+
     async def _maybe_spill(self, spec: TaskSpec):
         """Spillback: if this node can never or not-soon run the task,
         hand it to another node (reference: cluster_task_manager.cc:44)."""
@@ -378,7 +409,16 @@ class NodeDaemon:
             "find_node_for", {"resources": demand, "exclude": [self.node_id]}
         )
         if target is None:
-            return  # unschedulable for now; stays queued
+            # unschedulable cluster-wide: feed the autoscaler's demand
+            # ledger (reference: pending demand in LoadMetrics driving
+            # resource_demand_scheduler.py)
+            try:
+                self.controller_conn.send(
+                    "report_pending_demand", {"resources": demand}
+                )
+            except Exception:
+                pass
+            return  # stays queued
         for i, s in enumerate(self.task_queue):
             if s is spec:
                 del self.task_queue[i]
@@ -398,6 +438,11 @@ class NodeDaemon:
         (reference: `HandleRequestWorkerLease` node_manager.cc:1797)."""
         demand = payload["resources"]
         holder = self._conn_worker.get(conn, "remote")
+        if not _fits(demand, self.total_resources):
+            # never feasible on this node: tell the caller to reroute
+            # through the queue path, which spills to a feasible node
+            # (reference: spillback in cluster_task_manager.cc:44)
+            return {"infeasible": True}
         if not _fits(demand, self.available):
             return None
         for w in self.workers.values():
@@ -743,6 +788,9 @@ async def _amain(args):
 
 
 def main():
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     p = argparse.ArgumentParser()
     p.add_argument("--session-dir", required=True)
     p.add_argument("--head", action="store_true")

@@ -496,6 +496,17 @@ class Runtime:
                 if reply is None:
                     await asyncio.sleep(0.02)
                     continue
+                if isinstance(reply, dict) and reply.get("infeasible"):
+                    # local node can never host this demand: hand the
+                    # queued tasks to the node daemon, whose queue path
+                    # spills to a feasible node
+                    with self._state_lock:
+                        specs = list(pool.queue)
+                        pool.queue.clear()
+                        pool.requesting = False
+                    for s in specs:
+                        self.noded.send("submit_task", s)
+                    return
                 worker_id, socket_path = reply
                 try:
                     conn = await rpc.connect_unix(

@@ -35,6 +35,12 @@ class ChannelClosed(Exception):
     pass
 
 
+class ChannelPollTimeout(Exception):
+    """The blocking read expired with NOTHING consumed — distinct from a
+    user-raised TimeoutError travelling as an error payload (which is
+    consumed before it re-raises)."""
+
+
 def _chan_hash(name: str) -> bytes:
     return hashlib.blake2b(name.encode(), digest_size=16).digest()
 
@@ -97,7 +103,10 @@ class Channel:
         store = self._store()
         key = self._key(self._read_seq)
         timeout_ms = -1 if timeout_s is None else max(1, int(timeout_s * 1000))
-        view = store.get(key, timeout_ms=timeout_ms)
+        try:
+            view = store.get(key, timeout_ms=timeout_ms)
+        except TimeoutError as e:
+            raise ChannelPollTimeout(str(e)) from None
         try:
             data = bytes(view)
         finally:

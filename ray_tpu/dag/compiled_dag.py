@@ -18,7 +18,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag import execution as ex
-from ray_tpu.dag.channel import Channel, ChannelClosed
+from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelPollTimeout
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     DAGNode,
@@ -234,8 +234,13 @@ class CompiledDAG:
                 except ChannelClosed:
                     self._partial.append(None)
                     error = RuntimeError("DAG torn down mid-execution")
-                except TimeoutError:
-                    raise  # caller may retry; nothing was consumed
+                except ChannelPollTimeout:
+                    # caller may retry; nothing was consumed (a USER
+                    # TimeoutError payload is consumed before raising
+                    # and takes the branch below instead)
+                    raise TimeoutError(
+                        "timed out waiting for DAG output"
+                    ) from None
                 except BaseException as e:  # noqa: BLE001 — stored below
                     self._partial.append(None)
                     error = e

@@ -59,6 +59,44 @@ class ClassMethodNode(DAGNode):
         return f"ClassMethodNode({self.method_name}#{self._id})"
 
 
+class FunctionNode(DAGNode):
+    """One remote-function invocation in a task DAG (reference:
+    `dag/function_node.py`) — the node type workflows execute."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict):
+        super().__init__()
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _upstream(self) -> List[DAGNode]:
+        ups = [a for a in self.args if isinstance(a, DAGNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def execute(self, _memo: Optional[Dict[int, Any]] = None):
+        """Eager recursive execution (reference: DAGNode.execute).
+        Shared nodes (diamond DAGs) run exactly once per execute()."""
+        import ray_tpu as rt
+
+        memo: Dict[int, Any] = {} if _memo is None else _memo
+
+        def resolve(v):
+            if isinstance(v, FunctionNode):
+                if v._id not in memo:
+                    memo[v._id] = v.execute(memo)
+                return memo[v._id]
+            return v
+
+        args = [resolve(a) for a in self.args]
+        kwargs = {k: resolve(v) for k, v in self.kwargs.items()}
+        return rt.get(self.remote_fn.remote(*args, **kwargs))
+
+    def __repr__(self):
+        name = getattr(self.remote_fn, "__name__", "fn")
+        return f"FunctionNode({name}#{self._id})"
+
+
 class MultiOutputNode(DAGNode):
     """Bundle several leaves into one execute() result (reference:
     `dag/output_node.py`)."""

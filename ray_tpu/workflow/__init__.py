@@ -1,0 +1,33 @@
+"""Durable workflows: task DAGs with storage-backed resume.
+
+Reference: `python/ray/workflow/` — `workflow_executor.py` (DAG
+execution), `workflow_storage.py` (every task result durably logged),
+`workflow_state_from_storage.py` (resume skips completed tasks) — the
+same contract on a directory-per-workflow store: the bound DAG is
+persisted at submission, each task's result is written before the
+workflow advances, and `resume()` replays only what never finished.
+"""
+
+from ray_tpu.workflow.api import (
+    WorkflowStatus,
+    delete,
+    get_output,
+    get_status,
+    init_storage,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "WorkflowStatus",
+    "delete",
+    "get_output",
+    "get_status",
+    "init_storage",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

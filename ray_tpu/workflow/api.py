@@ -1,0 +1,248 @@
+"""Workflow public API + executor.
+
+Reference surface: `ray.workflow.run/run_async/resume/get_output/
+get_status/list_all/delete` (`python/ray/workflow/api.py`).
+
+Execution model (reference: `workflow_executor.py`): topological walk of
+the FunctionNode DAG; ready tasks run as ordinary remote tasks, results
+are durably written (atomic rename) before dependents are released, and
+a workflow-level status file tracks RUNNING/SUCCESSFUL/FAILED.  Resume
+reloads the pickled DAG from storage and skips every task with a
+persisted result — user code is not needed to resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu as rt
+from ray_tpu.dag.dag_node import DAGNode, FunctionNode
+
+_storage_dir: Optional[str] = None
+_lock = threading.Lock()
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+def init_storage(path: str):
+    """Set the workflow store root (reference: `workflow.init`)."""
+    global _storage_dir
+    _storage_dir = path
+    os.makedirs(path, exist_ok=True)
+
+
+def _store() -> str:
+    global _storage_dir
+    if _storage_dir is None:
+        _storage_dir = os.environ.get(
+            "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows"
+        )
+        os.makedirs(_storage_dir, exist_ok=True)
+    return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_store(), workflow_id)
+
+
+def _atomic_write(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _write_status(workflow_id: str, status: str, error: str = ""):
+    _atomic_write(
+        os.path.join(_wf_dir(workflow_id), "status.json"),
+        json.dumps({"status": status, "error": error,
+                    "ts": time.time()}).encode(),
+    )
+
+
+# ----------------------------------------------------------------------
+# executor
+# ----------------------------------------------------------------------
+def _topo(root: FunctionNode) -> List[FunctionNode]:
+    order: List[FunctionNode] = []
+    seen = set()
+
+    def visit(n: DAGNode):
+        if n._id in seen:
+            return
+        seen.add(n._id)
+        for u in n._upstream():
+            visit(u)
+        if isinstance(n, FunctionNode):
+            order.append(n)
+
+    visit(root)
+    return order
+
+
+def _task_key(idx: int, node: FunctionNode) -> str:
+    name = getattr(node.remote_fn, "__name__", "task")
+    return f"{idx:04d}_{name}"
+
+
+def _execute_dag(workflow_id: str, root: FunctionNode) -> Any:
+    wf = _wf_dir(workflow_id)
+    tasks_dir = os.path.join(wf, "tasks")
+    os.makedirs(tasks_dir, exist_ok=True)
+    order = _topo(root)
+    keys = {n._id: _task_key(i, n) for i, n in enumerate(order)}
+    results: Dict[int, Any] = {}
+
+    # load already-persisted results (resume path)
+    for n in order:
+        path = os.path.join(tasks_dir, keys[n._id] + ".pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                results[n._id] = cloudpickle.load(f)
+
+    def resolve(v):
+        if isinstance(v, FunctionNode):
+            return results[v._id]
+        return v
+
+    for n in order:
+        if n._id in results:
+            continue  # durably completed in a previous run
+        args = [resolve(a) for a in n.args]
+        kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
+        value = rt.get(n.remote_fn.remote(*args, **kwargs))
+        _atomic_write(
+            os.path.join(tasks_dir, keys[n._id] + ".pkl"),
+            cloudpickle.dumps(value),
+        )
+        results[n._id] = value
+    return results[root._id]
+
+
+def _run_to_completion(workflow_id: str, root: FunctionNode) -> Any:
+    _write_status(workflow_id, WorkflowStatus.RUNNING)
+    # liveness marker: lets get_status distinguish RUNNING (executor
+    # alive) from RESUMABLE (interrupted) — reference keeps this in the
+    # cluster's workflow manager actor
+    _atomic_write(
+        os.path.join(_wf_dir(workflow_id), "executor.json"),
+        json.dumps({"pid": os.getpid()}).encode(),
+    )
+    try:
+        out = _execute_dag(workflow_id, root)
+    except BaseException as e:
+        _write_status(workflow_id, WorkflowStatus.FAILED, error=repr(e))
+        raise
+    _atomic_write(
+        os.path.join(_wf_dir(workflow_id), "output.pkl"),
+        cloudpickle.dumps(out),
+    )
+    _write_status(workflow_id, WorkflowStatus.SUCCESSFUL)
+    return out
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+def run(dag: FunctionNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute a bound task DAG durably; returns the final output
+    (reference: `workflow.run`)."""
+    if not isinstance(dag, FunctionNode):
+        raise TypeError("workflow.run expects fn.bind(...) (a FunctionNode)")
+    workflow_id = workflow_id or f"wf_{int(time.time() * 1000):x}"
+    wf = _wf_dir(workflow_id)
+    os.makedirs(wf, exist_ok=True)
+    # persist the DAG so resume() works without user code
+    _atomic_write(os.path.join(wf, "dag.pkl"), cloudpickle.dumps(dag))
+    return _run_to_completion(workflow_id, dag)
+
+
+_async_executor = None
+
+
+def run_async(dag: FunctionNode, *, workflow_id: Optional[str] = None):
+    """Submit and return a concurrent.futures.Future."""
+    import concurrent.futures
+
+    global _async_executor
+    with _lock:
+        if _async_executor is None:
+            _async_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="workflow"
+            )
+    return _async_executor.submit(run, dag, workflow_id=workflow_id)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run an interrupted workflow; completed tasks are skipped
+    (reference: `workflow.resume` + `workflow_state_from_storage.py`)."""
+    wf = _wf_dir(workflow_id)
+    dag_path = os.path.join(wf, "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    out_path = os.path.join(wf, "output.pkl")
+    if os.path.exists(out_path):
+        with open(out_path, "rb") as f:
+            return cloudpickle.load(f)
+    with open(dag_path, "rb") as f:
+        dag = cloudpickle.load(f)
+    return _run_to_completion(workflow_id, dag)
+
+
+def get_output(workflow_id: str) -> Any:
+    out_path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(out_path):
+        raise ValueError(f"workflow {workflow_id!r} has no output yet")
+    with open(out_path, "rb") as f:
+        return cloudpickle.load(f)
+
+
+def get_status(workflow_id: str) -> str:
+    path = os.path.join(_wf_dir(workflow_id), "status.json")
+    if not os.path.exists(path):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    with open(path) as f:
+        status = json.load(f)["status"]
+    if status == WorkflowStatus.RUNNING:
+        # RUNNING with a live executor process stays RUNNING; without
+        # one the run was interrupted and is RESUMABLE (reference:
+        # WorkflowStatus.RESUMABLE)
+        exec_path = os.path.join(_wf_dir(workflow_id), "executor.json")
+        try:
+            with open(exec_path) as f:
+                pid = json.load(f)["pid"]
+            os.kill(pid, 0)
+            return WorkflowStatus.RUNNING
+        except (OSError, ValueError, KeyError):
+            return WorkflowStatus.RESUMABLE
+    return status
+
+
+def list_all(status_filter: Optional[str] = None) -> List[Tuple[str, str]]:
+    out = []
+    root = _store()
+    for wid in sorted(os.listdir(root)):
+        try:
+            s = get_status(wid)
+        except ValueError:
+            continue
+        if status_filter is None or s == status_filter:
+            out.append((wid, s))
+    return out
+
+
+def delete(workflow_id: str):
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
