@@ -32,6 +32,14 @@ from ray_tpu.core.object_ref import ObjectRef
 
 __version__ = "0.1.0"
 
+
+def timeline(filename=None):
+    """Chrome-tracing dump of recent task events (reference:
+    `ray.timeline()`)."""
+    from ray_tpu.util.state import timeline as _tl
+
+    return _tl(filename)
+
 __all__ = [
     "ActorClass",
     "ActorHandle",
@@ -50,5 +58,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
 ]

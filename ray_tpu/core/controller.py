@@ -62,6 +62,9 @@ class Controller:
         self.jobs: Dict[str, Dict] = {}
         self.placement_groups: Dict[bytes, Any] = {}  # filled by placement module
         self.pending_demand: Dict[tuple, float] = {}  # demand sig -> last ts
+        from collections import deque
+
+        self.task_events: deque = deque(maxlen=50_000)
         self._pg_manager = None  # set by placement module
         self._health_task: Optional[asyncio.Task] = None
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
@@ -356,6 +359,30 @@ class Controller:
         ]
 
     # ---- jobs --------------------------------------------------------
+    async def handle_report_task_events(self, payload, conn):
+        """Bounded ring of task state transitions (reference:
+        `gcs_task_manager.h` — the state API / timeline data source)."""
+        for ev in payload.get("events", []):
+            self.task_events.append(ev)
+        return {"ok": True}
+
+    async def handle_list_task_events(self, payload, conn):
+        payload = payload or {}
+        limit = payload.get("limit", 1000)
+        name = payload.get("name")
+        state = payload.get("state")
+        out = []
+        for ev in reversed(self.task_events):
+            if name and ev.get("name") != name:
+                continue
+            if state and ev.get("state") != state:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
     async def handle_report_pending_demand(self, payload, conn):
         """Demand ledger for the autoscaler (reference:
         `gcs_autoscaler_state_manager.h` pending resource demand)."""
@@ -413,6 +440,11 @@ class Controller:
             "status": "RUNNING",
         }
         return {"ok": True}
+
+    async def handle_list_jobs(self, payload, conn):
+        return [
+            {"job_id": jid, **info} for jid, info in self.jobs.items()
+        ]
 
     # ---- spillback target query (used by noded schedulers) ----------
     async def handle_find_node_for(self, payload, conn):

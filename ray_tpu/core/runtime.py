@@ -191,6 +191,9 @@ class Runtime:
         # pins plasma buffers the same way while Python buffers exist)
         self._held_pins: set = set()
         self._shutdown = False
+        from ray_tpu.core.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer()
 
     # ------------------------------------------------------------------
     # bootstrap
@@ -220,6 +223,7 @@ class Runtime:
         self.noded = await rpc.connect_unix(
             node_socket, handler=self._handle, name="noded"
         )
+        asyncio.ensure_future(self._flush_task_events_loop())
         self.controller = await rpc.connect_tcp(
             *controller_addr, handler=self._handle, name="controller"
         )
@@ -246,6 +250,15 @@ class Runtime:
         self._shutdown = True
 
         async def _close():
+            # final task-event drain so the last flush period's events
+            # reach the controller before the connection dies
+            events = self.task_events.drain()
+            if events and self.controller is not None:
+                try:
+                    self.controller.send("report_task_events", {"events": events})
+                    await asyncio.sleep(0.05)  # let the write flush
+                except Exception:
+                    pass
             if self._server:
                 await self._server.stop()
             for conn in list(self._conn_lease):
@@ -364,6 +377,7 @@ class Runtime:
                     rc = self.refs.get(a.id_bytes)
                     if rc:
                         rc.submitted += 1
+        self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
         self._push_or_queue(spec)
         return refs
 
@@ -632,6 +646,7 @@ class Runtime:
                         rc.submitted += 1
             if handle._address is not None:
                 self._actor_addr.setdefault(aid, tuple(handle._address))
+        self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
         self._push_actor_task(aid, spec)
         return refs
 
@@ -751,12 +766,32 @@ class Runtime:
     # ------------------------------------------------------------------
     # task completion (io thread)
     # ------------------------------------------------------------------
+    async def _flush_task_events_loop(self):
+        """Batched flush to the controller (reference:
+        `task_event_buffer.h:220` periodic flush — never the hot path)."""
+        from ray_tpu.core.task_events import FLUSH_PERIOD_S
+
+        while not self._shutdown:
+            await asyncio.sleep(FLUSH_PERIOD_S)
+            events = self.task_events.drain()
+            if events and self.controller is not None:
+                try:
+                    self.controller.send(
+                        "report_task_events", {"events": events}
+                    )
+                except Exception:
+                    pass
+
     def _complete_task(self, result: TaskResult):
         with self._state_lock:
             pt = self.pending_tasks.pop(result.task_id.binary(), None)
             if pt is None:
                 return
             if result.status == "ok":
+                self.task_events.record(
+                    result.task_id.binary(), pt.spec.name, "FINISHED",
+                    duration=(result.execution_info or {}).get("duration"),
+                )
                 for i, ret in enumerate(result.returns):
                     oid = ObjectID.for_return(result.task_id, i + 1)
                     st = self.objects.get(oid.binary())
@@ -791,6 +826,10 @@ class Runtime:
                 resubmit = True
             else:
                 resubmit = False
+                self.task_events.record(
+                    result.task_id.binary(), pt.spec.name, "FAILED",
+                    error=result.status,
+                )
                 if result.error is not None:
                     envelope = result.error
                 elif pt.spec.actor_id is not None:
@@ -1179,6 +1218,10 @@ class Runtime:
 
     async def _exec_task(self, spec: TaskSpec, conn):
         t0 = time.time()
+        self.task_events.record(
+            spec.task_id.binary(), spec.name, "RUNNING",
+            node_id=self.node_id, worker_id=self.worker_id.hex(),
+        )
         try:
             fn = await self._load_function(spec)
             args = [await self._materialize_arg(a) for a in spec.args]

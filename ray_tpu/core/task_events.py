@@ -1,0 +1,58 @@
+"""Task-event buffering: the observability feed.
+
+Reference: `src/ray/core_worker/task_event_buffer.h:220` — every runtime
+buffers per-task state transitions locally and flushes them to the
+control plane in periodic batches (never on the hot path), where the
+GCS-task-manager-equivalent keeps a bounded ring the state API and
+timeline read from (`gcs_task_manager.h`, `util/state/api.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+FLUSH_PERIOD_S = 0.5
+MAX_BUFFER = 10_000
+
+
+class TaskEventBuffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+
+    def record(self, task_id: bytes, name: str, state: str,
+               node_id: str = "", worker_id: str = "",
+               error: str = "", duration: Optional[float] = None):
+        ev = {
+            "task_id": task_id.hex(),
+            "name": name,
+            "state": state,  # SUBMITTED | RUNNING | FINISHED | FAILED
+            "ts": time.time(),
+        }
+        if node_id:
+            ev["node_id"] = node_id
+        if worker_id:
+            ev["worker_id"] = worker_id
+        if error:
+            ev["error"] = error[:512]
+        if duration is not None:
+            ev["duration"] = duration
+        with self._lock:
+            if len(self._events) >= MAX_BUFFER:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        if dropped:
+            out.append({
+                "task_id": "", "name": "__dropped__", "state": "DROPPED",
+                "ts": time.time(), "count": dropped,
+            })
+        return out

@@ -1,0 +1,60 @@
+"""Cluster CLI: status / list / timeline.
+
+Reference: `python/ray/scripts/scripts.py` (`ray status`,
+`ray list ...` from `ray/util/state`) — `python -m ray_tpu.scripts.cli
+<cmd> --address <ready-file>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str):
+    import ray_tpu as rt
+
+    rt.init(address=address)
+    return rt
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    p.add_argument("--address", required=True,
+                   help="head ready-file path (printed at init)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="cluster summary")
+    lp = sub.add_parser("list", help="list cluster entities")
+    lp.add_argument("what", choices=["tasks", "actors", "nodes", "jobs",
+                                     "placement-groups"])
+    lp.add_argument("--limit", type=int, default=100)
+    tp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
+    tp.add_argument("--output", default="timeline.json")
+    args = p.parse_args(argv)
+
+    rt = _connect(args.address)
+    from ray_tpu.util import state
+
+    try:
+        if args.cmd == "status":
+            print(json.dumps(state.cluster_status(), indent=2))
+        elif args.cmd == "list":
+            fn = {
+                "tasks": lambda: state.list_tasks(limit=args.limit),
+                "actors": state.list_actors,
+                "nodes": state.list_nodes,
+                "jobs": state.list_jobs,
+                "placement-groups": state.list_placement_groups,
+            }[args.what]
+            print(json.dumps(fn(), indent=2, default=str))
+        elif args.cmd == "timeline":
+            events = state.timeline(args.output)
+            print(f"wrote {len(events)} events to {args.output}")
+    finally:
+        rt.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

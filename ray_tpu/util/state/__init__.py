@@ -1,0 +1,124 @@
+"""State API: programmatic cluster introspection.
+
+Reference: `python/ray/util/state/api.py` (`StateApiClient:110`,
+`list_tasks:1008`) — list/summarize tasks, actors, nodes, placement
+groups, jobs; data aggregated by the controller (the GCS-task-manager
+equivalent fed by every runtime's task-event buffer).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.runtime import get_runtime
+
+
+def list_tasks(name: Optional[str] = None, state: Optional[str] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest task state transitions (newest last)."""
+    return get_runtime().controller_call(
+        "list_task_events", {"name": name, "state": state, "limit": limit}
+    )
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return get_runtime().controller_call("list_actors")
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return get_runtime().controller_call("get_nodes")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return get_runtime().controller_call("list_placement_groups")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    out = rt.controller_call("list_jobs")
+    return out if out is not None else []
+
+
+_STATE_RANK = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """state -> count over the retained event window (the latest event
+    per task wins, mirroring `ray summary tasks`).  Events from
+    different processes land in the ring in arbitrary order, so 'latest'
+    is decided by timestamp with terminal states breaking ties."""
+    latest: Dict[str, tuple] = {}
+    for ev in list_tasks(limit=50_000):
+        tid = ev.get("task_id")
+        if not tid:
+            continue
+        key = (ev["ts"], _STATE_RANK.get(ev["state"], 0))
+        if tid not in latest or key >= latest[tid][0]:
+            latest[tid] = (key, ev["state"])
+    return dict(Counter(state for _, state in latest.values()))
+
+
+def cluster_status() -> Dict[str, Any]:
+    """`ray status`-shaped summary."""
+    nodes = list_nodes()
+    actors = list_actors()
+    state = get_runtime().controller_call("get_autoscaler_state")
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "total_resources": _sum_resources(nodes),
+        "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "pending_demands": state["pending_demands"],
+        "task_summary": summarize_tasks(),
+    }
+
+
+def _sum_resources(nodes) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes:
+        if n["alive"]:
+            for k, v in n["resources"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-tracing events from the task event log (reference:
+    `ray.timeline()` — `_private/state.py:948` chrome_tracing_dump).
+    Load the output in chrome://tracing or Perfetto."""
+    events = list_tasks(limit=50_000)
+    # FINISHED events carry the execution duration; place complete
+    # events ("X") at ts-duration for each finished task
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev["state"] in ("FINISHED", "FAILED") and ev.get("duration"):
+            dur_us = ev["duration"] * 1e6
+            trace.append({
+                "name": ev["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": ev["ts"] * 1e6 - dur_us,
+                "dur": dur_us,
+                "pid": ev.get("node_id", "cluster"),
+                "tid": ev.get("worker_id", ev["task_id"][:8]),
+                "args": {"task_id": ev["task_id"], "state": ev["state"]},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+__all__ = [
+    "cluster_status",
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_placement_groups",
+    "list_tasks",
+    "summarize_tasks",
+    "timeline",
+]

@@ -1,0 +1,144 @@
+"""State API / observability tests.
+
+Coverage modeled on the reference's `python/ray/tests/test_state_api*.py`
+and `test_metrics_agent.py`: task events flow to the controller, listing
+and summarizing works, timeline exports chrome-tracing JSON, metrics
+export in Prometheus text format, CLI prints status.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import state
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, export_text
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=2, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+@rt.remote
+def traced_task(x):
+    time.sleep(0.02)
+    return x + 1
+
+
+@rt.remote
+def failing_task():
+    raise ValueError("boom")
+
+
+def _wait_for_events(pred, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        events = state.list_tasks(limit=10_000)
+        if pred(events):
+            return events
+        time.sleep(0.3)
+    raise AssertionError("task events never appeared")
+
+
+def test_task_events_and_summary(cluster):
+    refs = [traced_task.remote(i) for i in range(5)]
+    assert rt.get(refs) == [i + 1 for i in range(5)]
+    events = _wait_for_events(
+        lambda evs: sum(
+            1 for e in evs
+            if e["name"] == "traced_task" and e["state"] == "FINISHED"
+        ) >= 5
+    )
+    finished = [e for e in events if e["state"] == "FINISHED"
+                and e["name"] == "traced_task"]
+    assert all(e.get("duration", 0) > 0 for e in finished)
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 5
+
+
+def test_failed_task_event(cluster):
+    ref = failing_task.remote()
+    with pytest.raises(Exception, match="boom"):
+        rt.get(ref)
+    _wait_for_events(
+        lambda evs: any(
+            e["name"] == "failing_task" and e["state"] == "FAILED"
+            for e in evs
+        )
+    )
+
+
+def test_list_actors_nodes_jobs(cluster):
+    @rt.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert rt.get(a.ping.remote()) == 1
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert sum(1 for n in nodes if n["alive"]) >= 1
+    jobs = state.list_jobs()
+    assert len(jobs) >= 1
+    status = state.cluster_status()
+    assert status["nodes_alive"] >= 1
+
+
+def test_timeline_chrome_trace(cluster, tmp_path):
+    rt.get([traced_task.remote(i) for i in range(3)])
+    _wait_for_events(
+        lambda evs: sum(1 for e in evs if e["state"] == "FINISHED") >= 3
+    )
+    out = str(tmp_path / "trace.json")
+    events = rt.timeline(out)
+    assert len(events) >= 3
+    loaded = json.load(open(out))
+    ev = loaded[0]
+    assert ev["ph"] == "X" and ev["dur"] > 0 and "name" in ev
+
+
+def test_metrics_export():
+    c = Counter("test_requests_total", "requests", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("test_queue_len")
+    g.set(7)
+    h = Histogram("test_latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = export_text()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_queue_len 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1.0' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3.0' in text
+    assert "test_latency_s_sum" in text
+
+
+def test_cli_status(cluster):
+    import ray_tpu.api as api
+
+    address = None
+    sd = api._session.get("session_dir")
+    if sd:
+        import os
+
+        address = os.path.join(sd, "ready.json")
+    if address is None:
+        pytest.skip("no session ready file")
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--address", address,
+         "status"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout)
+    assert payload["nodes_alive"] >= 1
