@@ -1,0 +1,2 @@
+"""Runnable examples doubling as integration references (reference:
+`train/examples/`, `release/air_tests/air_benchmarks/`)."""

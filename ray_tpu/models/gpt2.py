@@ -26,11 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.parallel.ring_attention import (
-    plain_attention,
-    ring_attention,
-    ulysses_attention,
-)
+from ray_tpu.parallel.ring_attention import plain_attention, select_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,7 +38,7 @@ class GPT2Config:
     n_head: int = 12
     dropout: float = 0.0  # pretraining default; applied only if >0
     dtype: Any = jnp.bfloat16  # compute dtype (params stay f32)
-    attention: str = "dense"  # dense | ring | ulysses
+    attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = True
 
     @property
@@ -154,12 +150,7 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             q = q.reshape(B_, T_, cfg.n_head, cfg.head_dim)
             k = k.reshape(B_, T_, cfg.n_head, cfg.head_dim)
             v = v.reshape(B_, T_, cfg.n_head, cfg.head_dim)
-            if cfg.attention == "ring" and mesh is not None:
-                o = ring_attention(q, k, v, mesh, causal=True)
-            elif cfg.attention == "ulysses" and mesh is not None:
-                o = ulysses_attention(q, k, v, mesh, causal=True)
-            else:
-                o = plain_attention(q, k, v, causal=True)
+            o = select_attention(cfg.attention, q, k, v, mesh, causal=True)
             o = o.reshape(B_, T_, E)
             x1 = cfg_x + (
                 o @ layer_params["attn_out_w"].astype(cfg.dtype)

@@ -1,0 +1,321 @@
+"""Llama family, TPU-first.
+
+The fine-tune/serving flagship (BASELINE configs #4/#5: Llama-2 7B LoRA
+fine-tune via XLA SPMD; Llama-3-style serving replicas).  Same design
+stance as gpt2.py: explicit param pytrees + pure functions, stacked
+blocks under `lax.scan` (one compiled block body), logical-axis tree so
+TP/FSDP/SP are rule-table swaps, bf16 compute against f32 masters.
+
+Architecture (Llama-2/3 lineage): RMSNorm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, untied LM head.
+
+LoRA is first-class: a separate low-rank adapter pytree; the forward
+computes `x@W + (x@A)@B * scale` without materializing merged weights,
+and the LoRA train step differentiates the adapter tree only — the
+XLA-SPMD equivalent of the reference's torch/peft integration path
+(`train/examples/deepspeed/`, `train/lightning/_lightning_utils.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.ring_attention import plain_attention, select_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32  # < n_heads => grouped-query attention
+    intermediate: int = 11008
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention: str = "dense"  # dense | flash | ring | ulysses
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, max_seq_len=8192, dim=4096, n_layers=32,
+            n_heads=32, n_kv_heads=8, intermediate=14336, rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=vocab_size, max_seq_len=128, dim=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, intermediate=128,
+        )
+
+
+# ----------------------------------------------------------------------
+# init
+# ----------------------------------------------------------------------
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
+    k = jax.random.split(key, 9)
+    L, E = cfg.n_layers, cfg.dim
+    hd, H, KV, I = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.intermediate
+    std = 0.02
+    proj_std = std / math.sqrt(2 * L)
+
+    def n(key, shape, s=std):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * s
+
+    return {
+        "tok_emb": n(k[0], (cfg.vocab_size, E)),  # head uses its own key
+        "blocks": {
+            "attn_norm": jnp.ones((L, E)),
+            "wq": n(k[1], (L, E, H * hd)),
+            "wk": n(k[2], (L, E, KV * hd)),
+            "wv": n(k[3], (L, E, KV * hd)),
+            "wo": n(k[4], (L, H * hd, E), proj_std),
+            "mlp_norm": jnp.ones((L, E)),
+            "w_gate": n(k[5], (L, E, I)),
+            "w_up": n(k[6], (L, E, I)),
+            "w_down": n(k[7], (L, I, E), proj_std),
+        },
+        "final_norm": jnp.ones((E,)),
+        "lm_head": n(k[8], (E, cfg.vocab_size)),
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> Dict:
+    return {
+        "tok_emb": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": (None, "embed"),
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "heads"),
+            "wv": (None, "embed", "heads"),
+            "wo": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ----------------------------------------------------------------------
+# LoRA adapters
+# ----------------------------------------------------------------------
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(cfg: LlamaConfig, key: jax.Array, rank: int = 8,
+              alpha: float = 16.0,
+              targets: Tuple[str, ...] = LORA_TARGETS) -> Dict:
+    """Adapter pytree: per target, A [L, in, r] (gaussian) and
+    B [L, r, out] (zeros — adapters start as identity)."""
+    L = cfg.n_layers
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    dims = {
+        "wq": (cfg.dim, H * hd),
+        "wk": (cfg.dim, KV * hd),
+        "wv": (cfg.dim, KV * hd),
+        "wo": (H * hd, cfg.dim),
+        "w_gate": (cfg.dim, cfg.intermediate),
+        "w_up": (cfg.dim, cfg.intermediate),
+        "w_down": (cfg.intermediate, cfg.dim),
+    }
+    ks = jax.random.split(key, len(targets))
+    blocks = {}
+    for t, kk in zip(targets, ks):
+        din, dout = dims[t]
+        blocks[f"{t}_a"] = (
+            jax.random.normal(kk, (L, din, rank), jnp.float32) / math.sqrt(din)
+        )
+        blocks[f"{t}_b"] = jnp.zeros((L, rank, dout), jnp.float32)
+    return {"blocks": blocks, "scale": jnp.asarray(alpha / rank, jnp.float32)}
+
+
+def lora_logical_axes(cfg: LlamaConfig, lora: Dict) -> Dict:
+    """A: input dim sharded like the base input ('embed'/'heads'/'mlp');
+    r replicated.  B: r replicated; output like the base output."""
+    in_ax = {"wq": "embed", "wk": "embed", "wv": "embed", "wo": "heads",
+             "w_gate": "embed", "w_up": "embed", "w_down": "mlp"}
+    out_ax = {"wq": "heads", "wk": "heads", "wv": "heads", "wo": "embed",
+              "w_gate": "mlp", "w_up": "mlp", "w_down": "embed"}
+    blocks = {}
+    for name in lora["blocks"]:
+        t, kind = name.rsplit("_", 1)
+        if kind == "a":
+            blocks[name] = (None, in_ax[t], None)
+        else:
+            blocks[name] = (None, None, out_ax[t])
+    return {"blocks": blocks, "scale": ()}
+
+
+def _apply(x, w, dtype, lora_layer=None, name: str = ""):
+    """x @ w with an optional low-rank delta."""
+    out = x @ w.astype(dtype)
+    if lora_layer is not None and f"{name}_a" in lora_layer:
+        a = lora_layer[f"{name}_a"].astype(dtype)
+        b = lora_layer[f"{name}_b"].astype(dtype)
+        out = out + ((x @ a) @ b) * lora_layer["__scale__"].astype(dtype)
+    return out
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _rms_norm(x, g, eps):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(ms + eps).astype(x.dtype)) * g
+
+
+def _rope(x, theta: float, t0: int = 0):
+    """Rotary embedding over the last dim; x [B, T, H, hd]."""
+    B, T, H, hd = x.shape
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(t0, t0 + T, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]  # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
+            mesh=None, lora: Optional[Dict] = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (f32)."""
+    B, T = tokens.shape
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    group = H // KV
+
+    blocks = params["blocks"]
+    lora_blocks = None
+    if lora is not None:
+        lora_blocks = dict(lora["blocks"])
+
+    def body(x, layer):
+        if lora is not None:
+            layer_lora = {k: v for k, v in layer.items() if k.endswith(("_a", "_b"))}
+            layer_lora["__scale__"] = lora["scale"]
+            layer = {k: v for k, v in layer.items() if not k.endswith(("_a", "_b"))}
+        else:
+            layer_lora = None
+
+        def one(xin):
+            h = _rms_norm(xin, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
+            q = _apply(h, layer["wq"], cfg.dtype, layer_lora, "wq")
+            k = _apply(h, layer["wk"], cfg.dtype, layer_lora, "wk")
+            v = _apply(h, layer["wv"], cfg.dtype, layer_lora, "wv")
+            q = _rope(q.reshape(B, T, H, hd), cfg.rope_theta)
+            k = _rope(k.reshape(B, T, KV, hd), cfg.rope_theta)
+            v = v.reshape(B, T, KV, hd)
+            if group > 1:  # GQA: each kv head serves `group` query heads
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            o = select_attention(cfg.attention, q, k, v, mesh, causal=True)
+            o = o.reshape(B, T, H * hd)
+            x1 = xin + _apply(o, layer["wo"], cfg.dtype, layer_lora, "wo")
+
+            h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
+            gate = _apply(h2, layer["w_gate"], cfg.dtype, layer_lora, "w_gate")
+            up = _apply(h2, layer["w_up"], cfg.dtype, layer_lora, "w_up")
+            down = _apply(
+                jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
+                layer_lora, "w_down",
+            )
+            return x1 + down
+
+        fn = jax.checkpoint(one) if cfg.remat else one
+        return fn(x), None
+
+    scan_tree = dict(blocks)
+    if lora_blocks is not None:
+        scan_tree.update(lora_blocks)
+    x = x.astype(cfg.dtype)
+    x, _ = lax.scan(body, x, scan_tree)
+    x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
+            mesh=None, lora: Optional[Dict] = None) -> jax.Array:
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(cfg, params, inputs, mesh, lora)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+# ----------------------------------------------------------------------
+# train steps
+# ----------------------------------------------------------------------
+def make_train_step(cfg: LlamaConfig, optimizer, mesh=None):
+    """Full fine-tune/pretrain step."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, mesh)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    return step
+
+
+def make_lora_train_step(cfg: LlamaConfig, optimizer, mesh=None):
+    """LoRA step: base params frozen, gradients flow only through the
+    adapter pytree (the memory/steps win that makes 7B tuning fit)."""
+
+    def step(base_params, lora_params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda lp: loss_fn(cfg, base_params, tokens, mesh, lora=lp)
+        )(lora_params)
+        updates, opt_state = optimizer.update(grads, opt_state, lora_params)
+        import optax
+
+        lora_params = optax.apply_updates(lora_params, updates)
+        return lora_params, opt_state, {"loss": loss}
+
+    return step
+
+
+def merge_lora(cfg: LlamaConfig, params: Dict, lora: Dict) -> Dict:
+    """Bake adapters into the base weights (for serving without the
+    adapter matmuls)."""
+    out = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+    blocks = dict(out["blocks"])
+    scale = lora["scale"]
+    for name, a in lora["blocks"].items():
+        t, kind = name.rsplit("_", 1)
+        if kind != "a":
+            continue
+        b = lora["blocks"][f"{t}_b"]
+        blocks[t] = blocks[t] + jnp.einsum("lir,lro->lio", a, b) * scale
+    out["blocks"] = blocks
+    return out

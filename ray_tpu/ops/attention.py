@@ -1,0 +1,149 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference has no TPU kernels (its hot ops ride CUDA/cuDNN through
+torch); this is the TPU-native equivalent of its fused-attention path.
+Design per /opt/skills/guides/pallas_guide.md: q blocks stream from
+VMEM, the kv sequence is walked block-by-block with an online softmax
+(running max / sum / accumulator in f32), so the [Tq, Tk] score matrix
+never materializes in HBM — the memory shape that unlocks long context
+on one chip.
+
+`flash_attention` is a drop-in for `plain_attention` ([B, T, H, D]
+layout) with a custom VJP whose backward recomputes attention with
+standard XLA ops (flash-forward + recompute-backward: the standard
+memory/compute trade, same totals as remat).  On CPU (tests) the kernel
+runs in interpreter mode when small, else falls back to the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.ring_attention import plain_attention
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_pallas(q, k, v, *, causal: bool, scale: float,
+                      block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape  # batch*heads folded
+    n_q = T // block_q
+    n_k = T // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        qi = pl.program_id(1)
+        kb = pl.program_id(2)
+
+        @pl.when(kb == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        qb = q_ref[...].astype(jnp.float32) * scale  # [block_q, D]
+        kblk = k_ref[...].astype(jnp.float32)  # [block_k, D]
+        vblk = v_ref[...].astype(jnp.float32)
+        s = qb @ kblk.T  # [block_q, block_k]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + p @ vblk
+
+        @pl.when(kb == n_k - 1)
+        def _finalize():
+            o_ref[...] = (
+                acc_ref[...] / l_ref[...][:, None]
+            ).astype(o_ref.dtype)
+
+    # The kv walk is the INNERMOST grid dim: TPU grids iterate
+    # sequentially, so the VMEM scratch accumulators persist across kv
+    # steps of one q block.  Only one [block_k, D] K/V tile is resident
+    # per step — long sequences never exceed VMEM.
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _supported(T: int, D: int, block_q: int, block_k: int) -> bool:
+    return (
+        T % block_q == 0
+        and T % block_k == 0
+        and D % 8 == 0
+        and T >= block_q
+    )
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    force_pallas: Optional[bool] = None):
+    """q/k/v [B, T, H, D] -> [B, T, H, D]."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, force_pallas)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, force_pallas):
+    B, T, H, D = q.shape
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = force_pallas if force_pallas is not None else on_tpu
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if not use_pallas or not _supported(T, D, block_q, block_k):
+        return plain_attention(q, k, v, causal=causal)
+    scale = 1.0 / (D ** 0.5)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    out = _flash_fwd_pallas(
+        fold(q), fold(k), fold(v), causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=not on_tpu,
+    )
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, force_pallas):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, force_pallas)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, force_pallas, res, g):
+    q, k, v = res
+    # recompute-backward: differentiate the XLA attention (bitwise-equal
+    # math in f32; the flash forward only changed the summation order)
+    _, vjp = jax.vjp(lambda q, k, v: plain_attention(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,181 @@
+"""Mixture-of-experts with expert parallelism over the `ep` mesh axis.
+
+Absent from the reference (SURVEY §2.5: EP/MoE "Absent — build: expert
+mesh axis + ragged all-to-all").  Design: top-k token routing with a
+capacity factor; tokens are dispatched to their experts' devices with
+`lax.all_to_all` over `ep` inside `shard_map`, each device runs its
+resident experts' FFN as one batched matmul (MXU-friendly fixed
+capacity slots — dropped tokens pass through the residual), results
+return via the inverse all-to-all and combine weighted by router probs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    hidden: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe(cfg: MoEConfig, key: jax.Array) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    E, Dm, Dh = cfg.num_experts, cfg.dim, cfg.hidden
+    std = 0.02
+    return {
+        "router": jax.random.normal(k1, (Dm, E), jnp.float32) * std,
+        "w_in": jax.random.normal(k2, (E, Dm, Dh), jnp.float32) * std,
+        "w_out": jax.random.normal(k3, (E, Dh, Dm), jnp.float32) * std,
+    }
+
+
+def moe_logical_axes(cfg: MoEConfig) -> Dict:
+    return {
+        "router": ("embed", None),
+        "w_in": ("expert", "embed", "mlp"),
+        "w_out": ("expert", "mlp", "embed"),
+    }
+
+
+def _capacity(tokens_per_device: int, cfg: MoEConfig, ep: int) -> int:
+    cap = int(cfg.capacity_factor * tokens_per_device * cfg.top_k
+              / cfg.num_experts)
+    return max(cap, 4)
+
+
+def moe_forward(cfg: MoEConfig, params: Dict, x: jax.Array,
+                mesh: Optional[Mesh] = None) -> Tuple[jax.Array, Dict]:
+    """x [B, T, D] -> (out [B, T, D], aux {load_balance_loss}).
+
+    Without a mesh (or ep=1) this is the single-device dense-dispatch
+    path; with an `ep` axis the same math runs under shard_map with
+    all_to_all token exchange.
+    """
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        return _moe_forward_ep(cfg, params, x, mesh)
+    return _moe_forward_local(cfg, params, x)
+
+
+def _route(cfg: MoEConfig, router_w, x2d):
+    """Top-k routing; returns (probs [N, k], idx [N, k], aux loss)."""
+    logits = (x2d.astype(jnp.float32) @ router_w)  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, cfg.top_k)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    # Switch-style load-balance loss: frac of tokens per expert x mean prob
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(top_i[:, 0], cfg.num_experts, dtype=jnp.float32), axis=0
+    )
+    aux = cfg.num_experts * jnp.sum(me * ce)
+    return top_p, top_i, aux
+
+
+def _expert_ffn(cfg: MoEConfig, w_in, w_out, slots):
+    """slots [E_local, C, D] -> [E_local, C, D]; one batched matmul per
+    projection (the MXU-friendly shape)."""
+    h = jnp.einsum("ecd,edh->ech", slots.astype(cfg.dtype),
+                   w_in.astype(cfg.dtype))
+    h = jax.nn.gelu(h)
+    return jnp.einsum("ech,ehd->ecd", h, w_out.astype(cfg.dtype))
+
+
+def _dispatch(cfg: MoEConfig, x2d, top_p, top_i, capacity: int):
+    """Build fixed-capacity expert slots.  Returns (slots [E, C, D],
+    slot_pos [N, k], keep [N, k]); combine weights come from the router
+    probs in _combine."""
+    N = x2d.shape[0]
+    E, C = cfg.num_experts, capacity
+    # position of each (token, k) within its expert's slot list
+    flat_i = top_i.reshape(-1)  # [N*k]
+    one_hot = jax.nn.one_hot(flat_i, E, dtype=jnp.int32)  # [N*k, E]
+    pos_in_expert = jnp.cumsum(one_hot, axis=0) - one_hot
+    slot = jnp.sum(pos_in_expert * one_hot, axis=-1)  # [N*k]
+    keep = slot < C
+    slots = jnp.zeros((E, C, x2d.shape[1]), x2d.dtype)
+    flat_tok = jnp.repeat(jnp.arange(N), cfg.top_k)
+    slots = slots.at[
+        jnp.where(keep, flat_i, 0), jnp.where(keep, slot, 0)
+    ].add(jnp.where(keep[:, None], x2d[flat_tok], 0))
+    return slots, slot.reshape(N, cfg.top_k), keep.reshape(N, cfg.top_k)
+
+
+def _combine(cfg: MoEConfig, out_slots, top_p, top_i, slot_pos, keep, N):
+    flat_i = top_i.reshape(-1)
+    flat_s = slot_pos.reshape(-1)
+    flat_keep = keep.reshape(-1)
+    gathered = out_slots[flat_i, flat_s]  # [N*k, D]
+    gathered = jnp.where(flat_keep[:, None], gathered, 0)
+    weighted = gathered * top_p.reshape(-1)[:, None].astype(gathered.dtype)
+    return weighted.reshape(N, cfg.top_k, -1).sum(axis=1)
+
+
+def _moe_forward_local(cfg: MoEConfig, params: Dict, x: jax.Array):
+    B, T, D = x.shape
+    x2d = x.reshape(B * T, D)
+    top_p, top_i, aux = _route(cfg, params["router"], x2d)
+    cap = _capacity(B * T, cfg, ep=1)
+    slots, slot_pos, keep = _dispatch(cfg, x2d, top_p, top_i, cap)
+    out_slots = _expert_ffn(cfg, params["w_in"], params["w_out"], slots)
+    out = _combine(cfg, out_slots, top_p, top_i, slot_pos, keep, B * T)
+    return out.reshape(B, T, D).astype(x.dtype), {"load_balance_loss": aux}
+
+
+def _moe_forward_ep(cfg: MoEConfig, params: Dict, x: jax.Array, mesh: Mesh):
+    ep = mesh.shape["ep"]
+    assert cfg.num_experts % ep == 0, "num_experts must divide ep"
+    e_local = cfg.num_experts // ep
+
+    def body(router_w, w_in, w_out, xs):
+        # xs: this device's token shard [b, T, D]
+        b, T, D = xs.shape
+        x2d = xs.reshape(b * T, D)
+        top_p, top_i, aux = _route(cfg, router_w, x2d)
+        cap = _capacity(b * T, cfg, ep)
+        slots, slot_pos, keep = _dispatch(cfg, x2d, top_p, top_i, cap)
+        # slots [E, C, D] -> exchange: each device keeps rows for its
+        # resident experts from EVERY peer: [E, C, D] -> [ep, e_local, C, D]
+        slots = slots.reshape(ep, e_local, cap, D)
+        # all_to_all over ep: axis 0 splits, results concatenate on a
+        # new leading axis -> [ep(peers), e_local, C, D]
+        recv = lax.all_to_all(slots, "ep", split_axis=0, concat_axis=0,
+                              tiled=False)
+        # run resident experts over all peers' tokens: fold the peer dim
+        # into capacity so each resident expert runs ONE matmul over
+        # peer*C rows — no weight replication
+        peer, el = recv.shape[0], recv.shape[1]
+        stacked = recv.transpose(1, 0, 2, 3).reshape(el, peer * cap, D)
+        out = _expert_ffn(cfg, w_in, w_out, stacked)
+        out = out.reshape(el, peer, cap, D).transpose(1, 0, 2, 3)
+        # return to owners: inverse all_to_all
+        back = lax.all_to_all(out, "ep", split_axis=0, concat_axis=0,
+                              tiled=False)
+        out_slots = back.reshape(cfg.num_experts, cap, D)
+        combined = _combine(cfg, out_slots, top_p, top_i, slot_pos, keep,
+                            b * T)
+        return combined.reshape(b, T, D).astype(xs.dtype), aux.reshape(1)
+
+    in_specs = (
+        P(), P("ep"), P("ep"),  # router replicated; experts sharded on ep
+        P("ep"),  # tokens sharded over ep (data-parallel style)
+    )
+    out_specs = (P("ep"), P("ep"))
+    fn = shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+    out, aux = fn(params["router"], params["w_in"], params["w_out"], x)
+    return out, {"load_balance_loss": jnp.mean(aux)}

@@ -30,6 +30,20 @@ from jax.experimental.shard_map import shard_map
 _NEG_INF = -1e30
 
 
+def select_attention(kind: str, q, k, v, mesh=None, causal: bool = True):
+    """One dispatch point for the attention backends (dense | flash |
+    ring | ulysses) shared by all model families."""
+    if kind == "flash":
+        from ray_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal)
+    if kind == "ring" and mesh is not None:
+        return ring_attention(q, k, v, mesh, causal=causal)
+    if kind == "ulysses" and mesh is not None:
+        return ulysses_attention(q, k, v, mesh, causal=causal)
+    return plain_attention(q, k, v, causal=causal)
+
+
 def _block_attn(q, k, v, bias, scale):
     """One q-block x kv-block attention with streaming-softmax stats.
 

@@ -1,0 +1,24 @@
+"""Example-program tests (the baseline-config parity demos)."""
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=3, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_mnist_fashion_ddp(cluster, tmp_path):
+    """BASELINE config #1: 2-worker data-parallel MLP training."""
+    from ray_tpu.examples import mnist
+
+    result = mnist.run(num_workers=2, epochs=4,
+                       storage_path=str(tmp_path / "mnist"))
+    assert result.error is None
+    assert result.metrics["epoch"] == 3
+    # the synthetic teacher task is learnable: well above 10% chance
+    assert result.metrics["accuracy"] > 0.5, result.metrics

@@ -1,0 +1,95 @@
+"""Kernel/op tests: flash attention (interpret mode on CPU) and MoE
+with expert parallelism on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import flash_attention
+from ray_tpu.parallel.moe import MoEConfig, init_moe, moe_forward
+from ray_tpu.parallel.ring_attention import plain_attention
+
+
+def _qkv(B=2, T=64, H=4, D=16, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) * 0.3 for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_plain(causal):
+    q, k, v = _qkv()
+    ref = plain_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal, 32, 32, True)  # force pallas
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_attention_grad_matches_plain():
+    q, k, v = _qkv(T=32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 16, 16, True) ** 2)
+
+    def f_plain(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_plain, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3
+        )
+
+
+def test_flash_attention_fallback_on_odd_shapes():
+    q, k, v = _qkv(T=60, D=12)  # not divisible: falls back to XLA path
+    out = flash_attention(q, k, v)
+    ref = plain_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_moe_local_forward_and_grad():
+    cfg = MoEConfig(dim=32, hidden=64, num_experts=4, top_k=2,
+                    dtype=jnp.float32)
+    params = init_moe(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32), jnp.float32)
+    out, aux = moe_forward(cfg, params, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux["load_balance_loss"]))
+
+    def loss(p):
+        o, a = moe_forward(cfg, p, x)
+        return jnp.mean(o ** 2) + 0.01 * a["load_balance_loss"]
+
+    grads = jax.grad(loss)(params)
+    for g in jax.tree.leaves(grads):
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_moe_expert_parallel_matches_local():
+    """EP dispatch over 4 devices must agree with the local path on the
+    same weights (same capacity per token shard)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = MoEConfig(dim=16, hidden=32, num_experts=4, top_k=1,
+                    capacity_factor=4.0, dtype=jnp.float32)
+    params = init_moe(cfg, jax.random.PRNGKey(0))
+    devices = np.array(jax.devices("cpu")[:4]).reshape(4)
+    mesh = Mesh(devices, ("ep",))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16), jnp.float32)
+
+    out_ep, aux_ep = moe_forward(cfg, params, x, mesh)
+    assert out_ep.shape == x.shape
+    assert np.isfinite(np.asarray(out_ep)).all()
+    # per-shard local computation as the oracle: run the local path on
+    # each batch shard independently (capacity is per-shard in EP mode)
+    outs = []
+    for i in range(4):
+        o, _ = moe_forward(cfg, params, x[i:i + 1])
+        outs.append(np.asarray(o))
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.concatenate(outs), rtol=2e-4, atol=2e-4
+    )
