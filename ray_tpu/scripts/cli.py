@@ -31,6 +31,15 @@ def main(argv=None):
     lp.add_argument("--limit", type=int, default=100)
     tp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     tp.add_argument("--output", default="timeline.json")
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint")
+    js.add_argument("--wait", action="store_true")
+    for name in ("status", "logs", "stop"):
+        jc = jsub.add_parser(name)
+        jc.add_argument("job_id")
+    jsub.add_parser("list")
     args = p.parse_args(argv)
 
     rt = _connect(args.address)
@@ -51,6 +60,22 @@ def main(argv=None):
         elif args.cmd == "timeline":
             events = state.timeline(args.output)
             print(f"wrote {len(events)} events to {args.output}")
+        elif args.cmd == "job":
+            from ray_tpu import job as job_api
+
+            if args.job_cmd == "submit":
+                jid = job_api.submit_job(args.entrypoint)
+                print(jid)
+                if args.wait:
+                    print(job_api.wait_job(jid))
+            elif args.job_cmd == "status":
+                print(job_api.get_job_status(args.job_id))
+            elif args.job_cmd == "logs":
+                print(job_api.get_job_logs(args.job_id), end="")
+            elif args.job_cmd == "stop":
+                print(job_api.stop_job(args.job_id))
+            elif args.job_cmd == "list":
+                print(json.dumps(job_api.list_jobs(), indent=2))
     finally:
         rt.shutdown()
     return 0

@@ -1,0 +1,53 @@
+"""Job submission tests (reference: `dashboard/modules/job/tests/`)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import job
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=2, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_submit_and_succeed(cluster):
+    jid = job.submit_job(f"{sys.executable} -c \"print('hello from job')\"")
+    status = job.wait_job(jid, timeout=60)
+    assert status == job.JobStatus.SUCCEEDED
+    assert "hello from job" in job.get_job_logs(jid)
+    info = job.get_job_info(jid)
+    assert info["returncode"] == 0
+    assert any(j["job_id"] == jid for j in job.list_jobs())
+
+
+def test_failing_job(cluster):
+    jid = job.submit_job(f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    assert job.wait_job(jid, timeout=60) == job.JobStatus.FAILED
+    assert job.get_job_info(jid)["returncode"] == 3
+
+
+def test_stop_job(cluster):
+    jid = job.submit_job(f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.time() + 30
+    while job.get_job_status(jid) != job.JobStatus.RUNNING:
+        assert time.time() < deadline
+        time.sleep(0.1)
+    assert job.stop_job(jid)
+    assert job.wait_job(jid, timeout=30) == job.JobStatus.STOPPED
+
+
+def test_job_env_and_metadata(cluster):
+    jid = job.submit_job(
+        f"{sys.executable} -c \"import os; print('V=' + os.environ['MYVAR'])\"",
+        env={"MYVAR": "42"},
+        metadata={"owner": "test"},
+    )
+    assert job.wait_job(jid, timeout=60) == job.JobStatus.SUCCEEDED
+    assert "V=42" in job.get_job_logs(jid)
+    assert job.get_job_info(jid)["metadata"]["owner"] == "test"
