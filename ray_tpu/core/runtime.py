@@ -342,6 +342,11 @@ class Runtime:
     # normal task submission — thread-side fast path
     # ------------------------------------------------------------------
     def submit_task(self, fn, args, kwargs, **options) -> List[ObjectRef]:
+        if options.get("runtime_env"):
+            raise NotImplementedError(
+                "runtime_env is supported for actors only (they own their "
+                "worker process); pooled task workers are shared"
+            )
         fid, blob = self._export_function(fn)
         task_id = TaskID.for_job(self.job_id)
         num_returns = options.get("num_returns", 1)
@@ -600,6 +605,7 @@ class Runtime:
             namespace=options.get("namespace", "default"),
             strategy=_strategy_from_options(options),
             lifetime=options.get("lifetime"),
+            runtime_env=options.get("runtime_env"),
         )
         reply = await self.controller.call("create_actor", spec)
         if not reply.get("ok"):
@@ -1161,6 +1167,17 @@ class Runtime:
             asyncio.ensure_future(self._exec_task(spec, conn))
 
     async def _h_create_actor_instance(self, aspec: ActorCreationSpec, conn):
+        if aspec.runtime_env:
+            renv = aspec.runtime_env
+            os.environ.update(renv.get("env_vars", {}))
+            wd = renv.get("working_dir")
+            if wd:
+                os.makedirs(wd, exist_ok=True)
+                os.chdir(wd)
+                import sys as _sys
+
+                if wd not in _sys.path:
+                    _sys.path.insert(0, wd)
         cls = ser.loads(aspec.class_blob)
         self.actor_id = aspec.actor_id
         self._actor_aspec = aspec

@@ -126,6 +126,11 @@ class ActorCreationSpec:
     namespace: str = "default"
     strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     lifetime: Optional[str] = None  # "detached" keeps it past driver exit
+    # {"env_vars": {...}, "working_dir": path} applied in the actor's
+    # dedicated worker before __init__ (reference: _private/runtime_env/;
+    # actors own their worker, so process-level env mutation is safe —
+    # pooled task workers are shared and don't support this)
+    runtime_env: Optional[Dict[str, Any]] = None
 
 
 @dataclass

@@ -1,0 +1,160 @@
+"""DashboardHead actor.
+
+Reference: `dashboard/head.py:61` DashboardHead + module routes
+(`dashboard/modules/{node,actor,job,serve,metrics}`).  Async actor: the
+listen socket and all handlers live on the worker's io loop, state is
+fetched from the controller with async calls (never blocking the loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.serve.request import Request
+from ray_tpu.util import httpd
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111; color: #eee; }
+ h1 { font-size: 1.2em; } h2 { font-size: 1em; margin-top: 1.5em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #444; padding: 4px 8px; text-align: left; }
+ a { color: #8cf; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="status"></div>
+<h2>nodes</h2><div id="nodes"></div>
+<h2>actors</h2><div id="actors"></div>
+<h2>jobs</h2><div id="jobs"></div>
+<h2>recent tasks</h2><div id="tasks"></div>
+<script>
+function table(rows) {
+  if (!rows || !rows.length) return "<i>none</i>";
+  const cols = Object.keys(rows[0]);
+  let h = "<table><tr>" + cols.map(c => "<th>"+c+"</th>").join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => "<td>"+JSON.stringify(r[c])+"</td>").join("") + "</tr>";
+  return h + "</table>";
+}
+async function refresh() {
+  const s = await (await fetch("api/cluster_status")).json();
+  document.getElementById("status").innerHTML = "<pre>"+JSON.stringify(s, null, 1)+"</pre>";
+  for (const [id, url] of [["nodes","api/nodes"],["actors","api/actors"],
+                           ["jobs","api/jobs"],["tasks","api/tasks?limit=25"]]) {
+    document.getElementById(id).innerHTML = table(await (await fetch(url)).json());
+  }
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server = None
+
+    async def start(self) -> int:
+        self._server, self._port = await httpd.serve_http(
+            self._host, self._port, self._dispatch
+        )
+        return self._port
+
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    async def stop(self) -> bool:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        return True
+
+    # -- routing ------------------------------------------------------
+    async def _ctl(self, method: str, payload: Optional[Dict] = None):
+        from ray_tpu.core.runtime import get_runtime
+
+        return await get_runtime().controller.call(method, payload)
+
+    async def _dispatch(self, req: Request) -> Tuple[int, str, bytes]:
+        path = req.path.rstrip("/") or "/"
+        if path == "/":
+            return 200, "text/html; charset=utf-8", _PAGE.encode()
+        if path == "/api/cluster_status":
+            nodes = await self._ctl("get_nodes")
+            actors = await self._ctl("list_actors")
+            auto = await self._ctl("get_autoscaler_state")
+            return httpd.json_response({
+                "nodes_alive": sum(1 for n in nodes if n["alive"]),
+                "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+                "pending_demands": auto["pending_demands"],
+            })
+        if path == "/api/nodes":
+            return httpd.json_response(await self._ctl("get_nodes"))
+        if path == "/api/actors":
+            return httpd.json_response(await self._ctl("list_actors"))
+        if path == "/api/placement_groups":
+            return httpd.json_response(await self._ctl("list_placement_groups"))
+        if path == "/api/jobs":
+            jobs = await self._ctl("list_jobs") or []
+            # submitted (supervised) jobs live in the KV
+            keys = await self._ctl("kv_keys", {"prefix": "job:"}) or []
+            from ray_tpu.core.runtime import get_runtime
+
+            rt_ = get_runtime()
+            for key in keys:
+                raw = await rt_.controller.call("kv_get", {"key": key})
+                if raw:
+                    jobs.append(json.loads(raw))
+            return httpd.json_response(jobs)
+        if path == "/api/tasks":
+            limit = int(req.query_params.get("limit", "100"))
+            events = await self._ctl("list_task_events", {"limit": limit})
+            return httpd.json_response(events)
+        if path == "/api/timeline":
+            events = await self._ctl("list_task_events", {"limit": 50_000})
+            trace = [
+                {
+                    "name": ev["name"], "cat": "task", "ph": "X",
+                    "ts": ev["ts"] * 1e6 - ev["duration"] * 1e6,
+                    "dur": ev["duration"] * 1e6,
+                    "pid": ev.get("node_id", "cluster"),
+                    "tid": ev.get("worker_id", ev["task_id"][:8]),
+                }
+                for ev in events
+                if ev["state"] in ("FINISHED", "FAILED") and ev.get("duration")
+            ]
+            return httpd.json_response(trace)
+        if path == "/api/serve":
+            try:
+                from ray_tpu.serve.api import _get_controller_async
+                from ray_tpu.core.runtime import get_runtime
+
+                controller = await _get_controller_async()
+                ref = controller.get_serve_status.remote()
+                status = await get_runtime()._get_one(ref)
+                return httpd.json_response(status)
+            except Exception:
+                return httpd.json_response({})
+        if path == "/metrics":
+            from ray_tpu.util.metrics import export_text
+
+            return 200, "text/plain; version=0.0.4", export_text().encode()
+        return 404, "text/plain", b"not found"
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0):
+    """Launch the dashboard actor; returns (handle, (host, port))."""
+    import ray_tpu as rt
+
+    head = (
+        rt.remote(DashboardHead)
+        .options(name="DASHBOARD_HEAD", max_concurrency=8, num_cpus=0)
+        .remote(host, port)
+    )
+    bound = rt.get(head.start.remote())
+    return head, (host, bound)

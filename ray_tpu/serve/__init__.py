@@ -23,6 +23,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.request import Request, Response
@@ -38,6 +39,8 @@ __all__ = [
     "Request",
     "Response",
     "batch",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "delete",
     "deployment",
     "get_app_handle",

@@ -169,11 +169,17 @@ class _HandleMethod:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, app_name: str = "default"):
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 _model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
+        self._model_id = _model_id
 
     def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        if self._model_id:
+            from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+            kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         router = _router_for(self.app_name, self.deployment_name)
         return DeploymentResponse(router, method, args, kwargs)
 
@@ -185,11 +191,20 @@ class DeploymentHandle:
             raise AttributeError(name)
         return _HandleMethod(self, name)
 
-    def options(self, **_opts) -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                **_opts) -> "DeploymentHandle":
+        if multiplexed_model_id is not None:
+            return DeploymentHandle(
+                self.deployment_name, self.app_name,
+                _model_id=multiplexed_model_id,
+            )
         return self
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name))
+        return (
+            DeploymentHandle,
+            (self.deployment_name, self.app_name, self._model_id),
+        )
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name})"

@@ -1,0 +1,109 @@
+"""Model multiplexing: many models per replica with LRU residency.
+
+Reference: `python/ray/serve/multiplex.py` (`@serve.multiplexed`) +
+`serve.get_multiplexed_model_id()` — a replica lazily loads models by id
+on first request and keeps at most `max_num_models_per_replica` resident
+(LRU eviction).  Callers pick the model per request via
+`handle.options(multiplexed_model_id=...)`.
+
+On TPU, residency is the whole point: a loaded model is a set of
+device-resident arrays (and usually a compiled program); reloading per
+request would forfeit both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import functools
+import inspect
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+MODEL_ID_KWARG = "__serve_model_id__"
+
+
+def _set_model_id(model_id: str):
+    # contextvars are per-thread AND per-asyncio-task: the replica sets
+    # this on the exact thread/task that runs the user code
+    _current_model_id.set(model_id)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id the caller asked for (reference:
+    `serve.get_multiplexed_model_id`)."""
+    return _current_model_id.get()
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, max_models: int):
+        self._loader = loader
+        self._max = max_models
+        self._models: OrderedDict[str, Any] = OrderedDict()
+        self._loading: dict = {}  # model_id -> Future (in-flight dedup)
+        self._lock = asyncio.Lock()
+
+    async def get(self, owner, model_id: str):
+        while True:
+            async with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                fut = self._loading.get(model_id)
+                if fut is None:
+                    fut = asyncio.get_running_loop().create_future()
+                    self._loading[model_id] = fut
+                    break
+            # another request is loading this model: share its result
+            return await asyncio.shield(fut)
+        try:
+            out = self._loader(owner, model_id)
+            if inspect.isawaitable(out):
+                out = await out
+        except BaseException as e:
+            async with self._lock:
+                self._loading.pop(model_id, None)
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        async with self._lock:
+            self._models[model_id] = out
+            self._models.move_to_end(model_id)
+            while len(self._models) > self._max:
+                self._models.popitem(last=False)  # LRU eviction; the
+                # arrays free when the last reference drops
+            self._loading.pop(model_id, None)
+        if not fut.done():
+            fut.set_result(out)
+        return out
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate an `async def load_model(self, model_id)` method; calls
+    become LRU-cached per replica instance."""
+
+    def _decorate(fn: Callable):
+        attr = f"__serve_model_cache_{id(fn)}"
+
+        @functools.wraps(fn)
+        async def wrapper(self, model_id: Optional[str] = None):
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            cache = getattr(self, attr, None)
+            if cache is None:
+                cache = _ModelCache(fn, max_num_models_per_replica)
+                setattr(self, attr, cache)
+            return await cache.get(self, model_id)
+
+        wrapper._is_serve_multiplexed = True
+        return wrapper
+
+    if _fn is not None:
+        return _decorate(_fn)
+    return _decorate

@@ -10,7 +10,6 @@ length for power-of-two routing and autoscaling.
 from __future__ import annotations
 
 import asyncio
-import functools
 import inspect
 import time
 from typing import Any, Dict, Optional
@@ -67,6 +66,9 @@ class Replica:
         pool where blocking `.result()` composition is safe — the same
         split the reference makes between async and sync callables.
         """
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
+
+        model_id = kwargs.pop(MODEL_ID_KWARG, "")
         self._ongoing += 1
         self._total += 1
         try:
@@ -75,14 +77,18 @@ class Replica:
             else:
                 target = getattr(self._callable, method_name or "__call__")
             if asyncio.iscoroutinefunction(target):
+                _set_model_id(model_id)
                 out = await target(*args, **kwargs)
             else:
                 from ray_tpu.core.runtime import get_runtime
 
+                def _call_with_ctx():
+                    _set_model_id(model_id)
+                    return target(*args, **kwargs)
+
                 loop = asyncio.get_running_loop()
                 out = await loop.run_in_executor(
-                    get_runtime()._exec_pool,
-                    functools.partial(target, *args, **kwargs),
+                    get_runtime()._exec_pool, _call_with_ctx
                 )
                 if inspect.isawaitable(out):
                     out = await out

@@ -99,11 +99,25 @@ class Router:
         self._install_table(table)
 
     # -- replica choice ----------------------------------------------
-    def _try_pick(self):
+    def _try_pick(self, affinity_key: str = ""):
         with self._lock:
             cands = list(self._replicas.values())
             if not cands:
                 return None
+            if affinity_key:
+                # model multiplexing: consistent choice per model id so
+                # each model stays resident on one replica instead of
+                # thrashing every LRU (reference: the pow-2 scheduler's
+                # multiplex-aware candidate ranking)
+                cands.sort(key=lambda r: r.replica_id)
+                import zlib
+
+                pick = cands[zlib.adler32(affinity_key.encode()) % len(cands)]
+                if pick.local_inflight >= pick.max_ongoing:
+                    pick = None  # saturated: fall through to pow-2
+                if pick is not None:
+                    pick.local_inflight += 1
+                    return pick
             if len(cands) == 1:
                 pick = cands[0]
             else:
@@ -147,11 +161,14 @@ class Router:
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0):
         """Pick a replica and submit; returns the reply ObjectRef."""
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+        affinity = kwargs.get(MODEL_ID_KWARG, "")
         deadline = time.monotonic() + timeout_s
         backoff = 0.005
         while True:
             self._refresh()
-            info = self._try_pick()
+            info = self._try_pick(affinity)
             if info is not None:
                 return self._submit(info, method_name, args, kwargs)
             if time.monotonic() > deadline:
@@ -165,11 +182,14 @@ class Router:
 
     async def assign_request_async(self, method_name: str, args: tuple,
                                    kwargs: dict, timeout_s: float = 30.0):
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+        affinity = kwargs.get(MODEL_ID_KWARG, "")
         deadline = time.monotonic() + timeout_s
         backoff = 0.005
         while True:
             await self._refresh_async()
-            info = self._try_pick()
+            info = self._try_pick(affinity)
             if info is not None:
                 return self._submit(info, method_name, args, kwargs)
             if time.monotonic() > deadline:

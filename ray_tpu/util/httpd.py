@@ -1,0 +1,94 @@
+"""Minimal asyncio HTTP/1.1 server helpers (dependency-free).
+
+Shared by observability endpoints (dashboard) and anything else serving
+HTTP off the runtime's io loop.  The serve proxy keeps its own copy of
+this logic tuned for its routing path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ray_tpu.serve.request import Request
+
+_MAX_BODY = 64 * 1024 * 1024
+
+_REASONS = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+
+Handler = Callable[[Request], Awaitable[Tuple[int, str, bytes]]]
+
+
+async def read_request(reader: asyncio.StreamReader) -> Optional[Request]:
+    try:
+        line = await reader.readline()
+    except (ConnectionResetError, asyncio.LimitOverrunError):
+        return None
+    if not line or line in (b"\r\n", b"\n"):
+        return None
+    parts = line.decode("latin1").strip().split()
+    if len(parts) < 2:
+        return None
+    headers: Dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            break
+        if b":" in line:
+            k, v = line.decode("latin1").split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", "0") or "0")
+    if length > _MAX_BODY:
+        return None
+    body = await reader.readexactly(length) if length else b""
+    return Request(parts[0], parts[1], headers, body)
+
+
+async def write_response(writer: asyncio.StreamWriter, status: int,
+                         ctype: str, body: bytes, keep_alive: bool = True):
+    head = (
+        f"HTTP/1.1 {status} {_REASONS.get(status, 'Status')}\r\n"
+        f"Content-Type: {ctype}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+    )
+    writer.write(head.encode() + body)
+    await writer.drain()
+
+
+def json_response(value: Any, status: int = 200) -> Tuple[int, str, bytes]:
+    return status, "application/json", json.dumps(value, default=str).encode()
+
+
+async def serve_http(host: str, port: int, handler: Handler):
+    """Start an asyncio HTTP server; returns (server, bound_port)."""
+
+    async def _conn(reader, writer):
+        try:
+            while True:
+                req = await read_request(reader)
+                if req is None:
+                    break
+                keep = req.headers.get("connection", "keep-alive") != "close"
+                try:
+                    status, ctype, body = await handler(req)
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    import traceback
+
+                    status, ctype = 500, "text/plain"
+                    body = f"{e}\n{traceback.format_exc()}".encode()
+                await write_response(writer, status, ctype, body, keep)
+                if not keep:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    server = await asyncio.start_server(_conn, host, port)
+    return server, server.sockets[0].getsockname()[1]

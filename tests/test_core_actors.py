@@ -151,3 +151,24 @@ def test_actor_resources_released_on_kill(cluster):
             break
         time.sleep(0.2)
     assert rt.available_resources().get("CPU", 0) >= before - 0.01
+
+
+def test_actor_runtime_env(cluster):
+    @rt.remote
+    class EnvReader:
+        def read(self, name):
+            import os
+
+            return os.environ.get(name)
+
+        def cwd(self):
+            import os
+
+            return os.getcwd()
+
+    a = EnvReader.options(
+        runtime_env={"env_vars": {"MY_RUNTIME_VAR": "on"},
+                     "working_dir": "/tmp/ray_tpu_renv_test"}
+    ).remote()
+    assert rt.get(a.read.remote("MY_RUNTIME_VAR"), timeout=30) == "on"
+    assert rt.get(a.cwd.remote(), timeout=30) == "/tmp/ray_tpu_renv_test"

@@ -1,0 +1,85 @@
+"""Dashboard tests (reference: `dashboard/tests/`): real HTTP against
+the dashboard actor's endpoints."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture(scope="module")
+def dash():
+    rt.init(num_workers=2, num_cpus=8, ignore_reinit_error=True)
+    head, (host, port) = start_dashboard()
+    yield f"http://{host}:{port}"
+    try:
+        rt.get(head.stop.remote(), timeout=5)
+        rt.kill(head)
+    except Exception:
+        pass
+    rt.shutdown()
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_index_and_status(dash):
+    status, body = _get(dash + "/")
+    assert status == 200 and b"ray_tpu dashboard" in body
+    status, body = _get(dash + "/api/cluster_status")
+    payload = json.loads(body)
+    assert payload["nodes_alive"] >= 1
+
+
+def test_api_endpoints(dash):
+    @rt.remote
+    def noop(x):
+        return x
+
+    rt.get([noop.remote(i) for i in range(3)])
+
+    status, body = _get(dash + "/api/nodes")
+    assert status == 200 and json.loads(body)[0]["alive"]
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        _, body = _get(dash + "/api/tasks?limit=1000")
+        if any(e["name"] == "noop" for e in json.loads(body)):
+            break
+        time.sleep(0.3)
+    assert any(e["name"] == "noop" for e in json.loads(body))
+
+    status, body = _get(dash + "/api/timeline")
+    assert status == 200
+
+    status, body = _get(dash + "/metrics")
+    assert status == 200
+
+    status, _ = _get(dash + "/api/placement_groups")
+    assert status == 200
+
+
+def test_jobs_endpoint_includes_submitted(dash):
+    import sys
+
+    from ray_tpu import job
+
+    jid = job.submit_job(f"{sys.executable} -c \"print('dash job')\"")
+    job.wait_job(jid, timeout=60)
+    _, body = _get(dash + "/api/jobs")
+    jobs = json.loads(body)
+    assert any(j.get("job_id") == jid for j in jobs)
+
+
+def test_404(dash):
+    try:
+        _get(dash + "/nope")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

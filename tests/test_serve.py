@@ -282,3 +282,40 @@ def test_redeploy_updates_version(serve_instance):
             return
         time.sleep(0.2)
     raise AssertionError("redeploy never served v2")
+
+
+def test_model_multiplexing(serve_instance):
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"model": model_id, "weight": len(model_id)}
+
+        async def __call__(self, x):
+            model = await self.get_model()
+            return f"{model['model']}:{x * model['weight']}"
+
+        def load_log(self):
+            return self.loads
+
+    h = serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+    ha = h.options(multiplexed_model_id="alpha")
+    hb = h.options(multiplexed_model_id="beta")
+    assert ha.remote(2).result(timeout_s=10) == "alpha:10"
+    assert hb.remote(2).result(timeout_s=10) == "beta:8"
+    # cached: repeated calls do not reload
+    assert ha.remote(3).result(timeout_s=10) == "alpha:15"
+    loads = h.load_log.remote().result(timeout_s=10)
+    assert loads.count("alpha") == 1 and loads.count("beta") == 1
+    # LRU: a third model evicts the least recently USED (beta — alpha
+    # was touched after it); re-requesting beta reloads it
+    h.options(multiplexed_model_id="gamma").remote(1).result(timeout_s=10)
+    ha.remote(1).result(timeout_s=10)  # alpha still resident: no reload
+    hb.remote(1).result(timeout_s=10)  # beta was evicted: reloads
+    loads = h.load_log.remote().result(timeout_s=10)
+    assert loads.count("alpha") == 1
+    assert loads.count("beta") == 2
