@@ -7,7 +7,7 @@ host-collective gradient allreduce (the CPU-fleet path).  PPO is the
 first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
-from ray_tpu.rllib.algorithms import PPO, Algorithm, AlgorithmConfig, PPOConfig
+from ray_tpu.rllib.algorithms import DQN, PPO, Algorithm, AlgorithmConfig, DQNConfig, PPOConfig
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
@@ -20,6 +20,8 @@ __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "CartPoleVectorEnv",
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "EnvRunnerGroup",
     "Learner",

@@ -59,7 +59,7 @@ class EnvRunner:
         }
 
     # -- sampling (HOT LOOP of the RL stack) --------------------------
-    def sample(self, module_def) -> Dict[str, np.ndarray]:
+    def sample(self, module_def, explore=None) -> Dict[str, np.ndarray]:
         assert self._params is not None, "set_weights before sample"
         T, B = self._T, self._env.num_envs
         D = self._env.observation_size
@@ -74,15 +74,23 @@ class EnvRunner:
         # bootstrap GAE uses instead of zero (truncation is not failure)
         boot_buf = np.zeros((T, B), np.float32)
 
+        select = getattr(module_def, "select_actions_numpy", None)
         obs = self._obs
         for t in range(T):
-            logits, value = module_def.forward_numpy(self._params, obs)
-            probs = _softmax(logits)
-            u = self._rng.random((B, 1))
-            actions = (probs.cumsum(axis=-1) > u).argmax(axis=-1).astype(np.int32)
-            logp = np.log(np.take_along_axis(
-                probs, actions[:, None], axis=-1
-            )[:, 0] + 1e-10)
+            if select is not None:
+                # module-defined exploration (e.g. epsilon-greedy DQN)
+                actions, logp, value = select(
+                    self._params, obs, self._rng, explore
+                )
+                actions = actions.astype(np.int32)
+            else:
+                logits, value = module_def.forward_numpy(self._params, obs)
+                probs = _softmax(logits)
+                u = self._rng.random((B, 1))
+                actions = (probs.cumsum(axis=-1) > u).argmax(axis=-1).astype(np.int32)
+                logp = np.log(np.take_along_axis(
+                    probs, actions[:, None], axis=-1
+                )[:, 0] + 1e-10)
             next_obs, rewards, terminated, truncated, info = self._env.step(actions)
             done = terminated | truncated
             obs_buf[t], act_buf[t] = obs, actions
@@ -108,6 +116,7 @@ class EnvRunner:
         self._obs = obs
         _, final_value = module_def.forward_numpy(self._params, obs)
         return {
+            "final_obs": obs.copy(),
             "obs": obs_buf,
             "actions": act_buf,
             "logp": logp_buf,

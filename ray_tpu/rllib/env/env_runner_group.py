@@ -49,11 +49,11 @@ class EnvRunnerGroup:
         ]
         rt.wait(refs, num_returns=len(refs), timeout=30)
 
-    def sample(self, module_def) -> List[Dict[str, np.ndarray]]:
+    def sample(self, module_def, explore=None) -> List[Dict[str, np.ndarray]]:
         """One rollout from every healthy runner; failed runners are
         replaced and their sample skipped this round (reference:
         EnvRunnerGroup fault tolerance)."""
-        refs = [r.sample.remote(module_def) for r in self._runners]
+        refs = [r.sample.remote(module_def, explore) for r in self._runners]
         out: List[Dict[str, np.ndarray]] = []
         for i, ref in enumerate(refs):
             try:

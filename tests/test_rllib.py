@@ -211,3 +211,26 @@ def test_multi_learner_ddp_runs(cluster):
         assert np.isfinite(r["total_loss"])
     finally:
         algo.stop()
+
+
+def test_dqn_learns_cartpole(cluster):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .training(lr=1e-3, learn_batch_size=64, num_updates_per_iter=32,
+                  epsilon_decay_iters=15)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        results = [algo.train() for _ in range(30)]
+        early = results[2].get("episode_return_mean", 15.0)
+        late = results[-1]["episode_return_mean"]
+        assert np.isfinite(results[-1]["td_error_mean"])
+        assert late > max(35.0, early + 10.0), (early, late)
+    finally:
+        algo.stop()
