@@ -223,7 +223,9 @@ class Runtime:
         self.noded = await rpc.connect_unix(
             node_socket, handler=self._handle, name="noded"
         )
-        asyncio.ensure_future(self._flush_task_events_loop())
+        self._flush_task = asyncio.ensure_future(
+            self._flush_task_events_loop()
+        )
         self.controller = await rpc.connect_tcp(
             *controller_addr, handler=self._handle, name="controller"
         )
@@ -250,6 +252,9 @@ class Runtime:
         self._shutdown = True
 
         async def _close():
+            flush = getattr(self, "_flush_task", None)
+            if flush is not None:
+                flush.cancel()
             # final task-event drain so the last flush period's events
             # reach the controller before the connection dies
             events = self.task_events.drain()
