@@ -53,8 +53,15 @@ class ActorInfo:
 class Controller:
     """Service object; methods handle_<name> are RPC entry points."""
 
-    def __init__(self):
+    def __init__(self, persist_path: Optional[str] = None):
         self.cfg = get_config()
+        # file-backed persistence of the durable tables (reference: the
+        # Redis StoreClient enabling GCS fault tolerance,
+        # `redis_store_client.h:106`): KV (function store, job records,
+        # library state) and job registry survive a head restart and
+        # rehydrate at boot (reference: GcsInitData, `gcs_init_data.h`)
+        self._persist_path = persist_path
+        self._dirty = False
         self.nodes: Dict[str, NodeInfo] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor id
@@ -69,7 +76,81 @@ class Controller:
         self._health_task: Optional[asyncio.Task] = None
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
 
+    def load_persisted(self):
+        if not self._persist_path:
+            return
+        import base64
+        import json
+        import os
+
+        if not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path) as f:
+                snap = json.load(f)
+            self.kv = {
+                k: base64.b64decode(v) for k, v in snap.get("kv", {}).items()
+            }
+            self.jobs = snap.get("jobs", {})
+            for job in self.jobs.values():
+                # every driver of the previous incarnation is gone
+                # (reference: GCS marks jobs dead for disconnected
+                # drivers on restart)
+                if job.get("status") == "RUNNING":
+                    job["status"] = "DEAD"
+            logger.info(
+                "controller rehydrated %d kv keys, %d jobs from %s",
+                len(self.kv), len(self.jobs), self._persist_path,
+            )
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("controller state rehydration failed: %s", e)
+
+    def _mark_dirty(self):
+        self._dirty = True
+
+    def flush_snapshot(self) -> bool:
+        """Synchronous snapshot write; clears dirty only on success so
+        failed writes retry on the next tick.  Called by the loop and at
+        daemon shutdown (the last debounce window must not be lost)."""
+        import base64
+        import json
+        import os
+
+        if not self._persist_path:
+            return False
+        try:
+            kv_enc = {}
+            for k, v in self.kv.items():
+                if not isinstance(v, (bytes, bytearray)):
+                    import cloudpickle
+
+                    v = cloudpickle.dumps(v)  # kv contract is bytes, but
+                    # the store must never be the thing that breaks
+                kv_enc[k] = base64.b64encode(bytes(v)).decode()
+            snap = {"kv": kv_enc, "jobs": self.jobs, "ts": time.time()}
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f, default=str)
+            os.replace(tmp, self._persist_path)
+            self._dirty = False
+            return True
+        except Exception as e:  # noqa: BLE001 — persistence must never
+            # kill the loop; the state stays dirty and retries
+            logger.warning("controller persistence failed: %s", e)
+            return False
+
+    async def _persist_loop(self):
+        """Debounced snapshot writer (write-through would tax the
+        function-store fast path)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self._dirty:
+                self.flush_snapshot()
+
     def start_health_checks(self):
+        if self._persist_path:
+            # hold the reference: the loop keeps only weak refs to tasks
+            self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
 
     async def _health_loop(self):
@@ -156,6 +237,7 @@ class Controller:
     # ---- kv ----------------------------------------------------------
     async def handle_kv_put(self, payload, conn):
         self.kv[payload["key"]] = payload["value"]
+        self._mark_dirty()
         return {"ok": True}
 
     # fire-and-forget variant used on the submission fast path
@@ -166,6 +248,7 @@ class Controller:
 
     async def handle_kv_del(self, payload, conn):
         self.kv.pop(payload["key"], None)
+        self._mark_dirty()
         return {"ok": True}
 
     async def handle_kv_keys(self, payload, conn):
@@ -439,6 +522,7 @@ class Controller:
             "driver_pid": payload.get("pid"),
             "status": "RUNNING",
         }
+        self._mark_dirty()
         return {"ok": True}
 
     async def handle_list_jobs(self, payload, conn):

@@ -33,6 +33,8 @@ def launch_noded(
     """Returns (process, ready-file contents)."""
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
     ready_file = os.path.join(session_dir, "ready.json")
+    if os.path.exists(ready_file):
+        os.remove(ready_file)  # reusing a session dir (head restart)
     cmd = [
         sys.executable, "-m", "ray_tpu.core.noded",
         "--session-dir", session_dir,

@@ -122,7 +122,12 @@ class NodeDaemon:
             from ray_tpu.core.controller import Controller
             from ray_tpu.core.placement import PlacementGroupManager
 
-            self.controller = Controller()
+            self.controller = Controller(
+                persist_path=os.path.join(
+                    self.session_dir, "controller_state.json"
+                )
+            )
+            self.controller.load_persisted()
             self.controller._pg_manager = PlacementGroupManager(self.controller)
             ctl_server = rpc.Server(self.controller, name="controller")
             self.controller_port = await ctl_server.start_tcp("127.0.0.1", 0)
@@ -713,6 +718,8 @@ class NodeDaemon:
     # shutdown
     # ------------------------------------------------------------------
     async def shutdown(self):
+        if self.controller is not None:
+            self.controller.flush_snapshot()
         self._draining = True
         for w in self.workers.values():
             if w.proc is not None or w.kind == "worker":
