@@ -1,4 +1,4 @@
-"""Parallelism layer: meshes, shardings, collectives, sequence parallelism."""
+"""Parallelism layer: meshes, shardings, collectives, sequence/pipeline/expert parallelism."""
 
 from ray_tpu.parallel.mesh import (
     AXES,
@@ -9,6 +9,8 @@ from ray_tpu.parallel.mesh import (
     mesh_from_devices,
     replicated,
 )
+from ray_tpu.parallel.moe import MoEConfig, init_moe, moe_forward
+from ray_tpu.parallel.pipeline import pipeline_apply, stage_sharding
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     shard_params,
@@ -21,13 +23,18 @@ __all__ = [
     "AXES",
     "DEFAULT_RULES",
     "MeshSpec",
+    "MoEConfig",
     "batch_axes",
     "data_sharding",
+    "init_moe",
     "local_batch_size",
     "mesh_from_devices",
+    "moe_forward",
+    "pipeline_apply",
     "replicated",
     "shard_params",
     "sharding_from_logical",
+    "stage_sharding",
     "spec_from_logical",
     "tree_shardings",
 ]

@@ -1,0 +1,109 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+Absent as a first-class strategy in the reference (SURVEY §2.5: PP
+"expressible via aDAG multi-actor pipelines" only).  Here it is a
+compiled-program strategy: stage parameters are sharded over `pp`
+(leading stage dim), and a GPipe microbatch schedule runs inside
+`shard_map` — each step every device computes its resident stage and
+hands its activation to the next stage with `lax.ppermute` (ICI
+neighbor exchange).  Compute on microbatch m overlaps the transfer of
+microbatch m-1; the bubble is the standard (S-1)/(M+S-1) fraction.
+The whole schedule is one `lax.scan`, so XLA compiles a single step
+body regardless of microbatch count, and `jax.grad` differentiates
+straight through it (backward replays the ring in reverse).
+
+For cross-host pipelines where stages cannot share a jit program, the
+actor-level alternative is `ray_tpu.dag` compiled graphs (the
+reference's aDAG pattern) — same schedule, channels instead of
+ppermute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def stage_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for stage-stacked params (leading dim = num stages)."""
+    return NamedSharding(mesh, P("pp"))
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+):
+    """Run x [B, ...] through S pipeline stages.
+
+    stage_params: pytree whose leaves have leading dim S (sharded over
+    `pp`); stage_fn(params_slice, microbatch) -> microbatch-shaped
+    output (stages must preserve the activation shape, the usual
+    transformer-block contract).
+
+    B must divide into num_microbatches equal microbatches.
+    """
+    S = mesh.shape["pp"]
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} must equal the "
+                f"mesh's pp size {S} — a mismatch would silently drop "
+                "stages after sharding"
+            )
+    B = x.shape[0]
+    M = num_microbatches
+    assert B % M == 0, f"num_microbatches {M} must divide batch {B}"
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    def body(params, xs_local):
+        # params: this device's stage slice, leading dim 1
+        params_local = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index("pp")
+        T = M + S - 1  # schedule length incl. pipeline bubble
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        def step(carry, t):
+            recv, outs = carry
+            # stage 0 consumes microbatch t while t < M; later stages
+            # consume what arrived from the previous stage
+            feed_idx = jnp.clip(t, 0, M - 1)
+            x_in = jnp.where(idx == 0, xs_local[feed_idx], recv)
+            y = stage_fn(params_local, x_in)
+            # last stage banks its result for microbatch t - (S - 1)
+            out_slot = jnp.clip(t - (S - 1), 0, M - 1)
+            take = jnp.logical_and(idx == S - 1, t >= S - 1)
+            outs = lax.cond(
+                take,
+                lambda o: o.at[out_slot].set(y),
+                lambda o: o,
+                outs,
+            )
+            recv = lax.ppermute(y, "pp", fwd_perm)
+            return (recv, outs), None
+
+        outs0 = jnp.zeros_like(xs_local)
+        recv0 = jnp.zeros_like(xs_local[0])
+        (recv, outs), _ = lax.scan(step, (recv0, outs0), jnp.arange(T))
+        # only the last stage holds real outputs; a masked psum
+        # broadcasts them so every device returns the coherent batch
+        contrib = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(contrib, "pp")
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    out = fn(stage_params, xs)
+    return out.reshape(B, *x.shape[1:])
