@@ -98,6 +98,16 @@ class NodeDaemon:
         self._node_conns: Dict[str, rpc.Connection] = {}  # node_id -> conn
         self._node_addrs: Dict[str, Tuple[str, int]] = {}
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # disk-spilled primary copies: id -> file path (reference:
+        # `local_object_manager.h:41` spilling/restoring)
+        self._spilled: Dict[bytes, str] = {}
+        self._spill_dir = os.path.join(session_dir, "spilled")
+        import threading as _threading
+
+        # spill/restore mutate the store + index from the executor
+        # thread (file IO must not stall the io loop — the reference
+        # uses dedicated IO workers the same way)
+        self._spill_lock = _threading.Lock()
         self._actor_locations: Dict[bytes, Tuple[str, str]] = {}
         self.unix_server: Optional[rpc.Server] = None
         self.tcp_server: Optional[rpc.Server] = None
@@ -385,6 +395,13 @@ class NodeDaemon:
             if self.task_queue:
                 self._schedule()
             try:
+                if self.store.used > self.SPILL_HIGH * self.store.capacity:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._maybe_spill_objects
+                    )
+            except Exception:
+                logger.exception("object spill pass failed")
+            try:
                 used = {
                     k: self.total_resources.get(k, 0.0) - v
                     for k, v in self.available.items()
@@ -401,6 +418,102 @@ class NodeDaemon:
                 )
             except Exception:
                 pass
+
+    # ------------------------------------------------------------------
+    # object spilling (reference: LocalObjectManager, SpillObjects
+    # `local_object_manager.h:110`): above the high watermark, persist
+    # LRU sealed objects to disk and delete them from shm; restore on
+    # demand.  Distinct from eviction: spilled primaries survive without
+    # lineage recomputation.
+    # ------------------------------------------------------------------
+    SPILL_HIGH = 0.80
+    SPILL_LOW = 0.60
+
+    def _maybe_spill_objects(self, force: bool = False):
+        """Runs on an executor thread (sync file IO); serialized by
+        _spill_lock against concurrent urgent-spill requests."""
+        with self._spill_lock:
+            cap = self.store.capacity
+            if cap <= 0:
+                return 0
+            if not force and self.store.used <= self.SPILL_HIGH * cap:
+                return 0
+            target = int(self.SPILL_LOW * cap)
+            os.makedirs(self._spill_dir, exist_ok=True)
+            spilled = 0
+            for id_bytes in self.store.spill_candidates(64):
+                if self.store.used <= target:
+                    break
+                try:
+                    view = self.store.get(id_bytes, timeout_ms=0)
+                except Exception:
+                    continue
+                try:
+                    data = bytes(view)
+                finally:
+                    del view
+                    self.store.release(id_bytes)
+                path = os.path.join(self._spill_dir, id_bytes.hex() + ".bin")
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+                if not self.store.delete(id_bytes):
+                    # pinned between candidate scan and delete: the
+                    # bytes stay resident, the file is garbage
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    continue
+                self._spilled[id_bytes] = path
+                spilled += 1
+            if spilled:
+                logger.info("spilled %d objects to disk (store %.0f%% full)",
+                            spilled, 100 * self.store.used / cap)
+            return spilled
+
+    def _restore_spilled(self, id_bytes: bytes) -> bool:
+        with self._spill_lock:
+            path = self._spilled.get(id_bytes)
+            if path is None:
+                return False
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                self._spilled.pop(id_bytes, None)
+                return False
+            if not self.store.contains(id_bytes):
+                try:
+                    self.store.put(id_bytes, data, allow_evict=False)
+                except Exception:
+                    return False  # still pressured; caller retries after
+                    # the next spill pass frees room
+            self._spilled.pop(id_bytes, None)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return True
+
+    async def handle_restore_object(self, payload, conn):
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._restore_spilled, payload["id"]
+        )
+        return {"ok": ok}
+
+    async def handle_spill_now(self, payload, conn):
+        """Urgent spill on create-backpressure (the reference's create
+        queue triggering spilling, `create_request_queue.h`)."""
+        try:
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, self._maybe_spill_objects, True
+            )
+        except Exception:
+            logger.exception("urgent spill failed")
+            n = 0
+        return {"spilled": n}
 
     async def _maybe_spill(self, spec: TaskSpec):
         """Spillback: if this node can never or not-soon run the task,
@@ -597,7 +710,15 @@ class NodeDaemon:
         try:
             buf = self.store.get(id_bytes, timeout_ms=0)
         except Exception:
-            return None
+            restored = await asyncio.get_running_loop().run_in_executor(
+                None, self._restore_spilled, id_bytes
+            )
+            if not restored:
+                return None
+            try:
+                buf = self.store.get(id_bytes, timeout_ms=0)
+            except Exception:
+                return None
         try:
             return bytes(buf)
         finally:
@@ -605,6 +726,12 @@ class NodeDaemon:
 
     async def handle_free_object(self, payload, conn):
         self.store.delete(payload["id"])
+        path = self._spilled.pop(payload["id"], None)
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     async def handle_free_remote(self, payload, conn):
         node_id = payload["node_id"]

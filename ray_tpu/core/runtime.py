@@ -317,7 +317,23 @@ class Runtime:
             ser.write_chunks(chunks, memoryview(buf))
             st.where, st.value, st.size = _INLINE, bytes(buf), total
         else:
-            dest = self.store.create(oid.binary(), total)
+            from ray_tpu.shm import StoreFullError
+
+            deadline = time.time() + 30.0
+            while True:
+                try:
+                    dest = self.store.create(
+                        oid.binary(), total, allow_evict=False
+                    )
+                    break
+                except StoreFullError:
+                    if time.time() > deadline:
+                        raise
+                    try:
+                        self.noded_call("spill_now", None, timeout=10)
+                    except Exception:
+                        pass
+                    time.sleep(0.05)
             ser.write_chunks(chunks, dest)
             del dest
             self.store.seal(oid.binary())
@@ -895,16 +911,33 @@ class Runtime:
         return await self._get_borrowed(ref)
 
     def _deser_pinned(self, id_bytes: bytes, buf):
-        """Deserialize a shm buffer, keeping ONE pin per object for the
-        life of this process (values hold zero-copy views into the
-        segment; releasing would allow eviction under a live array)."""
-        if id_bytes in self._held_pins:
-            # already held once; drop the extra pin from this get
-            self.store.release(id_bytes)
-        else:
-            self._held_pins.add(id_bytes)
+        """Deserialize a shm buffer; the get's pin is held while the
+        value lives.  EVERY get keeps its own store pin: a per-get
+        finalizer on the returned array releases exactly that pin when
+        the array is garbage-collected (numpy view chains hold base
+        references, so the finalizer cannot fire while derived views
+        live — the reference releases plasma buffers on value GC the
+        same way).  Non-array values may leak extracted views past their
+        container's death, so their pin is held for the process lifetime
+        (released at shutdown)."""
+        import weakref
+
+        import numpy as _np
+
         tag, val = ser.deserialize(buf)
-        return _unwrap(tag, val)
+        out = _unwrap(tag, val)
+        if isinstance(out, _np.ndarray):
+            weakref.finalize(out, self._release_pin, id_bytes)
+        else:
+            self._held_pins.add(id_bytes)  # process-lifetime pin
+        return out
+
+    def _release_pin(self, id_bytes: bytes):
+        if not self._shutdown:
+            try:
+                self.store.release(id_bytes)
+            except Exception:
+                pass
 
     async def _read_shm(self, ref: ObjectRef, node_id: Optional[str]):
         try:
@@ -916,7 +949,24 @@ class Runtime:
                 )
                 buf = self.store.get(ref.binary(), timeout_ms=30_000)
             else:
-                return await self._reconstruct_and_get(ref)
+                # spilled-to-disk primaries restore without recompute;
+                # a restored object can be re-evicted/re-spilled before
+                # we read it under sustained pressure, so retry a few
+                # times before falling back to lineage reconstruction
+                buf = None
+                for _attempt in range(3):
+                    reply = await self.noded.call(
+                        "restore_object", {"id": ref.binary()}
+                    )
+                    if not (reply and reply.get("ok")):
+                        break
+                    try:
+                        buf = self.store.get(ref.binary(), timeout_ms=0)
+                        break
+                    except ObjectNotFoundError:
+                        await asyncio.sleep(0.1)
+                if buf is None:
+                    return await self._reconstruct_and_get(ref)
         return self._deser_pinned(ref.binary(), buf)
 
     async def _get_borrowed(self, ref: ObjectRef):
@@ -1286,7 +1336,7 @@ class Runtime:
                     return fn(*args, **kwargs)
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
-            returns = self._package_returns(spec, value)
+            returns = await self._package_returns(spec, value)
             result = TaskResult(
                 task_id=spec.task_id,
                 status="ok",
@@ -1311,7 +1361,30 @@ class Runtime:
             except Exception:
                 pass
 
-    def _package_returns(self, spec: TaskSpec, value) -> List[Tuple]:
+    async def _create_with_backpressure(self, id_bytes: bytes, total: int,
+                                        timeout_s: float = 30.0):
+        """Blocking-create semantics (reference: plasma's
+        create_request_queue.h — creates wait under memory pressure
+        instead of failing): on a full store, ask the node daemon to
+        spill urgently and retry until the deadline."""
+        from ray_tpu.shm import StoreFullError
+
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                # no destructive eviction: pressure resolves by spilling
+                # (primaries survive on disk) rather than data loss
+                return self.store.create(id_bytes, total, allow_evict=False)
+            except StoreFullError:
+                if time.time() > deadline:
+                    raise
+                try:
+                    await self.noded.call("spill_now", None, timeout=10)
+                except Exception:
+                    pass
+                await asyncio.sleep(0.05)
+
+    async def _package_returns(self, spec: TaskSpec, value) -> List[Tuple]:
         if spec.num_returns == 1:
             values = [value]
         else:
@@ -1331,7 +1404,9 @@ class Runtime:
                 ser.write_chunks(chunks, memoryview(buf))
                 out.append((_INLINE, bytes(buf)))
             else:
-                dest = self.store.create(oid.binary(), total)
+                dest = await self._create_with_backpressure(
+                    oid.binary(), total
+                )
                 ser.write_chunks(chunks, dest)
                 del dest
                 self.store.seal(oid.binary())

@@ -60,6 +60,8 @@ def _load():
             lib.rts_close.argtypes = [p]
             lib.rts_unlink.argtypes = [ctypes.c_char_p]
             lib.rts_create.argtypes = [p, ctypes.c_char_p, u64, ctypes.POINTER(u64)]
+            lib.rts_create_ex.argtypes = [p, ctypes.c_char_p, u64,
+                                          ctypes.POINTER(u64), ctypes.c_int]
             lib.rts_seal.argtypes = [p, ctypes.c_char_p]
             lib.rts_get.argtypes = [p, ctypes.c_char_p, ctypes.c_int64,
                                     ctypes.POINTER(u64), ctypes.POINTER(u64)]
@@ -67,6 +69,8 @@ def _load():
             lib.rts_delete.argtypes = [p, ctypes.c_char_p]
             lib.rts_contains.argtypes = [p, ctypes.c_char_p]
             lib.rts_reap_creator.argtypes = [p, u64]
+            lib.rts_spill_candidates.restype = u64
+            lib.rts_spill_candidates.argtypes = [p, ctypes.c_char_p, u64]
             for fn in ("rts_used", "rts_capacity", "rts_count", "rts_evictions"):
                 getattr(lib, fn).restype = u64
                 getattr(lib, fn).argtypes = [p]
@@ -161,20 +165,25 @@ class ShmStore:
             pass
 
     # -- object ops ----------------------------------------------------
-    def create(self, object_id: bytes, size: int) -> memoryview:
-        """Allocate a writable buffer; caller must seal() when done."""
+    def create(self, object_id: bytes, size: int,
+               allow_evict: bool = True) -> memoryview:
+        """Allocate a writable buffer; caller must seal() when done.
+        allow_evict=False never destroys sealed primaries for room — the
+        runtime uses it so pressure is resolved by disk spilling
+        (preserving data) instead of destructive LRU eviction."""
         off = ctypes.c_uint64()
-        rc = _load().rts_create(self._h, _pad_id(object_id), size, ctypes.byref(off))
+        rc = _load().rts_create_ex(self._h, _pad_id(object_id), size,
+                                   ctypes.byref(off), 1 if allow_evict else 0)
         _check(rc, f"create {object_id.hex()}")
         return self._view[off.value : off.value + size]
 
     def seal(self, object_id: bytes):
         _check(_load().rts_seal(self._h, _pad_id(object_id)), f"seal {object_id.hex()}")
 
-    def put(self, object_id: bytes, data) -> None:
+    def put(self, object_id: bytes, data, allow_evict: bool = True) -> None:
         """create + copy + seal in one call."""
         data = memoryview(data).cast("B")
-        buf = self.create(object_id, data.nbytes)
+        buf = self.create(object_id, data.nbytes, allow_evict=allow_evict)
         buf[:] = data
         self.seal(object_id)
 
@@ -201,6 +210,14 @@ class ShmStore:
     def reap_creator(self, pid: int) -> int:
         """Drop unsealed objects created by a dead process."""
         return _load().rts_reap_creator(self._h, pid)
+
+    def spill_candidates(self, max_ids: int = 64) -> list:
+        """LRU-ordered ids of sealed, unpinned objects (the spill
+        manager's shopping list)."""
+        buf = ctypes.create_string_buffer(18 * max_ids)
+        n = _load().rts_spill_candidates(self._h, buf, max_ids)
+        raw = buf.raw
+        return [raw[i * 18:(i + 1) * 18] for i in range(n)]
 
     # -- stats ---------------------------------------------------------
     @property

@@ -40,6 +40,9 @@
 
 extern "C" {
 
+int rts_create_ex(void* hv, const uint8_t* id, uint64_t size, uint64_t* out_off,
+                  int allow_evict);
+
 #define RTS_OK 0
 #define RTS_EXISTS (-1)
 #define RTS_NOT_FOUND (-2)
@@ -373,6 +376,15 @@ int rts_unlink(const char* name) { return shm_unlink(name) == 0 ? RTS_OK : RTS_I
 // ---- object ops -----------------------------------------------------
 
 int rts_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* out_off) {
+  return rts_create_ex(hv, id, size, out_off, 1);
+}
+
+// allow_evict=0: never destroy sealed primaries to make room — the
+// caller's backpressure path spills them to disk instead (reference:
+// create_request_queue.h queues creates and triggers spilling rather
+// than evicting unconditionally).
+int rts_create_ex(void* hv, const uint8_t* id, uint64_t size, uint64_t* out_off,
+                  int allow_evict) {
   Handle* h = (Handle*)hv;
   Header* hdr = h->hdr;
   lock(hdr);
@@ -381,12 +393,12 @@ int rts_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* out_off) {
     unlock(hdr);
     return RTS_EXISTS;
   }
-  // Evict-until-fit: retry the allocation after each eviction so
+  // Evict-until-fit (only when allowed): retry after each eviction so
   // fragmentation is resolved by coalescing, not just total-free math.
   uint64_t alloc_size = 0;
   uint64_t off = arena_alloc(h, size, &alloc_size);
   while (!off) {
-    if (!evict_one(h)) {
+    if (!allow_evict || !evict_one(h)) {
       unlock(hdr);
       return RTS_OOM;
     }
@@ -536,6 +548,37 @@ int rts_reap_creator(void* hv, uint64_t pid) {
       hdr->num_objects--;
       n++;
     }
+  }
+  unlock(hdr);
+  return n;
+}
+
+// LRU-ordered ids of spillable (sealed, unpinned) objects.  The spill
+// manager reads candidates, persists them to disk, then deletes them —
+// the disk-spilling path the reference's LocalObjectManager drives
+// (`local_object_manager.h:110` SpillObjects).  out receives up to
+// max_ids contiguous 18-byte ids; returns the count written.
+uint64_t rts_spill_candidates(void* hv, uint8_t* out, uint64_t max_ids) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  lock(hdr);
+  // selection sort over a bounded output: table scans are O(cap) and
+  // cap is 64k — fine at the 1 Hz spill cadence
+  uint64_t n = 0;
+  uint64_t last_lru = 0;
+  while (n < max_ids) {
+    Entry* best = nullptr;
+    for (uint64_t i = 0; i < hdr->table_cap; i++) {
+      Entry* e = &h->table[i];
+      if (e->state != ENTRY_SEALED || e->pins != 0) continue;
+      if (e->lru < last_lru) continue;
+      if (e->lru == last_lru && n > 0) continue;  // already emitted
+      if (!best || e->lru < best->lru) best = e;
+    }
+    if (!best) break;
+    memcpy(out + n * 18, best->id, 18);
+    last_lru = best->lru;
+    n++;
   }
   unlock(hdr);
   return n;

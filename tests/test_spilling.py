@@ -1,0 +1,51 @@
+"""Object spilling tests (reference: `tests/test_object_spilling*.py`):
+primary copies spill to disk above the high watermark and restore on
+demand without lineage recomputation."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture()
+def small_store_cluster():
+    # 12 MB store: a few 1.5MB objects cross the 80% watermark
+    rt.init(num_workers=2, num_cpus=4,
+            object_store_memory=12 * 1024 * 1024,
+            ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_spill_and_restore(small_store_cluster):
+    import time
+
+    call_count = {"n": 0}
+
+    @rt.remote
+    def make_blob(i):
+        import numpy as np
+
+        return np.full(1_500_000 // 8, i, dtype=np.int64)
+
+    refs = [make_blob.remote(i) for i in range(10)]  # ~15MB total
+    rt.get(refs[-1])  # force completion of the chain tail
+    # give the 1 Hz spill pass time to run while the store is pressured
+    deadline = time.time() + 15
+    spilled_seen = False
+    import glob
+    import ray_tpu.api as api
+
+    sd = api._session.get("session_dir")
+    while time.time() < deadline:
+        if glob.glob(f"{sd}/spilled/*.bin"):
+            spilled_seen = True
+            break
+        time.sleep(0.5)
+    assert spilled_seen, "nothing was spilled to disk under pressure"
+
+    # every object is still readable — spilled ones restore from disk
+    for i, ref in enumerate(refs):
+        arr = rt.get(ref)
+        assert arr[0] == i and arr[-1] == i
