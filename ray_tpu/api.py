@@ -335,7 +335,11 @@ def kill(handle: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
-    raise NotImplementedError("task cancellation lands in a later milestone")
+    """Cancel the task creating `ref` (reference: `ray.cancel`).
+    Queued/not-yet-started tasks fail with TaskCancelledError; a task
+    already executing Python code is not interrupted (the reference's
+    non-force semantics)."""
+    return get_runtime().cancel(ref, force=force)
 
 
 # ----------------------------------------------------------------------

@@ -497,6 +497,28 @@ class NodeDaemon:
                 pass
             return True
 
+    async def handle_cancel_task(self, payload, conn):
+        """Drop a still-queued task (reference:
+        CancelTask on the raylet for unleased tasks)."""
+        task_id = payload["task_id"]
+        for i, spec in enumerate(self.task_queue):
+            if spec.task_id.binary() == task_id:
+                del self.task_queue[i]
+                from ray_tpu.core import serialization as ser
+                from ray_tpu import exceptions as exc
+
+                envelope = ser.serialize_to_bytes(
+                    exc.TaskCancelledError(task_id=spec.task_id),
+                    tag=ser.TAG_ERROR,
+                )
+                await self._route_to_owner(
+                    spec.owner, "task_result",
+                    TaskResult(task_id=spec.task_id, status="error",
+                               error=envelope),
+                )
+                return {"cancelled": True}
+        return {"cancelled": False}
+
     async def handle_restore_object(self, payload, conn):
         ok = await asyncio.get_running_loop().run_in_executor(
             None, self._restore_spilled, payload["id"]

@@ -303,6 +303,97 @@ class Runtime:
             raise exc.GetTimeoutError(f"timed out after {timeout}s")
 
     # ------------------------------------------------------------------
+    # cancellation (reference: CoreWorker::CancelTask + the executor's
+    # cancellation wrapper `_raylet.pyx:2055`)
+    # ------------------------------------------------------------------
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        """Cancel the task that creates `ref`.  Queued tasks are
+        dropped and their returns fail with TaskCancelledError; tasks
+        already pushed to a worker are cancelled only if they have not
+        started executing (a running Python task cannot be safely
+        interrupted — same limitation as the reference without force)."""
+        if force:
+            raise NotImplementedError(
+                "force=True (kill the executing worker) is not implemented; "
+                "non-force cancellation covers queued/not-started tasks"
+            )
+        task_id = ref.id.task_id().binary()
+        with self._state_lock:
+            pt = self.pending_tasks.get(task_id)
+            if pt is None:
+                return False  # finished or never ours
+            pt.retries_left = 0  # a cancelled task never retries
+            spec = pt.spec
+            # 1. still in a local lease-pool queue: drop it here
+            for pool in self._pools.values():
+                for queued in list(pool.queue):
+                    if queued.task_id.binary() == task_id:
+                        pool.queue.remove(queued)
+                        self._fail_cancelled(task_id, spec)
+                        return True
+            # 1b. actor task still queued owner-side (actor connection
+            # not yet established): drop before it drains
+            if spec.actor_id is not None:
+                q = self._actor_queue.get(spec.actor_id.binary())
+                if q:
+                    for queued in list(q):
+                        if queued.task_id.binary() == task_id:
+                            q.remove(queued)
+                            self._fail_cancelled(task_id, spec)
+                            return True
+        # 2. pushed (or routed via noded): ask the execution side
+        self._run(self._cancel_remote(task_id, spec))
+        return True
+
+    async def _cancel_remote(self, task_id: bytes, spec: TaskSpec):
+        with self._state_lock:
+            conns = []
+            for pool, lease in self._conn_lease.values():
+                if task_id in lease.assigned:
+                    conns.append(lease.conn)
+            if spec.actor_id is not None:
+                c = self._actor_conns.get(spec.actor_id.binary())
+                if c is not None:
+                    conns.append(c)
+        for conn in conns:
+            try:
+                reply = await conn.call(
+                    "cancel_task", {"task_id": task_id}, timeout=5
+                )
+                if reply and reply.get("cancelled"):
+                    return
+            except Exception:
+                pass
+        # not found on any executor (e.g. queued in noded): best-effort
+        try:
+            await self.noded.call("cancel_task", {"task_id": task_id})
+        except Exception:
+            pass
+
+    def _fail_cancelled(self, task_id: bytes, spec: TaskSpec):
+        envelope = ser.serialize_to_bytes(
+            exc.TaskCancelledError(task_id=spec.task_id),
+            tag=ser.TAG_ERROR,
+        )
+        self._complete_task(TaskResult(
+            task_id=spec.task_id, status="error", error=envelope,
+        ))
+
+    async def _h_cancel_task(self, payload, conn):
+        """Executor side: drop the task if it has not started."""
+        task_id = payload["task_id"]
+        started = getattr(self, "_started_tasks", None)
+        if started is None:
+            started = self._started_tasks = set()
+        if task_id in started:
+            return {"cancelled": False}  # already executing
+        cancelled = self._cancelled_tasks = getattr(
+            self, "_cancelled_tasks", set()
+        )
+        cancelled.add(task_id)
+        return {"cancelled": True}
+
+    # ------------------------------------------------------------------
     # put / get / wait
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
@@ -1290,6 +1381,26 @@ class Runtime:
 
     async def _exec_task(self, spec: TaskSpec, conn):
         t0 = time.time()
+        tid = spec.task_id.binary()
+        cancelled = getattr(self, "_cancelled_tasks", None)
+        if cancelled and tid in cancelled:
+            cancelled.discard(tid)
+            envelope = ser.serialize_to_bytes(
+                exc.TaskCancelledError(task_id=spec.task_id),
+                tag=ser.TAG_ERROR,
+            )
+            conn.send("task_result", {
+                "result": TaskResult(task_id=spec.task_id, status="error",
+                                     error=envelope),
+                "owner": spec.owner,
+            })
+            return
+        started = getattr(self, "_started_tasks", None)
+        if started is None:
+            started = self._started_tasks = set()
+        started.add(tid)
+        # (discarded in the finally below — the set only guards the
+        # not-yet-started window against late cancellation)
         self.task_events.record(
             spec.task_id.binary(), spec.name, "RUNNING",
             node_id=self.node_id, worker_id=self.worker_id.hex(),
@@ -1350,6 +1461,7 @@ class Runtime:
                 tag=ser.TAG_ERROR,
             )
             result = TaskResult(task_id=spec.task_id, status="error", error=envelope)
+        self._started_tasks.discard(tid)
         try:
             conn.send("task_result", {"result": result, "owner": spec.owner})
         except Exception:

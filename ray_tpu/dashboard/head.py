@@ -140,6 +140,62 @@ class DashboardHead:
                 return httpd.json_response(status)
             except Exception:
                 return httpd.json_response({})
+        if path == "/api/serve/applications":
+            # REST deploy (reference: `dashboard/modules/serve/` REST API
+            # + `serve/schema.py` app config): PUT deploys an app whose
+            # bound graph is named by import_path "module:variable"
+            if req.method == "PUT":
+                body = req.json()
+                loop = asyncio.get_running_loop()
+
+                def _deploy():
+                    import importlib
+                    import sys
+
+                    from ray_tpu import serve
+
+                    added = []
+                    for d in body.get("import_dirs", []):
+                        if d not in sys.path:
+                            sys.path.insert(0, d)
+                            added.append(d)
+                    try:
+                        mod_name, _, var = body["import_path"].partition(":")
+                        mod = importlib.import_module(mod_name)
+                        if mod_name in sys.modules:
+                            # redeploys must see edited code, not the
+                            # import cache
+                            mod = importlib.reload(mod)
+                        app = getattr(mod, var)
+                    finally:
+                        for d in added:
+                            try:
+                                sys.path.remove(d)
+                            except ValueError:
+                                pass
+                    serve.run(
+                        app,
+                        name=body.get("name", "default"),
+                        route_prefix=body.get("route_prefix", "/"),
+                    )
+
+                await loop.run_in_executor(None, _deploy)
+                return httpd.json_response({"ok": True})
+            return httpd.json_response(
+                {"error": "use PUT with {import_path, name, route_prefix}"},
+                status=405,
+            )
+        if path.startswith("/api/serve/applications/") and req.method == "DELETE":
+            name = path.rsplit("/", 1)[1]
+            loop = asyncio.get_running_loop()
+
+            def _delete():
+                from ray_tpu import serve
+
+                serve.delete(name)
+
+            await loop.run_in_executor(None, _delete)
+            return httpd.json_response({"ok": True})
         if path == "/metrics":
             from ray_tpu.util.metrics import export_text
 

@@ -61,6 +61,15 @@ class ObjectReconstructionFailedError(ObjectLostError):
     exceeded or lineage evicted)."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before completion (reference:
+    TaskCancelledError; raised by `get` on a cancelled ref)."""
+
+    def __init__(self, message: str = "Task was cancelled.", task_id=None):
+        super().__init__(message)
+        self.task_id = task_id
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get(timeout=...)` expired."""
 

@@ -8,6 +8,7 @@ returns a handle to the ingress deployment.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -176,6 +177,14 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True):
             port = rt.get(p.start.remote())
             _state["proxy"] = p
             _state["http_address"] = (opts.host, port)
+            # cluster-visible discovery; the whole rpc layer binds
+            # 127.0.0.1 today (single-host clusters), so loopback is
+            # valid from every process that can reach the KV
+            from ray_tpu.core.runtime import get_runtime
+
+            get_runtime().kv_put(
+                "serve:http_address", json.dumps([opts.host, port]).encode()
+            )
     return _state["controller"]
 
 
@@ -212,7 +221,21 @@ async def _get_controller_async():
 
 
 def http_address() -> Optional[tuple]:
-    return _state.get("http_address")
+    addr = _state.get("http_address")
+    if addr is not None:
+        return addr
+    # proxy may have been started by another process (REST deploy via
+    # the dashboard): discover through the controller KV
+    from ray_tpu.core.runtime import get_runtime, is_initialized
+
+    if not is_initialized():
+        return None
+    raw = get_runtime().kv_get("serve:http_address")
+    if raw:
+        host, port = json.loads(raw)
+        _state["http_address"] = (host, int(port))
+        return _state["http_address"]
+    return None
 
 
 # ----------------------------------------------------------------------
@@ -319,6 +342,26 @@ def shutdown():
         controller = _state.pop("controller", None)
         proxy = _state.pop("proxy", None)
         _state.pop("http_address", None)
+    # the control plane may have been started by ANOTHER process (REST
+    # deploy via the dashboard): resolve the named actors so shutdown
+    # tears them down from anywhere
+    if controller is None:
+        try:
+            controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
+        except Exception:
+            controller = None
+    if proxy is None:
+        try:
+            proxy = rt.get_actor("SERVE_PROXY", CONTROLLER_NAMESPACE)
+        except Exception:
+            proxy = None
+    try:
+        from ray_tpu.core.runtime import get_runtime, is_initialized
+
+        if is_initialized():
+            get_runtime().kv_del("serve:http_address")
+    except Exception:
+        pass
     if proxy is not None:
         try:
             rt.get(proxy.stop.remote(), timeout=5)

@@ -200,3 +200,41 @@ def test_retry_on_worker_death(cluster):
 def test_cluster_resources(cluster):
     total = rt.cluster_resources()
     assert total.get("CPU", 0) >= 8
+
+
+def test_cancel_queued_task(cluster):
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @rt.remote
+    def blocker(sec):
+        time.sleep(sec)
+        return "done"
+
+    @rt.remote
+    def quick():
+        return 1
+
+    # saturate the workers with blockers, then queue victims behind them
+    blockers = [blocker.remote(3) for _ in range(12)]
+    victims = [quick.remote() for _ in range(8)]
+    cancelled = [rt.cancel(v) for v in victims]
+    assert any(cancelled)
+    outcomes = []
+    for v in victims:
+        try:
+            outcomes.append(rt.get(v, timeout=30))
+        except TaskCancelledError:
+            outcomes.append("cancelled")
+    assert "cancelled" in outcomes
+    rt.get(blockers)  # drain
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @rt.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert rt.get(ref) == 7
+    assert rt.cancel(ref) is False  # already finished: nothing to do
+    assert rt.get(ref) == 7

@@ -83,3 +83,49 @@ def test_404(dash):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_serve_rest_deploy(dash, tmp_path):
+    import sys
+
+    # an importable module holding a bound application
+    mod_dir = str(tmp_path)
+    with open(f"{mod_dir}/rest_app_mod.py", "w") as f:
+        f.write(
+            "from ray_tpu import serve\n"
+            "@serve.deployment\n"
+            "class Hello:\n"
+            "    def __call__(self, request):\n"
+            "        return {'hello': request.query_params.get('who', 'x')}\n"
+            "app = Hello.bind()\n"
+        )
+    sys.path.insert(0, mod_dir)
+    try:
+        req = urllib.request.Request(
+            dash + "/api/serve/applications",
+            data=json.dumps({
+                "import_path": "rest_app_mod:app",
+                "import_dirs": [mod_dir],
+                "name": "restapp",
+                "route_prefix": "/rest",
+            }).encode(),
+            method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["ok"]
+        from ray_tpu import serve
+
+        host, port = serve.http_address()
+        status, body = _get(f"http://{host}:{port}/rest?who=world")
+        assert json.loads(body) == {"hello": "world"}
+        # status visible over REST
+        _, body = _get(dash + "/api/serve")
+        assert "restapp" in json.loads(body)
+        # DELETE removes it
+        dreq = urllib.request.Request(
+            dash + "/api/serve/applications/restapp", method="DELETE")
+        with urllib.request.urlopen(dreq, timeout=60) as r:
+            assert json.loads(r.read())["ok"]
+        serve.shutdown()
+    finally:
+        sys.path.remove(mod_dir)
