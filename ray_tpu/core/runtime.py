@@ -331,16 +331,12 @@ class Runtime:
                         pool.queue.remove(queued)
                         self._fail_cancelled(task_id, spec)
                         return True
-            # 1b. actor task still queued owner-side (actor connection
-            # not yet established): drop before it drains
-            if spec.actor_id is not None:
-                q = self._actor_queue.get(spec.actor_id.binary())
-                if q:
-                    for queued in list(q):
-                        if queued.task_id.binary() == task_id:
-                            q.remove(queued)
-                            self._fail_cancelled(task_id, spec)
-                            return True
+            # 1b. actor tasks are NEVER dropped owner-side: per-caller
+            # seq_nos were assigned at submit and the executor's ordered
+            # queue would wait forever on a gap — instead the cancel
+            # rides the normal path and the executor replies
+            # TaskCancelledError without running the method (seq chain
+            # intact)
         # 2. pushed (or routed via noded): ask the execution side
         self._run(self._cancel_remote(task_id, spec))
         return True
@@ -355,6 +351,16 @@ class Runtime:
                 c = self._actor_conns.get(spec.actor_id.binary())
                 if c is not None:
                     conns.append(c)
+        if spec.actor_id is not None and not conns:
+            # connection still being established: wait briefly so the
+            # cancel can land on the executor before the task starts
+            for _ in range(50):
+                await asyncio.sleep(0.1)
+                with self._state_lock:
+                    c = self._actor_conns.get(spec.actor_id.binary())
+                if c is not None:
+                    conns.append(c)
+                    break
         for conn in conns:
             try:
                 reply = await conn.call(

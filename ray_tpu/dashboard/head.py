@@ -161,11 +161,12 @@ class DashboardHead:
                             added.append(d)
                     try:
                         mod_name, _, var = body["import_path"].partition(":")
-                        mod = importlib.import_module(mod_name)
                         if mod_name in sys.modules:
-                            # redeploys must see edited code, not the
-                            # import cache
-                            mod = importlib.reload(mod)
+                            # REdeploy must see edited code, not the
+                            # import cache (first deploy imports once)
+                            mod = importlib.reload(sys.modules[mod_name])
+                        else:
+                            mod = importlib.import_module(mod_name)
                         app = getattr(mod, var)
                     finally:
                         for d in added:

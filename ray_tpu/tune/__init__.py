@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -36,6 +37,7 @@ __all__ = [
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",
+    "TPESearcher",
     "Trainable",
     "TrialScheduler",
     "TuneConfig",

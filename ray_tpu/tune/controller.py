@@ -123,7 +123,12 @@ class TuneController:
         metric: Optional[str] = None,
         mode: str = "max",
         on_result: Optional[Callable[[Trial, Dict], None]] = None,
+        searcher=None,
     ):
+        # an adaptive searcher supplies new trial configs lazily as
+        # results arrive (reference: SearchGenerator feeding
+        # TuneController); a pre-generated trial list leaves it None
+        self.searcher = searcher
         self.trainable_def = trainable_def
         self.trials = trials
         self.experiment_dir = experiment_dir
@@ -237,6 +242,19 @@ class TuneController:
         while True:
             running = [t for t in self.trials if t.status == RUNNING]
             pending = [t for t in self.trials if t.status == PENDING]
+            # adaptive search: pull fresh configs once capacity frees
+            while (
+                self.searcher is not None
+                and len(running) + len(pending) < self.max_concurrent
+            ):
+                tid = new_trial_id()
+                cfg = self.searcher.suggest(tid)
+                if cfg is None:
+                    self.searcher = None
+                    break
+                t = Trial(trial_id=tid, config=cfg)
+                self.trials.append(t)
+                pending.append(t)
             if not running and not pending:
                 break
             while pending and len(running) < self.max_concurrent:
@@ -245,6 +263,16 @@ class TuneController:
                     self._start_trial(t)
                     running.append(t)
                 except Exception as e:
+                    if "insufficient resources" in str(e):
+                        # resources from just-killed trial actors free
+                        # asynchronously: stay PENDING and retry for a
+                        # bounded window before declaring the request
+                        # genuinely unsatisfiable
+                        t.failures += 1
+                        if t.failures <= 150:  # ~30s of 0.2s passes
+                            t.status = PENDING
+                            time.sleep(0.2)
+                            break
                     self._stop_trial(t, ERROR, f"failed to start: {e}")
             refs = [t.inflight for t in running if t.inflight is not None]
             if not refs:
@@ -268,12 +296,18 @@ class TuneController:
             else:
                 self._stop_trial(trial, ERROR, f"{e}\n{tb}")
                 self.scheduler.on_trial_complete(trial, None)
+                if self.searcher is not None:
+                    self.searcher.on_trial_complete(
+                        trial.trial_id, None, error=True
+                    )
             return
         if result.get("done"):
             if trial.checkpoint_path is None or self.checkpoint_frequency:
                 self._save_trial_checkpoint(trial)
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial, trial.last_result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
             return
         trial.last_result = result
         trial.metrics_history.append(result)
@@ -286,11 +320,15 @@ class TuneController:
             self._save_trial_checkpoint(trial)
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial, result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, result)
             return
         decision = self.scheduler.on_trial_result(trial, result)
         if decision == STOP:
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial, result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, result)
             return
         if self._maybe_exploit(trial):
             return  # back to PENDING with new config + donor checkpoint

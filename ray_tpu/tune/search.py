@@ -185,3 +185,168 @@ class BasicVariantGenerator(Searcher):
 
     def total(self) -> int:
         return len(self._variants)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator, built in (the reference ships
+    TPE through the HyperOpt/Optuna integrations — `tune/search/hyperopt/`,
+    `tune/search/optuna/`; neither dependency exists in this image, so
+    the algorithm is native).
+
+    After `n_startup` random trials, observations split at the `gamma`
+    quantile of the metric into good/rest; numeric params sample
+    candidates from a Parzen (gaussian-kernel) estimate over the good
+    points and keep the candidate maximizing the good/rest density
+    ratio l(x)/g(x); categorical params sample from smoothed good-count
+    weights.
+
+    adaptive=True: the controller pulls suggestions lazily and feeds
+    results back (suggestions made before any feedback are random).
+    """
+
+    adaptive = True
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", num_samples: int = 32,
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        self.space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._suggested = 0
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[tuple] = []  # (config, score)
+        for path, dom in _walk(param_space):
+            if _is_grid(dom):
+                raise ValueError(
+                    f"TPESearcher does not accept grid_search at {path}; "
+                    "use a Domain (uniform/loguniform/choice/...)"
+                )
+
+    # -- observation ---------------------------------------------------
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        v = float(result[self.metric])
+        self._observed.append((cfg, v if self.mode == "max" else -v))
+
+    # -- suggestion ----------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        if len(self._observed) < self.n_startup:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_config()
+        self._live[trial_id] = cfg
+        return cfg
+
+    def _flat_space(self):
+        """(path, domain) pairs over nested dicts (same walk as
+        BasicVariantGenerator)."""
+        return list(_walk(self.space))
+
+    def _random_config(self) -> Dict[str, Any]:
+        import copy
+
+        cfg = copy.deepcopy(self.space)
+        for path, dom in self._flat_space():
+            if isinstance(dom, SampleFrom):
+                _set_in(cfg, path, dom.fn(cfg))
+            elif isinstance(dom, Domain):
+                _set_in(cfg, path, dom.sample(self._rng))
+            # non-Domain leaves are literals already present in cfg
+        return cfg
+
+    def _split(self):
+        ranked = sorted(self._observed, key=lambda p: -p[1])
+        n_good = max(1, int(len(ranked) * self.gamma))
+        return ranked[:n_good], ranked[n_good:]
+
+    def _tpe_config(self) -> Dict[str, Any]:
+        import copy
+        import math
+
+        good, rest = self._split()
+        cfg = copy.deepcopy(self.space)
+        for path, dom in self._flat_space():
+            key = path  # tuple path into nested config dicts
+
+            def _get(c, p=key):
+                for part in p:
+                    c = c[part]
+                return c
+
+            if not isinstance(dom, Domain):
+                continue  # literal: already present in the copied cfg
+            if not isinstance(dom, (Uniform, LogUniform, Randint, Choice)):
+                # quantized/sample_from/custom: random draw (TPE fit
+                # over these is not implemented)
+                if isinstance(dom, SampleFrom):
+                    _set_in(cfg, path, dom.fn(cfg))
+                else:
+                    _set_in(cfg, path, dom.sample(self._rng))
+                continue
+            if isinstance(dom, Choice):
+                counts = {c: 1.0 for c in dom.categories}  # +1 smoothing
+                for g, _ in good:
+                    try:
+                        counts[_get(g)] = counts.get(_get(g), 1.0) + 1.0
+                    except (KeyError, TypeError):
+                        pass
+                total = sum(counts.values())
+                r = self._rng.uniform(0, total)
+                acc = 0.0
+                for c, w in counts.items():
+                    acc += w
+                    if r <= acc:
+                        _set_in(cfg, path, c)
+                        break
+                continue
+            # numeric: Parzen density ratio over log-space for LogUniform
+            logspace = isinstance(dom, LogUniform)
+            xform = math.log if logspace else (lambda x: x)
+            inv = math.exp if logspace else (lambda x: x)
+            def _maybe(g):
+                try:
+                    return xform(_get(g))
+                except (KeyError, TypeError):
+                    return None
+
+            g_pts = [p for p in (_maybe(g) for g, _ in good) if p is not None]
+            r_pts = [p for p in (_maybe(g) for g, _ in rest) if p is not None]
+            if not g_pts:
+                _set_in(cfg, path, dom.sample(self._rng))
+                continue
+            lo = xform(dom.low)
+            hi = xform(dom.high)
+            bw = max((hi - lo) / max(len(g_pts), 1) ** 0.5, 1e-6)
+
+            def dens(x, pts):
+                if not pts:
+                    return 1.0 / (hi - lo)
+                s = sum(
+                    math.exp(-0.5 * ((x - p) / bw) ** 2) for p in pts
+                )
+                return s / (len(pts) * bw * math.sqrt(2 * math.pi)) + 1e-12
+
+            best_x, best_ratio = None, -1.0
+            for _ in range(self.n_candidates):
+                center = self._rng.choice(g_pts)
+                x = min(max(self._rng.gauss(center, bw), lo), hi)
+                ratio = dens(x, g_pts) / dens(x, r_pts)
+                if ratio > best_ratio:
+                    best_x, best_ratio = x, ratio
+            val = inv(best_x)
+            if isinstance(dom, Randint):
+                val = int(round(min(max(val, dom.low), dom.high - 1)))
+            _set_in(cfg, path, val)
+        return cfg

@@ -139,7 +139,10 @@ class Tuner:
                     t.restore_from = t.checkpoint_path
                 trials.append(t)
             return trials
-        searcher = self.tune_config.search_alg or BasicVariantGenerator(
+        searcher = self.tune_config.search_alg
+        if searcher is not None and getattr(searcher, "adaptive", False):
+            return []  # the controller pulls configs as results arrive
+        searcher = searcher or BasicVariantGenerator(
             self.param_space, self.tune_config.num_samples, self.tune_config.seed
         )
         trials = []
@@ -168,6 +171,11 @@ class Tuner:
             resources_per_trial=self.tune_config.resources_per_trial,
             metric=self.tune_config.metric,
             mode=self.tune_config.mode,
+            searcher=(
+                self.tune_config.search_alg
+                if getattr(self.tune_config.search_alg, "adaptive", False)
+                else None
+            ),
         )
         controller.run()
         controller.save_experiment_state()

@@ -203,3 +203,34 @@ def test_tuner_over_jax_trainer(rt_start, tmp_path):
     ).fit()
     assert results.num_errors == 0
     assert results.get_best_result().metrics["m"] == pytest.approx(6.0)
+
+
+def test_tpe_searcher_beats_random_on_quadratic(rt_start, tmp_path):
+    """Adaptive TPE concentrates samples near the optimum of
+    f(x, y) = -(x-0.7)^2 - (y-0.2)^2."""
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        score = -(config["x"] - 0.7) ** 2 - (config["y"] - 0.2) ** 2
+        tune.report({"score": score})
+
+    searcher = TPESearcher(
+        {"x": tune.uniform(0, 1), "y": tune.uniform(0, 1)},
+        metric="score", mode="max", num_samples=40, n_startup=8, seed=0,
+    )
+    results = Tuner(
+        objective,
+        tune_config=TuneConfig(metric="score", mode="max",
+                               search_alg=searcher, max_concurrent_trials=4),
+        run_config=train.RunConfig(name="tpe", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    scores = sorted(
+        (r.metrics["score"] for r in results if "score" in (r.metrics or {})),
+        reverse=True,
+    )
+    assert len(scores) == 40
+    # the best of 40 adaptive samples should be well inside the bowl
+    assert scores[0] > -0.01, scores[:5]
+    # late samples concentrate: top quartile clearly better than chance
+    assert scores[9] > -0.05, scores[:10]
