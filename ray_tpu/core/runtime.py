@@ -337,8 +337,12 @@ class Runtime:
             # rides the normal path and the executor replies
             # TaskCancelledError without running the method (seq chain
             # intact)
-        # 2. pushed (or routed via noded): ask the execution side
-        self._run(self._cancel_remote(task_id, spec))
+        # 2. pushed (or routed via noded): ask the execution side —
+        # asynchronously (best-effort, like the reference): the caller
+        # must not block while an actor connection establishes
+        asyncio.run_coroutine_threadsafe(
+            self._cancel_remote(task_id, spec), self.loop
+        )
         return True
 
     async def _cancel_remote(self, task_id: bytes, spec: TaskSpec):
@@ -485,6 +489,10 @@ class Runtime:
             strategy=_strategy_from_options(options),
             name=options.get("name", getattr(fn, "__name__", "task")),
         )
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.is_enabled():
+            spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
         refs = []
         with self._state_lock:
             for oid in spec.return_ids():
@@ -754,6 +762,10 @@ class Runtime:
             actor_id=handle._actor_id,
             seq_no=handle._next_seq(),
         )
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.is_enabled():
+            spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
         refs = []
         with self._state_lock:
             for oid in spec.return_ids():
@@ -1422,6 +1434,9 @@ class Runtime:
             loop = asyncio.get_running_loop()
             self._task_local.task_id = spec.task_id
 
+            from ray_tpu.util import tracing as _tracing
+
+            trace_ctx = getattr(spec, "trace_ctx", None)
             if spec.actor_id is not None:
                 mname = spec.kwargs["__rt_method__"]
                 if mname == "__rt_dag_exec_loop__":
@@ -1438,19 +1453,22 @@ class Runtime:
                 else:
                     method = getattr(self.actor_instance, mname)
                 if asyncio.iscoroutinefunction(method):
-                    value = await method(*args, **kwargs)
+                    with _tracing.execution_span(spec.name, trace_ctx):
+                        value = await method(*args, **kwargs)
                 else:
 
                     def _call_method():
                         self._task_local.task_id = spec.task_id
-                        return method(*args, **kwargs)
+                        with _tracing.execution_span(spec.name, trace_ctx):
+                            return method(*args, **kwargs)
 
                     value = await loop.run_in_executor(self._exec_pool, _call_method)
             else:
 
                 def _call():
                     self._task_local.task_id = spec.task_id
-                    return fn(*args, **kwargs)
+                    with _tracing.execution_span(spec.name, trace_ctx):
+                        return fn(*args, **kwargs)
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
             returns = await self._package_returns(spec, value)

@@ -104,6 +104,9 @@ class TaskSpec:
     # actor linkage
     actor_id: Optional[ActorID] = None  # actor task if set
     seq_no: int = -1  # per-caller submission order for actor tasks
+    # opt-in tracing context {trace_id, span_id} (reference: trace
+    # propagation in task metadata, `tracing_helper.py:165`)
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]

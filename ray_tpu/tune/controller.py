@@ -102,6 +102,8 @@ class Trial:
     inflight: Any = None
     trial_dir: str = ""
     failures: int = 0
+    start_retries: int = 0  # resource-wait retries; distinct from the
+    # fault-tolerance failure budget
 
     def runnable(self) -> bool:
         return self.status == PENDING
@@ -267,13 +269,18 @@ class TuneController:
                         # resources from just-killed trial actors free
                         # asynchronously: stay PENDING and retry for a
                         # bounded window before declaring the request
-                        # genuinely unsatisfiable
-                        t.failures += 1
-                        if t.failures <= 150:  # ~30s of 0.2s passes
+                        # genuinely unsatisfiable (separate counter: the
+                        # user's max_failures budget is for real crashes)
+                        t.start_retries += 1
+                        if t.start_retries <= 150:  # ~30s of 0.2s passes
                             t.status = PENDING
                             time.sleep(0.2)
                             break
                     self._stop_trial(t, ERROR, f"failed to start: {e}")
+                    if self.searcher is not None:
+                        self.searcher.on_trial_complete(
+                            t.trial_id, None, error=True
+                        )
             refs = [t.inflight for t in running if t.inflight is not None]
             if not refs:
                 time.sleep(0.01)

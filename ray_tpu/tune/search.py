@@ -258,12 +258,15 @@ class TPESearcher(Searcher):
         import copy
 
         cfg = copy.deepcopy(self.space)
+        deferred = []
         for path, dom in self._flat_space():
             if isinstance(dom, SampleFrom):
-                _set_in(cfg, path, dom.fn(cfg))
+                deferred.append((path, dom))  # resolve after all draws
             elif isinstance(dom, Domain):
                 _set_in(cfg, path, dom.sample(self._rng))
             # non-Domain leaves are literals already present in cfg
+        for path, dom in deferred:
+            _set_in(cfg, path, dom.fn(cfg))
         return cfg
 
     def _split(self):
@@ -277,6 +280,7 @@ class TPESearcher(Searcher):
 
         good, rest = self._split()
         cfg = copy.deepcopy(self.space)
+        deferred = []
         for path, dom in self._flat_space():
             key = path  # tuple path into nested config dicts
 
@@ -289,26 +293,33 @@ class TPESearcher(Searcher):
                 continue  # literal: already present in the copied cfg
             if not isinstance(dom, (Uniform, LogUniform, Randint, Choice)):
                 # quantized/sample_from/custom: random draw (TPE fit
-                # over these is not implemented)
+                # over these is not implemented); sample_from defers
+                # until every other param is concrete
                 if isinstance(dom, SampleFrom):
-                    _set_in(cfg, path, dom.fn(cfg))
+                    deferred.append((path, dom))
                 else:
                     _set_in(cfg, path, dom.sample(self._rng))
                 continue
             if isinstance(dom, Choice):
-                counts = {c: 1.0 for c in dom.categories}  # +1 smoothing
+                # index-keyed weights: categories may be unhashable
+                # (lists/dicts are legal Choice members)
+                weights = [1.0] * len(dom.categories)  # +1 smoothing
                 for g, _ in good:
                     try:
-                        counts[_get(g)] = counts.get(_get(g), 1.0) + 1.0
+                        v = _get(g)
                     except (KeyError, TypeError):
-                        pass
-                total = sum(counts.values())
+                        continue
+                    for ci, c in enumerate(dom.categories):
+                        if c == v:
+                            weights[ci] += 1.0
+                            break
+                total = sum(weights)
                 r = self._rng.uniform(0, total)
                 acc = 0.0
-                for c, w in counts.items():
+                for ci, w in enumerate(weights):
                     acc += w
                     if r <= acc:
-                        _set_in(cfg, path, c)
+                        _set_in(cfg, path, dom.categories[ci])
                         break
                 continue
             # numeric: Parzen density ratio over log-space for LogUniform
@@ -349,4 +360,6 @@ class TPESearcher(Searcher):
             if isinstance(dom, Randint):
                 val = int(round(min(max(val, dom.low), dom.high - 1)))
             _set_in(cfg, path, val)
+        for path, dom in deferred:
+            _set_in(cfg, path, dom.fn(cfg))
         return cfg

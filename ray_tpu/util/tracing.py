@@ -1,0 +1,147 @@
+"""Distributed tracing: span capture with cross-task context propagation.
+
+Reference: `python/ray/util/tracing/tracing_helper.py` — opt-in
+OpenTelemetry tracing where remote calls and task execution are wrapped
+in spans and the trace context rides the task metadata
+(`_DictPropagator:165`).  The same design here without the otel
+dependency: spans are plain dicts, the context propagates inside
+`TaskSpec.trace_ctx`, and a pluggable exporter receives finished spans
+(wire an OTLP exporter there when the package exists; the default
+keeps an in-process ring readable via `get_spans`).
+
+Usage:
+    from ray_tpu.util import tracing
+    tracing.enable()           # in the driver, before submitting
+    ... rt.remote work ...
+    spans = tracing.get_spans()   # every process exports its own spans
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+_ENV_FLAG = "RT_TRACING_ENABLED"
+
+_lock = threading.Lock()
+_spans: deque = deque(maxlen=10_000)
+_exporter: Optional[Callable[[Dict[str, Any]], None]] = None
+# contextvar, NOT threading.local: async actor tasks interleave on one
+# event-loop thread and must each carry their own active span
+_ctx_var: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_trace_ctx", default=None
+)
+
+
+def enable():
+    """Turn tracing on for this process AND propagate the flag to child
+    processes (workers inherit env through the daemon spawn chain)."""
+    os.environ[_ENV_FLAG] = "1"
+
+
+def disable():
+    os.environ.pop(_ENV_FLAG, None)
+
+
+def is_enabled() -> bool:
+    return os.environ.get(_ENV_FLAG, "") == "1"
+
+
+def set_span_exporter(fn: Optional[Callable[[Dict[str, Any]], None]]):
+    """Every finished span is passed to fn (e.g. an OTLP exporter);
+    None restores the in-process ring only."""
+    global _exporter
+    _exporter = fn
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active span's (trace_id, span_id) — the parent for anything
+    submitted from here."""
+    return _ctx_var.get()
+
+
+def _record(span: Dict[str, Any]):
+    with _lock:
+        _spans.append(span)
+    if _exporter is not None:
+        try:
+            _exporter(span)
+        except Exception:
+            pass
+
+
+def make_submit_ctx(task_name: str) -> Optional[Dict[str, str]]:
+    """Called at task submission: returns the trace context to embed in
+    the spec, recording a zero-duration 'submit' span."""
+    if not is_enabled():
+        return None
+    parent = current_context()
+    trace_id = parent["trace_id"] if parent else _new_id()
+    span_id = _new_id()
+    now = time.time()
+    _record({
+        "name": f"submit:{task_name}",
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": parent["span_id"] if parent else None,
+        "start": now,
+        "end": now,
+        "kind": "PRODUCER",
+    })
+    return {"trace_id": trace_id, "span_id": span_id}
+
+
+class execution_span:
+    """Context manager wrapping task execution on the worker; nested
+    submits from inside pick up this span as their parent."""
+
+    def __init__(self, task_name: str, trace_ctx: Optional[Dict[str, str]]):
+        self._name = task_name
+        self._ctx = trace_ctx
+        self._prev = None
+        self._span: Optional[Dict[str, Any]] = None
+
+    def __enter__(self):
+        if self._ctx is None:
+            return self
+        span_id = _new_id()
+        self._span = {
+            "name": f"run:{self._name}",
+            "trace_id": self._ctx["trace_id"],
+            "span_id": span_id,
+            "parent_id": self._ctx["span_id"],
+            "start": time.time(),
+            "kind": "CONSUMER",
+        }
+        self._token = _ctx_var.set(
+            {"trace_id": self._ctx["trace_id"], "span_id": span_id}
+        )
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._span["end"] = time.time()
+            if exc_type is not None:
+                self._span["error"] = exc_type.__name__
+            _record(self._span)
+            _ctx_var.reset(self._token)
+        return False

@@ -1,0 +1,61 @@
+"""Tracing tests (reference: `tests/test_tracing.py`): spans captured
+around submit/execute with context propagation across nested tasks."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    tracing.enable()  # before init: workers inherit the env flag
+    rt.init(num_workers=2, num_cpus=4, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+    tracing.disable()
+
+
+def test_submit_spans_and_exporter(cluster):
+    tracing.clear_spans()
+    seen = []
+    tracing.set_span_exporter(seen.append)
+    try:
+        @rt.remote
+        def traced(x):
+            return x + 1
+
+        assert rt.get(traced.remote(1)) == 2
+        spans = tracing.get_spans()
+        submits = [s for s in spans if s["name"] == "submit:traced"]
+        assert len(submits) == 1
+        assert submits[0]["trace_id"] and submits[0]["parent_id"] is None
+        assert seen  # exporter received the span
+    finally:
+        tracing.set_span_exporter(None)
+
+
+def test_context_propagates_to_nested_tasks(cluster):
+    @rt.remote
+    def child():
+        return [s for s in tracing.get_spans() if s["name"] == "submit:child"]
+
+    @rt.remote
+    def parent():
+        # runs on a worker: submitting child from inside the execution
+        # span must parent it to THIS task's span
+        ref = child.remote()
+        rt.get(ref)
+        mine = [s for s in tracing.get_spans() if s["name"] == "submit:child"]
+        return mine
+
+    tracing.clear_spans()
+    child_submits = rt.get(parent.remote(), timeout=60)
+    assert child_submits, "no child submit span captured on the worker"
+    sub = child_submits[-1]
+    assert sub["parent_id"] is not None  # parented to run:parent's span
+    # same trace id as the driver's root submit for parent
+    roots = [s for s in tracing.get_spans() if s["name"] == "submit:parent"]
+    assert roots and roots[-1]["trace_id"] == sub["trace_id"]
