@@ -491,8 +491,7 @@ class Runtime:
         )
         from ray_tpu.util import tracing as _tracing
 
-        if _tracing.is_enabled():
-            spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
+        spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
         refs = []
         with self._state_lock:
             for oid in spec.return_ids():
@@ -764,8 +763,7 @@ class Runtime:
         )
         from ray_tpu.util import tracing as _tracing
 
-        if _tracing.is_enabled():
-            spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
+        spec.trace_ctx = _tracing.make_submit_ctx(spec.name)
         refs = []
         with self._state_lock:
             for oid in spec.return_ids():

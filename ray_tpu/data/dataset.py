@@ -247,6 +247,27 @@ class Dataset:
                 arrs = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
             yield arrs
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        dtypes=None,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Batches as torch tensors (reference:
+        `data/iterator.py` iter_torch_batches); dtypes maps column ->
+        torch dtype."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(np.ascontiguousarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
     def take(self, n: int = 20) -> List[Dict]:
         return list(itertools.islice(self.iter_rows(), n))
 

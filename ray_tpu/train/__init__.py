@@ -24,6 +24,7 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.trainer import (
     BaseTrainer,
     DataParallelTrainer,
@@ -48,6 +49,8 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TorchConfig",
+    "TorchTrainer",
     "TrainContext",
     "TrainingFailedError",
     "TrainingWorkerError",

@@ -263,6 +263,7 @@ class TuneController:
                 t = pending.pop(0)
                 try:
                     self._start_trial(t)
+                    t.start_retries = 0  # budget is per start attempt
                     running.append(t)
                 except Exception as e:
                     if "insufficient resources" in str(e):

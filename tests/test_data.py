@@ -198,3 +198,15 @@ def test_streaming_split_in_train_workers(rt_start, tmp_path):
     ).fit()
     assert result.error is None
     assert result.metrics["world_total"] == sum(range(80))
+
+
+def test_iter_torch_batches(rt_start):
+    import torch
+
+    ds = rd.range(100).map(lambda r: {"id": r["id"], "x": float(r["id"]) * 2})
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=25):
+        assert isinstance(batch["id"], torch.Tensor)
+        assert batch["x"].shape == (25,)
+        seen += batch["id"].shape[0]
+    assert seen == 100

@@ -233,4 +233,5 @@ def test_tpe_searcher_beats_random_on_quadratic(rt_start, tmp_path):
     # the best of 40 adaptive samples should be well inside the bowl
     assert scores[0] > -0.01, scores[:5]
     # late samples concentrate: top quartile clearly better than chance
-    assert scores[9] > -0.05, scores[:10]
+    # (uniform-random 10th-best on this bowl is typically ~-0.15)
+    assert scores[9] > -0.1, scores[:10]
