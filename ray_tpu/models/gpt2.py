@@ -40,6 +40,10 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16  # compute dtype (params stay f32)
     attention: str = "dense"  # dense | flash | ring | ulysses
     remat: bool = True
+    # remat policy: "full" recomputes the whole block backward (min
+    # memory); "dots" saves matmul outputs (checkpoint_policies
+    # dots_with_no_batch_dims_saveable) trading HBM for recompute FLOPs
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -170,7 +174,21 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             ].astype(cfg.dtype)
             return x1 + h2
 
-        fn = jax.checkpoint(one) if cfg.remat else one
+        if cfg.remat:
+            if cfg.remat_policy not in ("full", "dots"):
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; "
+                    "expected 'full' or 'dots'"
+                )
+            if cfg.remat_policy == "dots":
+                fn = jax.checkpoint(
+                    one,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )
+            else:
+                fn = jax.checkpoint(one)
+        else:
+            fn = one
         return fn(x), None
 
     x = x.astype(cfg.dtype)
