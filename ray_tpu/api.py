@@ -114,11 +114,48 @@ def _wait_ready(ready_file: str, proc, timeout: float = 60.0) -> Dict:
 
 
 def _resolve_address(address: str) -> Dict:
+    if address == "auto":
+        # newest live session on this host (reference: ray.init("auto")
+        # via the bootstrap address file)
+        import glob
+
+        base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+
+        def _mtime(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0  # deleted between glob and sort
+
+        candidates = sorted(
+            glob.glob(os.path.join(base, "session_*", "ready.json"))
+            + glob.glob(os.path.join(base, "cluster_*", "node_*", "ready.json")),
+            key=_mtime,
+            reverse=True,
+        )
+        import socket as _socket
+
+        for path in candidates:
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+                # liveness = an accepting socket, not a leftover file
+                # (SIGKILLed daemons never unlink theirs)
+                s = _socket.socket(_socket.AF_UNIX)
+                s.settimeout(1.0)
+                try:
+                    s.connect(info["socket_path"])
+                finally:
+                    s.close()
+                return info
+            except (OSError, ValueError, KeyError):
+                continue
+        raise exc.RayTpuError("address='auto': no live cluster found")
     if os.path.exists(address):
         with open(address) as f:
             return json.load(f)
     raise exc.RayTpuError(
-        "address must be a ready-file path of a running cluster for now"
+        "address must be a ready-file path of a running cluster (or 'auto')"
     )
 
 

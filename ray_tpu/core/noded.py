@@ -867,6 +867,10 @@ class NodeDaemon:
     # shutdown
     # ------------------------------------------------------------------
     async def shutdown(self):
+        try:
+            os.remove(self.socket_path)  # 'auto' discovery hygiene
+        except OSError:
+            pass
         if self.controller is not None:
             self.controller.flush_snapshot()
         self._draining = True

@@ -197,6 +197,50 @@ class DashboardHead:
 
             await loop.run_in_executor(None, _delete)
             return httpd.json_response({"ok": True})
+        if path == "/api/logs":
+            # session log browser (reference: `dashboard/modules/log/`);
+            # filesystem walks/reads run off the loop like every other
+            # blocking handler here
+            loop = asyncio.get_running_loop()
+            file = req.query_params.get("file")
+            if file:
+                def _tail():
+                    import os
+
+                    base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+                    # constrain to the session tree — no path escapes
+                    full = os.path.realpath(os.path.join(base, file))
+                    if not full.startswith(os.path.realpath(base) + os.sep):
+                        return None
+                    try:
+                        with open(full, "rb") as f:
+                            f.seek(0, os.SEEK_END)
+                            size = f.tell()
+                            f.seek(max(0, size - 64 * 1024))
+                            return f.read()
+                    except OSError:
+                        return None
+
+                data = await loop.run_in_executor(None, _tail)
+                if data is None:
+                    return 404, "text/plain", b"not found"
+                return 200, "text/plain; charset=utf-8", data
+
+            def _list():
+                import glob
+                import os
+
+                base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+                return sorted(
+                    os.path.relpath(p, base)
+                    for p in glob.glob(base + "/**/*", recursive=True)
+                    if os.path.isfile(p)
+                    and (p.endswith(".out") or p.endswith(".log"))
+                )
+
+            return httpd.json_response(
+                await loop.run_in_executor(None, _list)
+            )
         if path == "/metrics":
             from ray_tpu.util.metrics import export_text
 

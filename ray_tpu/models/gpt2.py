@@ -45,6 +45,13 @@ class GPT2Config:
     # dots_with_no_batch_dims_saveable) trading HBM for recompute FLOPs
     remat_policy: str = "full"
 
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "expected 'full' or 'dots'"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
@@ -175,11 +182,6 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             return x1 + h2
 
         if cfg.remat:
-            if cfg.remat_policy not in ("full", "dots"):
-                raise ValueError(
-                    f"unknown remat_policy {cfg.remat_policy!r}; "
-                    "expected 'full' or 'dots'"
-                )
             if cfg.remat_policy == "dots":
                 fn = jax.checkpoint(
                     one,

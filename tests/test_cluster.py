@@ -117,3 +117,23 @@ def test_actor_restarts_on_surviving_node(cluster):
             last_err = e
         time.sleep(0.5)
     raise AssertionError(f"actor never restarted: {last_err}")
+
+
+def test_init_auto_discovers_cluster(cluster):
+    """ray_tpu.init(address='auto') joins the newest live cluster from a
+    separate driver process (reference: ray.init('auto'))."""
+    import subprocess
+    import sys
+
+    code = (
+        "import ray_tpu as rt\n"
+        "rt.init(address='auto')\n"
+        "print('nodes:', len([n for n in rt.nodes() if n['alive']]))\n"
+        "rt.shutdown()\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "nodes: 1" in out.stdout, out.stdout

@@ -129,3 +129,20 @@ def test_serve_rest_deploy(dash, tmp_path):
         serve.shutdown()
     finally:
         sys.path.remove(mod_dir)
+
+
+def test_logs_endpoint(dash):
+    _, body = _get(dash + "/api/logs")
+    files = json.loads(body)
+    assert any(f.endswith("noded.out") for f in files), files[:5]
+    target = next(f for f in files if f.endswith("noded.out"))
+    import urllib.parse
+
+    status, body = _get(dash + "/api/logs?file=" + urllib.parse.quote(target))
+    assert status == 200 and b"noded" in body
+    # traversal is rejected
+    try:
+        _get(dash + "/api/logs?file=../../etc/hostname")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
