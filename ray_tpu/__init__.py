@@ -13,6 +13,7 @@ from ray_tpu import exceptions
 from ray_tpu.api import (
     ActorClass,
     ActorHandle,
+    ObjectRefGenerator,
     RemoteFunction,
     available_resources,
     cancel,
@@ -44,6 +45,7 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "available_resources",
     "cancel",

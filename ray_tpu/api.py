@@ -9,18 +9,25 @@ products, so reference users find the same call shapes.
 from __future__ import annotations
 
 import atexit
+import inspect
 import json
 import os
 import subprocess
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu import exceptions as exc
 from ray_tpu.core.config import Config, set_config
 from ray_tpu.core.ids import ActorID
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.runtime import Runtime, get_runtime, is_initialized, set_runtime
+from ray_tpu.core.runtime import (
+    ObjectRefGenerator,
+    Runtime,
+    get_runtime,
+    is_initialized,
+    set_runtime,
+)
 
 _session: Dict[str, Any] = {}
 _init_lock = threading.Lock()
@@ -54,6 +61,14 @@ def init(
                 return _session.get("info")
             raise exc.RayTpuError("ray_tpu.init() called twice")
 
+        # workers spawned anywhere in this session adopt the driver's
+        # sys.path (see worker_main) so by-reference pickles resolve
+        import sys as _sys
+
+        os.environ["RT_DRIVER_SYS_PATH"] = json.dumps(
+            [p for p in _sys.path if p]
+        )
+
         cfg = Config().apply_env_overrides()
         if _system_config:
             cfg.apply_dict(_system_config)
@@ -86,6 +101,13 @@ def init(
         set_runtime(rt)
         rt.controller_call(
             "register_job", {"job_id": rt.job_id.hex(), "pid": os.getpid()}
+        )
+        # joining drivers can't reach pre-existing workers through the
+        # spawn env — publish sys.path in the KV too; executors extend
+        # their path from it on ModuleNotFoundError and retry
+        rt.kv_put(
+            "driver:sys_path",
+            json.dumps([p for p in _sys.path if p]).encode(),
         )
         _session["info"] = info
         atexit.register(shutdown)
@@ -186,6 +208,12 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    if isinstance(refs, ObjectRefGenerator):
+        raise TypeError(
+            "get() does not accept an ObjectRefGenerator — iterate it "
+            "and get() each yielded ObjectRef (reference: ray.get raises "
+            "the same way on streaming generators)"
+        )
     return get_runtime().get(refs, timeout=timeout)
 
 
@@ -212,9 +240,18 @@ class RemoteFunction:
         self.__name__ = getattr(fn, "__name__", "remote_function")
 
     def remote(self, *args, **kwargs):
-        refs = get_runtime().submit_task(self._fn, list(args), kwargs, **self._options)
-        n = self._options.get("num_returns", 1)
-        return refs[0] if n == 1 else refs
+        opts = self._options
+        n = opts.get("num_returns", 1)
+        if n == 1 and (inspect.isgeneratorfunction(self._fn)
+                       or inspect.isasyncgenfunction(self._fn)):
+            # generator functions stream by default (reference:
+            # streaming generators in `_raylet.pyx` / task_manager.h:208)
+            opts = dict(opts)
+            n = opts["num_returns"] = "streaming"
+        out = get_runtime().submit_task(self._fn, list(args), kwargs, **opts)
+        if n == "streaming":
+            return out  # ObjectRefGenerator
+        return out[0] if n == 1 else out
 
     def bind(self, *args, **kwargs):
         """Build a task-DAG node instead of executing (reference:
@@ -246,11 +283,17 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def remote(self, *args, **kwargs):
-        refs = get_runtime().submit_actor_task(
-            self._handle, self._name, list(args), kwargs,
-            num_returns=self._num_returns,
+        n = self._num_returns
+        if n == 1 and self._name in getattr(
+            self._handle, "_streaming_methods", ()
+        ):
+            n = "streaming"
+        out = get_runtime().submit_actor_task(
+            self._handle, self._name, list(args), kwargs, num_returns=n,
         )
-        return refs[0] if self._num_returns == 1 else refs
+        if n == "streaming":
+            return out  # ObjectRefGenerator
+        return out[0] if n == 1 else out
 
     def bind(self, *args, **kwargs):
         """Build a compiled-graph node instead of executing (reference:
@@ -268,11 +311,15 @@ class ActorHandle:
     ordered delivery via process-wide sequence numbers."""
 
     def __init__(self, actor_id: ActorID, address, class_name: str,
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 streaming_methods: Tuple[str, ...] = ()):
         self._actor_id = actor_id
         self._address = address  # (node_id, worker_id)
         self._class_name = class_name
         self._max_task_retries = max_task_retries
+        # method names defined as (async) generators: their calls
+        # stream by default, like generator remote functions
+        self._streaming_methods = tuple(streaming_methods)
 
     def _next_seq(self) -> int:
         with _seq_lock:
@@ -293,6 +340,7 @@ class ActorHandle:
                 self._address,
                 self._class_name,
                 self._max_task_retries,
+                self._streaming_methods,
             ),
         )
 
@@ -300,8 +348,10 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
 
 
-def _rebuild_handle(aid_bytes, address, class_name, max_task_retries):
-    return ActorHandle(ActorID(aid_bytes), address, class_name, max_task_retries)
+def _rebuild_handle(aid_bytes, address, class_name, max_task_retries,
+                    streaming_methods=()):
+    return ActorHandle(ActorID(aid_bytes), address, class_name,
+                       max_task_retries, streaming_methods)
 
 
 class ActorClass:
@@ -312,7 +362,9 @@ class ActorClass:
         self._options = options
 
     def remote(self, *args, **kwargs) -> ActorHandle:
-        actor_id, address = get_runtime().create_actor(
+        # streaming-method discovery lives in create_actor (recorded in
+        # the spec so get_actor-rebuilt handles agree with this one)
+        actor_id, address, streaming = get_runtime().create_actor(
             self._cls, list(args), kwargs, **self._options
         )
         return ActorHandle(
@@ -320,6 +372,7 @@ class ActorClass:
             address,
             self._cls.__name__,
             self._options.get("max_task_retries", 0),
+            streaming,
         )
 
     def options(self, **opts) -> "ActorClass":
@@ -361,6 +414,7 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
         info["address"],
         name,
         info.get("max_task_retries", 0),
+        tuple(info.get("streaming_methods", ())),
     )
 
 

@@ -40,8 +40,9 @@ class AutoscalerConfig:
 
 
 class StandardAutoscaler:
-    LAUNCH_COOLDOWN_S = 10.0  # a just-launched node absorbs its demand
-    # before the still-fresh demand signature can trigger a duplicate
+    ABSORB_MAX_S = 60.0  # safety valve: a launch absorbs matching demand
+    # until demand clears, but never longer than this (stuck demand that
+    # genuinely needs more nodes gets another chance)
 
     def __init__(self, provider: NodeProvider, config: AutoscalerConfig):
         self.provider = provider
@@ -84,23 +85,40 @@ class StandardAutoscaler:
         now = time.time()
 
         # 1. scale up for unmet demand: demand is pending because no
-        # node fits it — launch the first node type that would.  A node
-        # launched within the cooldown that fits the demand absorbs it;
-        # without this, the demand signature (fresh for ~5s after the
-        # last report) would trigger duplicate launches.
-        self._recent_launches = [
-            (ts, prov) for ts, prov in self._recent_launches
-            if now - ts < self.LAUNCH_COOLDOWN_S
-        ]
+        # node fits it.  Launched nodes absorb demand via bin-packing —
+        # each recent launch's capacity is consumed by the demands it
+        # can serve, and only the remainder triggers new launches.  The
+        # demand signature stays "pending" in controller state until the
+        # work is actually scheduled, so launches keep absorbing until
+        # the demand list clears (not a fixed cooldown, which double-
+        # launches whenever node startup + scheduling outlasts it).
         demands: List[Dict[str, float]] = state["pending_demands"]
+        if not demands:
+            self._recent_launches = []
+        else:
+            self._recent_launches = [
+                (ts, prov) for ts, prov in self._recent_launches
+                if now - ts < self.ABSORB_MAX_S
+            ]
         counts: Dict[str, int] = {}
         for p, (tname, _) in self._managed.items():
             counts[tname] = counts.get(tname, 0) + 1
+        # remaining capacity of launches still absorbing demand
+        spare: List[Dict[str, float]] = [
+            dict(prov) for _, prov in self._recent_launches
+        ]
         for demand in demands:
+            absorbed = False
+            for cap in spare:
+                if _fits(demand, cap):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    absorbed = True
+                    break
+            if absorbed:
+                continue
             if self.num_managed() >= self.config.max_workers:
                 break
-            if any(_fits(demand, prov) for _, prov in self._recent_launches):
-                continue
             for tname, tcfg in self.config.node_types.items():
                 if not _fits(demand, tcfg.provides()):
                     continue
@@ -108,6 +126,10 @@ class StandardAutoscaler:
                     continue
                 self._launch(tname)
                 self._recent_launches.append((now, tcfg.provides()))
+                cap = tcfg.provides()
+                for k, v in demand.items():
+                    cap[k] = cap.get(k, 0.0) - v
+                spare.append(cap)
                 counts[tname] = counts.get(tname, 0) + 1
                 break
         if demands:

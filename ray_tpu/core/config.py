@@ -63,6 +63,18 @@ class Config:
     #: scheduler_spread_threshold)
     scheduler_spread_threshold: float = 0.5
 
+    # ---- memory monitor / OOM killer ---------------------------------
+    #: period between node memory polls; 0 disables the monitor
+    #: (reference memory_monitor_refresh_ms, `ray_config_def.h`)
+    memory_monitor_refresh_ms: int = 1000
+    #: node memory fraction above which a busy task worker is killed
+    #: instead of risking the kernel OOM killer (reference
+    #: memory_usage_threshold)
+    memory_usage_threshold: float = 0.97
+    #: victim selection: retriable_lifo | group_by_owner (reference
+    #: worker_killing_policy.h:34)
+    worker_killing_policy: str = "retriable_lifo"
+
     # ---- health / fault tolerance ------------------------------------
     #: period between controller->node health probes (reference
     #: health_check_period_ms, `ray_config_def.h:843`)

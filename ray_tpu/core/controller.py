@@ -360,6 +360,9 @@ class Controller:
             "address": info.address,
             "class_blob": info.spec.class_blob,
             "max_task_retries": info.spec.max_task_retries,
+            "streaming_methods": tuple(
+                getattr(info.spec, "streaming_methods", ()) or ()
+            ),
             "death_cause": info.death_cause,
         }
 
@@ -533,17 +536,21 @@ class Controller:
     # ---- spillback target query (used by noded schedulers) ----------
     async def handle_find_node_for(self, payload, conn):
         """Cluster-level placement for spilled-back leases (reference:
-        `cluster_task_manager.cc:44` spillback)."""
+        `cluster_task_manager.cc:44` spillback).  With spread=True,
+        feasible nodes are taken round-robin (reference:
+        `spread_scheduling_policy.h:27`)."""
         demand = payload["resources"]
         exclude = set(payload.get("exclude", []))
-        best = None
-        for n in self.nodes.values():
-            if not n.alive or n.node_id in exclude:
-                continue
-            if _fits(demand, n.resources):
-                if best is None or sum(n.resources.values()) > sum(
-                    best.resources.values()
-                ):
-                    best = n
-        return best.node_id if best else None
+        feasible = [
+            n for n in self.nodes.values()
+            if n.alive and n.node_id not in exclude
+            and _fits(demand, n.resources)
+        ]
+        if not feasible:
+            return None
+        if payload.get("spread"):
+            feasible.sort(key=lambda n: n.node_id)
+            self._spread_rr = getattr(self, "_spread_rr", 0) + 1
+            return feasible[self._spread_rr % len(feasible)].node_id
+        return max(feasible, key=lambda n: sum(n.resources.values())).node_id
 

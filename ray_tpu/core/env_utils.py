@@ -28,6 +28,22 @@ def infra_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     return env
 
 
+def adopt_sys_path(paths) -> bool:
+    """Prepend the driver's sys.path entries (those that exist here and
+    aren't present yet), preserving their order.  Shared by the
+    spawn-env path (worker_main) and the KV retry path (runtime) so the
+    adoption policy cannot diverge.  Returns True if anything was
+    added."""
+    import sys
+
+    added = False
+    for p in reversed(list(paths)):
+        if p and p not in sys.path and os.path.isdir(p):
+            sys.path.insert(0, p)
+            added = True
+    return added
+
+
 def worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """Env for spawning a worker: restore the axon marker unless the
     session runs on CPU (JAX_PLATFORMS=cpu — the test configuration),

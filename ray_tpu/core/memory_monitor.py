@@ -1,0 +1,151 @@
+"""Node memory monitor + OOM worker-killing policies.
+
+Reference: `src/ray/common/memory_monitor.h:52` (`MemoryMonitor`,
+`IsUsageAboveThreshold:110`) polls cgroup/system memory on a timer and
+drives the raylet's `WorkerKillingPolicy` (`worker_killing_policy.h:34`)
+— when the node crosses the usage threshold, a worker running
+retriable work is killed instead of letting the kernel OOM killer take
+down the daemon.  Policies mirror the reference's retriable-LIFO
+(newest retriable task first) and group-by-owner
+(`worker_killing_policy_group_by_owner.h`) shapes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+# cgroup v2 / v1 locations (reference reads the same files,
+# memory_monitor.cc GetCGroupMemoryBytes)
+_CGV2_LIMIT = "/sys/fs/cgroup/memory.max"
+_CGV2_USED = "/sys/fs/cgroup/memory.current"
+_CGV1_LIMIT = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+_CGV1_USED = "/sys/fs/cgroup/memory/memory.usage_in_bytes"
+
+# a cgroup "limit" at or beyond this is "no limit" (v1 reports a huge
+# number, v2 reports the string "max" which we map to None)
+_NO_LIMIT = 1 << 60
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        if raw == "max":
+            return None
+        v = int(raw)
+        return None if v >= _NO_LIMIT else v
+    except (OSError, ValueError):
+        return None
+
+
+def _system_memory() -> Tuple[int, int]:
+    """(used, total) from /proc/meminfo, using MemAvailable the way the
+    reference does (memory_monitor.cc GetLinuxMemoryBytes)."""
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        pass
+    if total is None:
+        return (0, 1)
+    if avail is None:
+        avail = total
+    return (total - avail, total)
+
+
+class MemoryMonitor:
+    """Polls memory usage; cgroup-aware (container limits win over the
+    host's when tighter)."""
+
+    def __init__(self, usage_threshold: float = 0.95,
+                 min_breaches: int = 2):
+        self.usage_threshold = usage_threshold
+        # consecutive breaches required before reporting (debounce, the
+        # reference's monitor fires on a sustained signal, not a blip)
+        self.min_breaches = min_breaches
+        self._breaches = 0
+
+    def get_memory_usage(self) -> Tuple[int, int]:
+        """(used_bytes, total_bytes) — the binding constraint."""
+        sys_used, sys_total = _system_memory()
+        cg_limit = _read_int(_CGV2_LIMIT)
+        cg_used = _read_int(_CGV2_USED)
+        if cg_limit is None:
+            cg_limit = _read_int(_CGV1_LIMIT)
+            cg_used = _read_int(_CGV1_USED)
+        if cg_limit is not None and cg_used is not None and cg_limit < sys_total:
+            return (cg_used, cg_limit)
+        return (sys_used, sys_total)
+
+    def usage_fraction(self) -> float:
+        used, total = self.get_memory_usage()
+        return used / max(total, 1)
+
+    def is_usage_above_threshold(self) -> bool:
+        """Debounced threshold check; call once per refresh interval."""
+        if self.usage_fraction() > self.usage_threshold:
+            self._breaches += 1
+        else:
+            self._breaches = 0
+        return self._breaches >= self.min_breaches
+
+    def reset(self):
+        """Restart the debounce — call after acting on a breach, so one
+        sustained breach triggers one kill, not one per poll while the
+        kernel catches up reclaiming the victim's pages."""
+        self._breaches = 0
+
+
+def pick_oom_victim(workers: List, policy: str = "retriable_lifo"):
+    """Choose the worker to kill when the node is over its memory
+    threshold, or None.
+
+    Only busy task workers are candidates: actors are stateful (their
+    death is a restart, not a retry) and idle workers free ~nothing.
+    `retriable_lifo` kills the most recently busied worker — the newest
+    work loses the least progress (reference: retriable-FIFO-by-task-
+    age policy, `worker_killing_policy.h:34`).  `group_by_owner` kills
+    the newest worker of the owner with the most busy workers, spreading
+    the pain across jobs (`worker_killing_policy_group_by_owner.h`).
+    """
+    candidates = [
+        w for w in workers
+        if w.kind == "worker" and w.actor_id is None and not w.idle
+        and getattr(w, "oom_killed_at", None) is None  # SIGKILL already
+        # sent; the daemon reaps it on conn loss — don't re-pick it
+    ]
+    if not candidates:
+        return None
+
+    def _retriable(w) -> bool:
+        # known-non-retriable only when every daemon-dispatched task on
+        # the worker has no retry budget; leased workers' direct-pushed
+        # tasks are invisible here — assume retriable (tasks default to
+        # retries > 0)
+        specs = list(w.in_flight.values())
+        if not specs:
+            return True
+        return any(getattr(s, "max_retries", 1) > 0 for s in specs)
+
+    retriable = [w for w in candidates if _retriable(w)]
+    if retriable:  # kill retriable work first; non-retriable is a
+        candidates = retriable  # permanent user-visible failure
+    if policy == "group_by_owner":
+        groups = {}
+        for w in candidates:
+            owner = next(
+                (spec.owner for spec in w.in_flight.values()), None
+            )
+            groups.setdefault(owner, []).append(w)
+        biggest = max(groups.values(), key=len)
+        candidates = biggest
+    return max(candidates, key=lambda w: getattr(w, "busy_since", 0.0) or 0.0)

@@ -37,7 +37,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID
-from ray_tpu.core.task_spec import ActorCreationSpec, Resources, TaskResult, TaskSpec, fits as _fits
+from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits
 from ray_tpu.shm import ShmStore
 
 logger = logging.getLogger(__name__)
@@ -57,6 +57,8 @@ class WorkerState:
     leased_to: Optional[str] = None  # worker_id of the lease holder
     in_flight: Dict[bytes, TaskSpec] = field(default_factory=dict)
     proc: Optional[subprocess.Popen] = None
+    busy_since: Optional[float] = None  # OOM victim ordering (LIFO)
+    oom_killed_at: Optional[float] = None  # SIGKILL sent; awaiting reap
 
     @property
     def idle(self):
@@ -161,6 +163,8 @@ class NodeDaemon:
         for _ in range(self.num_workers):
             self._spawn_worker()
         asyncio.ensure_future(self._retry_queue_loop())
+        if self.cfg.memory_monitor_refresh_ms > 0:
+            asyncio.ensure_future(self._memory_monitor_loop())
         logger.info(
             "noded %s up: %d workers, resources=%s",
             self.node_name,
@@ -317,9 +321,14 @@ class NodeDaemon:
         elif strat.kind == "spread":
             target = await self.controller_conn.call(
                 "find_node_for",
-                {"resources": spec.resources.as_dict(), "exclude": []},
+                {"resources": spec.resources.as_dict(), "exclude": [],
+                 "spread": True},
             )
             if target is not None and target != self.node_id:
+                # the choice is made exactly once: the receiving daemon
+                # must queue locally, not re-roll the round-robin (which
+                # would ping-pong the task between nodes forever)
+                spec.strategy = SchedulingStrategy()
                 (await self._node_conn(target)).send("submit_task", spec)
                 return
         self.task_queue.append(spec)
@@ -375,6 +384,8 @@ class NodeDaemon:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) - v
             w.lease = demand
+        if w.busy_since is None:
+            w.busy_since = time.time()
         w.in_flight[spec.task_id.binary()] = spec
         w.conn.send("execute_task", spec)
 
@@ -383,6 +394,48 @@ class NodeDaemon:
             for k, v in w.lease.items():
                 self.available[k] = self.available.get(k, 0.0) + v
             w.lease = None
+        if w.idle:
+            w.busy_since = None
+
+    async def _memory_monitor_loop(self):
+        """Poll node memory; kill a busy task worker when over the
+        threshold (reference: `memory_monitor.h:52` driving
+        `worker_killing_policy.h:34` in the raylet).  The killed
+        worker's tasks fail back to their owners as worker_died —
+        retriable work retries (possibly elsewhere), and the node
+        survives instead of the kernel OOM killer taking the daemon."""
+        from ray_tpu.core.memory_monitor import MemoryMonitor, pick_oom_victim
+
+        monitor = MemoryMonitor(self.cfg.memory_usage_threshold)
+        period = self.cfg.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                if not monitor.is_usage_above_threshold():
+                    continue
+                victim = pick_oom_victim(
+                    list(self.workers.values()),
+                    self.cfg.worker_killing_policy,
+                )
+                if victim is None:
+                    continue
+                used, total = monitor.get_memory_usage()
+                logger.warning(
+                    "memory usage %.1f%% above threshold %.1f%%: killing "
+                    "worker %s (policy=%s) to free memory",
+                    100 * used / max(total, 1),
+                    100 * self.cfg.memory_usage_threshold,
+                    victim.worker_id[:8],
+                    self.cfg.worker_killing_policy,
+                )
+                victim.oom_killed_at = time.time()
+                monitor.reset()  # one kill per sustained breach
+                try:
+                    os.kill(victim.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            except Exception:
+                logger.exception("memory monitor pass failed")
 
     async def _retry_queue_loop(self):
         """Periodic housekeeping: re-attempt queued-but-infeasible tasks
@@ -591,6 +644,7 @@ class NodeDaemon:
                     self.available[k] = self.available.get(k, 0.0) - v
                 w.lease = dict(demand)
                 w.leased_to = holder
+                w.busy_since = time.time()
                 return (w.worker_id, w.socket_path)
         if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
             self._spawn_worker()
@@ -638,6 +692,26 @@ class NodeDaemon:
     # worker replies arrive as task_result on its registration conn for
     # tasks this daemon dispatched (spillback / relayed actor tasks)
     handle_task_result = handle_task_done
+
+    async def handle_task_stream(self, payload, conn):
+        """Relay one streaming-generator item to the task's owner (used
+        when the executor's direct conn to the owner is gone, and for
+        daemon-dispatched tasks whose items arrive on the worker's
+        registration conn)."""
+        await self._route_to_owner(payload["owner"], "stream_item", payload)
+
+    handle_stream_item = handle_task_stream
+
+    async def handle_stream_cancel(self, payload, conn):
+        """Abandoned-stream stop signal for a daemon-dispatched task:
+        the owner doesn't know which worker runs it — fan out to local
+        workers (a no-op on the ones not running it)."""
+        for w in list(self.workers.values()):
+            if w.conn and not w.conn.closed and not w.idle:
+                try:
+                    w.conn.send("stream_cancel", payload)
+                except Exception:
+                    pass
 
     async def _route_to_owner(self, owner: Tuple[str, str], method: str, payload):
         node_id, worker_id = owner

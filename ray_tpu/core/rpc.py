@@ -8,7 +8,10 @@ length-prefixed pickled frames — the control plane carries small
 metadata messages only (bulk data rides the shm store / chunked object
 transfer), so codec simplicity beats schema rigor here.
 
-Frame format: [8 bytes LE length][pickled (msg_id, kind, method, payload)]
+Frame format: [8B LE length][struct envelope: msg_id u64, kind u8,
+method_len u16][method utf-8][payload cloudpickle] — the envelope rides
+OUTSIDE the pickle so an undeserializable payload fails one message,
+never the connection
 kind: 0 = request, 1 = reply, 2 = one-way.
 """
 
@@ -51,16 +54,27 @@ class RemoteError(RpcError):
         self.exc = exc
 
 
+# envelope rides OUTSIDE the pickled payload so a payload that fails to
+# deserialize (e.g. references a module only the sender can import) is
+# an error on that one message, not a torn connection
+_ENV = struct.Struct("<QBH")  # msg_id, kind, len(method)
+
+
 async def read_frame(reader: asyncio.StreamReader):
+    """Returns (msg_id, kind, method, payload_bytes) — the payload is
+    NOT deserialized here; the recv loop does that per-message so a bad
+    payload cannot take down the framing."""
     hdr = await reader.readexactly(8)
     (length,) = _LEN.unpack(hdr)
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     data = await reader.readexactly(length)
-    return pickle.loads(data)
+    msg_id, kind, mlen = _ENV.unpack_from(data)
+    method = data[_ENV.size:_ENV.size + mlen].decode()
+    return msg_id, kind, method, data[_ENV.size + mlen:]
 
 
-def frame_bytes(msg) -> bytes:
+def frame_bytes(msg_id: int, kind: int, method: str, payload) -> bytes:
     # cloudpickle, not stdlib pickle: task args/replies may hold
     # functions defined in the driver's __main__ (or lambdas/closures),
     # which stdlib pickle serializes BY REFERENCE — the receiving
@@ -68,8 +82,14 @@ def frame_bytes(msg) -> bytes:
     # silently bind the wrong symbol).  cloudpickle serializes such
     # objects by value.  ~2.7us/frame overhead vs stdlib on small
     # control messages (measured), bulk data rides the object plane.
-    payload = _dumps_oob(msg)
-    return _LEN.pack(len(payload)) + payload
+    blob = _dumps_oob(payload)
+    m = method.encode()
+    return (
+        _LEN.pack(_ENV.size + len(m) + len(blob))
+        + _ENV.pack(msg_id, kind, len(m))
+        + m
+        + blob
+    )
 
 
 class Connection:
@@ -104,8 +124,8 @@ class Connection:
         return self
 
     # ---- sending -----------------------------------------------------
-    def _enqueue(self, msg):
-        data = frame_bytes(msg)
+    def _enqueue(self, msg_id, kind, method, payload):
+        data = frame_bytes(msg_id, kind, method, payload)
         with self._outbox_lock:
             self._outbox.append(data)
             if self._flush_scheduled:
@@ -119,7 +139,7 @@ class Connection:
         io loop — pipelined submissions coalesce into few syscalls."""
         if self._closed:
             raise ConnectionLost(f"connection to {self.name} closed")
-        data = frame_bytes((0, ONEWAY, method, payload))
+        data = frame_bytes(0, ONEWAY, method, payload)
         with self._outbox_lock:
             self._outbox.append(data)
             if self._flush_scheduled:
@@ -145,7 +165,7 @@ class Connection:
         msg_id = next(self._ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        self._enqueue((msg_id, REQUEST, method, payload))
+        self._enqueue(msg_id, REQUEST, method, payload)
         try:
             return await (asyncio.wait_for(fut, timeout) if timeout else fut)
         finally:
@@ -155,13 +175,33 @@ class Connection:
         """Fire-and-forget."""
         if self._closed:
             raise ConnectionLost(f"connection to {self.name} closed")
-        self._enqueue((0, ONEWAY, method, payload))
+        self._enqueue(0, ONEWAY, method, payload)
 
     # ---- receiving ---------------------------------------------------
     async def _recv_loop(self):
         try:
             while True:
-                msg_id, kind, method, payload = await read_frame(self.reader)
+                msg_id, kind, method, blob = await read_frame(self.reader)
+                try:
+                    payload = pickle.loads(blob)
+                except Exception as de:  # noqa: BLE001 — isolate per message
+                    # a payload only the sender can deserialize (e.g. a
+                    # function pickled by reference to a module missing
+                    # here) fails THIS message, not the connection
+                    if kind == REQUEST:
+                        self._enqueue(msg_id, REPLY, "__error__",
+                                      RpcError(f"{method}: undeserializable "
+                                               f"payload: {de!r}"))
+                    elif kind == REPLY:
+                        fut = self._pending.get(msg_id)
+                        if fut and not fut.done():
+                            fut.set_exception(
+                                RpcError(f"{method}: undeserializable "
+                                         f"reply: {de!r}"))
+                    else:
+                        logger.warning("dropping undeserializable one-way "
+                                       "%s from %s: %r", method, self.name, de)
+                    continue
                 if kind == REPLY:
                     fut = self._pending.get(msg_id)
                     if fut and not fut.done():
@@ -186,18 +226,18 @@ class Connection:
             result = await self.handler(method, payload, self)
             if msg_id is not None:
                 try:
-                    self._enqueue((msg_id, REPLY, method, result))
+                    self._enqueue(msg_id, REPLY, method, result)
                 except Exception as pe:
                     # unpicklable result: the caller must not hang
-                    self._enqueue((msg_id, REPLY, "__error__",
-                                   RpcError(f"unpicklable reply from {method}: {pe!r}")))
+                    self._enqueue(msg_id, REPLY, "__error__",
+                                  RpcError(f"unpicklable reply from {method}: {pe!r}"))
         except Exception as e:
             if msg_id is not None:
                 try:
-                    self._enqueue((msg_id, REPLY, "__error__", e))
+                    self._enqueue(msg_id, REPLY, "__error__", e)
                 except Exception:
-                    self._enqueue((msg_id, REPLY, "__error__",
-                                   RpcError(f"{method} failed: {e!r}")))
+                    self._enqueue(msg_id, REPLY, "__error__",
+                                  RpcError(f"{method} failed: {e!r}"))
             else:
                 logger.exception("one-way handler %s failed", method)
 

@@ -44,6 +44,7 @@ from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import (
+    STREAMING,
     ActorCreationSpec,
     ArgRef,
     Resources,
@@ -76,6 +77,32 @@ class _ObjectState:
     node_id: Optional[str] = None  # location when in shm
     size: int = 0
     error: Optional[bytes] = None  # serialized error envelope
+
+
+@dataclass
+class _StreamState:
+    """Owner-side record of one streaming-generator task.
+
+    Reference: the streaming-generator refs the TaskManager tracks
+    (`src/ray/core_worker/task_manager.h:208` — generator returns are
+    dynamically appended as the executor yields).  Items arrive as
+    `stream_item` messages ahead of the final `task_result`; each item
+    becomes an owned object (inline or shm) addressable by
+    `ObjectID.for_return(task_id, index)`.
+    """
+
+    event: asyncio.Event
+    # yield-index -> item ref: keyed (not appended) so delivery-path
+    # switches mid-stream (direct conn -> daemon relay) or retry replays
+    # can never reorder consumption — the consumer always takes index
+    # consumed+1
+    items: Dict[int, "ObjectRef"] = field(default_factory=dict)
+    consumed: int = 0
+    total: Optional[int] = None  # set by the final ok task_result
+    error: Optional[bytes] = None  # error envelope ends the stream
+    # set once when the producing task finishes (ok or error) — for
+    # completion watchers that must not race the consumer's `event`
+    done: asyncio.Event = field(default_factory=asyncio.Event)
 
 
 @dataclass
@@ -156,6 +183,7 @@ class Runtime:
         self.refs: Dict[bytes, _RefCount] = {}
         self.pending_tasks: Dict[bytes, _PendingTask] = {}
         self.lineage: Dict[bytes, TaskSpec] = {}  # return id -> creating spec
+        self._streams: Dict[bytes, _StreamState] = {}  # task id -> stream
 
         # lease-based submission
         self._pools: Dict[tuple, _LeasePool] = {}
@@ -472,9 +500,9 @@ class Runtime:
         fid, blob = self._export_function(fn)
         task_id = TaskID.for_job(self.job_id)
         num_returns = options.get("num_returns", 1)
-        resolved = self._resolve_args_sync(args)
-        if resolved is None:
-            resolved = self._run(self._resolve_args_async(args))
+        if num_returns == "streaming":
+            num_returns = STREAMING
+        resolved, kwargs = self._resolve_args_kwargs(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             function_id=fid,
@@ -499,6 +527,10 @@ class Runtime:
                 self.lineage[oid.binary()] = spec
                 self._add_local_ref(oid.binary())
                 refs.append(ObjectRef(oid, self.address, _register=True))
+            if num_returns == STREAMING:
+                self._streams[spec.task_id.binary()] = _StreamState(
+                    event=asyncio.Event()
+                )
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
                 spec, spec.max_retries
             )
@@ -509,6 +541,8 @@ class Runtime:
                         rc.submitted += 1
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
         self._push_or_queue(spec)
+        if num_returns == STREAMING:
+            return ObjectRefGenerator(spec.task_id.binary(), self)
         return refs
 
     def _export_function(self, fn) -> Tuple[bytes, Optional[bytes]]:
@@ -545,7 +579,7 @@ class Runtime:
                 else:
                     return None
             else:
-                out.append(a)
+                out.append(self._inline_value_arg(a))
         return out
 
     async def _resolve_args_async(self, args) -> List[Any]:
@@ -565,8 +599,38 @@ class Runtime:
                 else:
                     out.append(ArgRef(a.binary(), a.owner))
             else:
-                out.append(a)
+                out.append(self._inline_value_arg(a))
         return out
+
+    def _resolve_args_kwargs(self, args, kwargs):
+        """Resolve positional args AND kwarg values together (top-level
+        ObjectRefs in either position resolve before execution, like the
+        reference).  Returns (resolved_args, resolved_kwargs)."""
+        keys = list(kwargs)
+        combined = list(args) + [kwargs[k] for k in keys]
+        resolved = self._resolve_args_sync(combined)
+        if resolved is None:
+            resolved = self._run(self._resolve_args_async(combined))
+        return (
+            resolved[: len(args)],
+            dict(zip(keys, resolved[len(args):])),
+        )
+
+    def _inline_value_arg(self, v) -> Tuple[str, bytes]:
+        """Serialize a plain (non-ref) argument into an inline envelope
+        at submission time.  The spec then carries only bytes + ids, so
+        every relaying daemon can deserialize the FRAME even when the
+        value references modules only driver/executor import, and a
+        value that fails to deserialize on the executor surfaces as
+        that task's error, not a poisoned connection (reference: args
+        travel as serialized buffers, materialized by the executor —
+        `dependency_resolver.h` / plasma args)."""
+        chunks, total, captured = ser.serialize(v)
+        if captured:
+            self._pin_contained(captured)
+        buf = bytearray(total)
+        ser.write_chunks(chunks, memoryview(buf))
+        return ("__rt_inline__", bytes(buf))
 
     def _pool_for(self, spec: TaskSpec) -> _LeasePool:
         demand = spec.resources.as_dict()
@@ -714,12 +778,23 @@ class Runtime:
             for m in dir(cls)
             if not m.startswith("__")
         )
+        import inspect as _inspect
+
+        streaming_methods = tuple(
+            m for m in dir(cls)
+            if not m.startswith("_")
+            and (_inspect.isgeneratorfunction(getattr(cls, m, None))
+                 or _inspect.isasyncgenfunction(getattr(cls, m, None)))
+        )
         spec = ActorCreationSpec(
             actor_id=actor_id,
             class_id=cid,
             class_blob=blob,
             init_args=await self._resolve_args_async(args),
-            init_kwargs=kwargs,
+            init_kwargs={
+                k: (await self._resolve_args_async([v]))[0]
+                for k, v in kwargs.items()
+            },
             owner=self.address,
             resources=Resources.from_options(options),
             max_restarts=options.get("max_restarts", self.cfg.actor_max_restarts),
@@ -728,6 +803,7 @@ class Runtime:
             is_async=is_async or options.get("max_concurrency", 1) > 1,
             name=options.get("name"),
             namespace=options.get("namespace", "default"),
+            streaming_methods=streaming_methods,
             strategy=_strategy_from_options(options),
             lifetime=options.get("lifetime"),
             runtime_env=options.get("runtime_env"),
@@ -736,15 +812,15 @@ class Runtime:
         if not reply.get("ok"):
             raise exc.RayTpuError(reply.get("error", "actor creation failed"))
         self._actor_addr[actor_id.binary()] = tuple(reply["address"])
-        return actor_id, reply["address"]
+        return actor_id, reply["address"], streaming_methods
 
     def submit_actor_task(self, handle, method_name, args, kwargs, **options):
         aid = handle._actor_id.binary()
         task_id = TaskID.for_actor_task(handle._actor_id)
-        resolved = self._resolve_args_sync(args)
-        if resolved is None:
-            resolved = self._run(self._resolve_args_async(args))
-        kwargs = dict(kwargs)
+        num_returns = options.get("num_returns", 1)
+        if num_returns == "streaming":
+            num_returns = STREAMING
+        resolved, kwargs = self._resolve_args_kwargs(args, kwargs)
         kwargs["__rt_method__"] = method_name
         spec = TaskSpec(
             task_id=task_id,
@@ -752,7 +828,7 @@ class Runtime:
             function_blob=None,
             args=resolved,
             kwargs=kwargs,
-            num_returns=options.get("num_returns", 1),
+            num_returns=num_returns,
             owner=self.address,
             resources=Resources(num_cpus=0),
             max_retries=options.get("max_retries", handle._max_task_retries),
@@ -770,6 +846,10 @@ class Runtime:
                 self.objects[oid.binary()] = _ObjectState(ready=asyncio.Event())
                 self._add_local_ref(oid.binary())
                 refs.append(ObjectRef(oid, self.address, _register=True))
+            if num_returns == STREAMING:
+                self._streams[spec.task_id.binary()] = _StreamState(
+                    event=asyncio.Event()
+                )
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
                 spec, spec.max_retries
             )
@@ -782,6 +862,8 @@ class Runtime:
                 self._actor_addr.setdefault(aid, tuple(handle._address))
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
         self._push_actor_task(aid, spec)
+        if num_returns == STREAMING:
+            return ObjectRefGenerator(spec.task_id.binary(), self)
         return refs
 
     def _push_actor_task(self, aid: bytes, spec: TaskSpec):
@@ -926,6 +1008,18 @@ class Runtime:
                     result.task_id.binary(), pt.spec.name, "FINISHED",
                     duration=(result.execution_info or {}).get("duration"),
                 )
+                stream = self._streams.get(result.task_id.binary())
+                if stream is not None:
+                    stream.total = int(
+                        (result.execution_info or {}).get(
+                            # fallback counts delivered + pending, not
+                            # just unconsumed, or it would truncate
+                            "num_items",
+                            stream.consumed + len(stream.items),
+                        )
+                    )
+                    self.loop.call_soon_threadsafe(stream.event.set)
+                    self.loop.call_soon_threadsafe(stream.done.set)
                 for i, ret in enumerate(result.returns):
                     oid = ObjectID.for_return(result.task_id, i + 1)
                     st = self.objects.get(oid.binary())
@@ -975,7 +1069,12 @@ class Runtime:
                     envelope = ser.serialize_to_bytes(
                         exc.WorkerCrashedError("worker died"), tag=ser.TAG_ERROR
                     )
-                for i in range(pt.spec.num_returns):
+                stream = self._streams.get(result.task_id.binary())
+                if stream is not None:
+                    stream.error = envelope
+                    self.loop.call_soon_threadsafe(stream.event.set)
+                    self.loop.call_soon_threadsafe(stream.done.set)
+                for i in range(max(pt.spec.num_returns, 0)):
                     oid = ObjectID.for_return(result.task_id, i + 1)
                     st = self.objects.get(oid.binary())
                     if st is not None:
@@ -1189,6 +1288,13 @@ class Runtime:
                 t.cancel()
         ready = [r for i, r in enumerate(refs) if done_flags[i]]
         not_ready = [r for i, r in enumerate(refs) if not done_flags[i]]
+        # the reference's ray.wait contract: done never exceeds
+        # num_returns — extra already-ready refs stay in the second list
+        # so `done, pending = wait(pending, num_returns=1)` loops
+        # consume every result exactly once
+        if len(ready) > num_returns:
+            not_ready = ready[num_returns:] + not_ready
+            ready = ready[:num_returns]
         return ready, not_ready
 
     # ------------------------------------------------------------------
@@ -1294,6 +1400,122 @@ class Runtime:
                 pass
             await lease.conn.close()
 
+    async def _h_stream_item(self, payload, conn):
+        """One yielded item of a streaming-generator task we own arrived
+        (ahead of the final task_result).  Duplicate indices (task retry
+        replaying the stream) are dropped — item object ids are
+        deterministic in (task_id, index)."""
+        tid = payload["task_id"].binary()
+        index = payload["index"]
+        ret = payload["item"]
+        oid = ObjectID.for_return(payload["task_id"], index)
+        with self._state_lock:
+            stream = self._streams.get(tid)
+            if stream is None or oid.binary() in self.objects:
+                return
+            st = _ObjectState(ready=asyncio.Event())
+            if ret[0] == _INLINE:
+                st.where, st.value, st.size = _INLINE, ret[1], len(ret[1])
+            else:
+                st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
+            st.ready.set()
+            self.objects[oid.binary()] = st
+            self._add_local_ref(oid.binary())
+            stream.items[index] = ObjectRef(oid, self.address, _register=True)
+        stream.event.set()
+
+    def stream_next(self, task_id_bytes: bytes, timeout: Optional[float] = None):
+        """Next item ObjectRef of a streaming task, blocking.  Returns
+        None when the stream is exhausted; raises the task's error at
+        the position it occurred."""
+        return self._run(
+            self._stream_next_async(task_id_bytes), timeout=timeout
+        )
+
+    async def stream_wait_done(self, tid: bytes):
+        """Await completion of a streaming task (ok or error); used by
+        watchers (e.g. serve's router queue-len tracking) that must not
+        race the consumer."""
+        with self._state_lock:
+            stream = self._streams.get(tid)
+        if stream is None:
+            return
+        await stream.done.wait()
+
+    async def _stream_next_async(self, tid: bytes):
+        while True:
+            with self._state_lock:
+                stream = self._streams.get(tid)
+                if stream is None:
+                    return None
+                nxt = stream.items.pop(stream.consumed + 1, None)
+                if nxt is not None:
+                    stream.consumed += 1
+                    return nxt
+                if stream.total is not None and stream.consumed >= stream.total:
+                    self._streams.pop(tid, None)
+                    return None
+                if stream.error is not None:
+                    # the next in-order item will never arrive: surface
+                    # the error (delivered items were consumed above)
+                    self._streams.pop(tid, None)
+                    raise _error_from_envelope(stream.error)
+                stream.event.clear()
+            await stream.event.wait()
+
+    def stream_release(self, tid: bytes):
+        """Drop a stream's owner-side state (abandoned consumer).
+        Unconsumed item refs are released by their ObjectRefs' GC; items
+        still arriving find no stream and are ignored.  Completion
+        watchers (stream_wait_done) are woken — the stream is finished
+        as far as this owner is concerned.  If the producer is still
+        running, it is told to stop (an unbounded generator must not
+        keep pinning its worker and sealing orphaned items into shm)."""
+        with self._state_lock:
+            stream = self._streams.pop(tid, None)
+            pt = self.pending_tasks.get(tid)
+        if stream is None or self._shutdown:
+            return
+        try:
+            self.loop.call_soon_threadsafe(stream.done.set)
+            if pt is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._stream_cancel_remote(tid, pt.spec), self.loop
+                )
+        except RuntimeError:
+            pass
+
+    async def _stream_cancel_remote(self, task_id: bytes, spec: TaskSpec):
+        """Best-effort 'stop producing' to wherever the streaming task
+        runs (same transport walk as _cancel_remote)."""
+        with self._state_lock:
+            conns = []
+            for pool, lease in self._conn_lease.values():
+                if task_id in lease.assigned:
+                    conns.append(lease.conn)
+            if spec.actor_id is not None:
+                c = self._actor_conns.get(spec.actor_id.binary())
+                if c is not None:
+                    conns.append(c)
+        for conn in conns:
+            try:
+                conn.send("stream_cancel", {"task_id": task_id})
+                return
+            except Exception:
+                pass
+        try:
+            self.noded.send("stream_cancel", {"task_id": task_id})
+        except Exception:
+            pass
+
+    async def _h_stream_cancel(self, payload, conn):
+        """Executor side: mark the stream abandoned; _stream_out stops
+        at the next yield boundary and closes the user generator."""
+        cancelled = self._cancelled_streams = getattr(
+            self, "_cancelled_streams", set()
+        )
+        cancelled.add(payload["task_id"])
+
     async def _h_get_object_value(self, payload, conn):
         st = self.objects.get(payload["id"])
         if st is None:
@@ -1386,9 +1608,33 @@ class Runtime:
                 else:
                     await self._exec_task(s, c)
 
+    async def _adopt_driver_sys_path(self) -> bool:
+        """Extend sys.path from the KV-published driver path (set by
+        joining drivers whose spawn-env never reached this worker);
+        True when anything new was added — the caller retries its
+        deserialization once."""
+        import json as _json
+
+        from ray_tpu.core.env_utils import adopt_sys_path
+
+        try:
+            blob = await self.controller.call(
+                "kv_get", {"key": "driver:sys_path"}
+            )
+        except Exception:
+            return False
+        if not blob:
+            return False
+        return adopt_sys_path(_json.loads(blob))
+
     async def _materialize_arg(self, a):
         if isinstance(a, tuple) and len(a) == 2 and a[0] == "__rt_inline__":
-            tag, val = ser.deserialize(memoryview(a[1]))
+            try:
+                tag, val = ser.deserialize(memoryview(a[1]))
+            except ModuleNotFoundError:
+                if not await self._adopt_driver_sys_path():
+                    raise
+                tag, val = ser.deserialize(memoryview(a[1]))
             return _unwrap(tag, val)
         if isinstance(a, ArgRef):
             ref = ObjectRef(ObjectID(a.id_bytes), a.owner)
@@ -1469,13 +1715,23 @@ class Runtime:
                         return fn(*args, **kwargs)
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
-            returns = await self._package_returns(spec, value)
-            result = TaskResult(
-                task_id=spec.task_id,
-                status="ok",
-                returns=returns,
-                execution_info={"duration": time.time() - t0},
-            )
+            if spec.is_streaming:
+                n_items = await self._stream_out(spec, value, conn)
+                result = TaskResult(
+                    task_id=spec.task_id,
+                    status="ok",
+                    returns=[],
+                    execution_info={"duration": time.time() - t0,
+                                    "num_items": n_items},
+                )
+            else:
+                returns = await self._package_returns(spec, value)
+                result = TaskResult(
+                    task_id=spec.task_id,
+                    status="ok",
+                    returns=returns,
+                    execution_info={"duration": time.time() - t0},
+                )
         except Exception as e:  # noqa: BLE001 - user exception boundary
             tb = traceback.format_exc()
             envelope = ser.serialize_to_bytes(
@@ -1494,6 +1750,65 @@ class Runtime:
                 )
             except Exception:
                 pass
+
+    async def _stream_out(self, spec: TaskSpec, value, conn) -> int:
+        """Drive a streaming-generator task's iteration: each yielded
+        item is packaged like a return value and pushed to the owner as
+        a `stream_item` ahead of the final task_result (reference:
+        streaming generators, `task_manager.h:208`).  A non-generator
+        return value becomes a single-item stream."""
+        import inspect
+
+        loop = asyncio.get_running_loop()
+        _END = object()
+        index = 0
+        tid = spec.task_id.binary()
+
+        def _abandoned() -> bool:
+            cancelled = getattr(self, "_cancelled_streams", None)
+            if cancelled and tid in cancelled:
+                cancelled.discard(tid)
+                return True
+            return False
+
+        async def _send(item):
+            nonlocal index
+            index += 1
+            oid = ObjectID.for_return(spec.task_id, index)
+            ret = await self._package_value(oid, item)
+            payload = {"task_id": spec.task_id, "index": index, "item": ret,
+                       "owner": spec.owner}
+            try:
+                conn.send("stream_item", payload)
+            except Exception:
+                # origin conn gone: route via the node daemon
+                self.noded.send("task_stream", payload)
+
+        if inspect.isasyncgen(value):
+            async for item in value:
+                await _send(item)
+                if _abandoned():
+                    await value.aclose()  # user generator's finally runs
+                    break
+        elif inspect.isgenerator(value):
+
+            def _next():
+                try:
+                    return next(value)
+                except StopIteration:
+                    return _END
+
+            while True:
+                item = await loop.run_in_executor(self._exec_pool, _next)
+                if item is _END:
+                    break
+                await _send(item)
+                if _abandoned():
+                    await loop.run_in_executor(self._exec_pool, value.close)
+                    break
+        else:
+            await _send(value)
+        return index
 
     async def _create_with_backpressure(self, id_bytes: bytes, total: int,
                                         timeout_s: float = 30.0):
@@ -1531,21 +1846,23 @@ class Runtime:
         out = []
         for i, v in enumerate(values):
             oid = ObjectID.for_return(spec.task_id, i + 1)
-            chunks, total, captured = ser.serialize(v)
-            self._pin_contained(captured)
-            if total <= self.cfg.max_direct_call_object_size:
-                buf = bytearray(total)
-                ser.write_chunks(chunks, memoryview(buf))
-                out.append((_INLINE, bytes(buf)))
-            else:
-                dest = await self._create_with_backpressure(
-                    oid.binary(), total
-                )
-                ser.write_chunks(chunks, dest)
-                del dest
-                self.store.seal(oid.binary())
-                out.append((_SHM, self.node_id, total))
+            out.append(await self._package_value(oid, v))
         return out
+
+    async def _package_value(self, oid: ObjectID, v) -> Tuple:
+        """Serialize one return value: inline bytes when small, sealed
+        into the local shm store when large."""
+        chunks, total, captured = ser.serialize(v)
+        self._pin_contained(captured)
+        if total <= self.cfg.max_direct_call_object_size:
+            buf = bytearray(total)
+            ser.write_chunks(chunks, memoryview(buf))
+            return (_INLINE, bytes(buf))
+        dest = await self._create_with_backpressure(oid.binary(), total)
+        ser.write_chunks(chunks, dest)
+        del dest
+        self.store.seal(oid.binary())
+        return (_SHM, self.node_id, total)
 
     async def _load_function(self, spec: TaskSpec):
         if spec.actor_id is not None:
@@ -1561,9 +1878,68 @@ class Runtime:
                     raise exc.RayTpuError(
                         f"function {spec.function_id.hex()} not found"
                     )
-            fn = ser.loads(blob)
+            try:
+                fn = ser.loads(blob)
+            except ModuleNotFoundError:
+                if not await self._adopt_driver_sys_path():
+                    raise
+                fn = ser.loads(blob)
             self._fn_cache[spec.function_id] = fn
         return fn
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs of a streaming-generator task
+    (`num_returns="streaming"`).  Reference: `ObjectRefGenerator` in
+    `_raylet.pyx` — each `next()` blocks until the executor yields the
+    next item and returns that item's ObjectRef; a mid-stream exception
+    in the generator body raises at the position it occurred.
+    """
+
+    def __init__(self, task_id_bytes: bytes, runtime: "Runtime"):
+        self._tid = task_id_bytes
+        self._rt = runtime
+
+    @property
+    def task_id(self) -> bytes:
+        return self._tid
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._rt.stream_next(self._tid)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        loop = asyncio.get_running_loop()
+        if loop is self._rt.loop:
+            # on the runtime's io loop (async actors, serve proxy):
+            # await natively — no thread blocked per waiting stream
+            ref = await self._rt._stream_next_async(self._tid)
+        else:
+            ref = await loop.run_in_executor(
+                None, self._rt.stream_next, self._tid
+            )
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def __del__(self):
+        # abandoned before exhaustion: drop the owner-side stream state
+        # (exhausted streams already popped it — this is a no-op then)
+        try:
+            self._rt.stream_release(self._tid)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._tid.hex()})"
 
 
 # ----------------------------------------------------------------------
@@ -1573,23 +1949,19 @@ _runtime: Optional[Runtime] = None
 
 
 def _strategy_from_options(options):
+    from ray_tpu.util.scheduling_strategies import pg_id_bytes, to_internal
+
     s = options.get("scheduling_strategy")
     if s is None:
         pg = options.get("placement_group")
         if pg is not None:
             return SchedulingStrategy(
                 kind="placement_group",
-                pg_id=(
-                    pg if isinstance(pg, bytes)
-                    else pg.id if isinstance(getattr(pg, "id", None), bytes)
-                    else pg.id.binary()
-                ),
+                pg_id=pg_id_bytes(pg),
                 pg_bundle_index=options.get("placement_group_bundle_index", -1),
             )
         return SchedulingStrategy()
-    if isinstance(s, str):
-        return SchedulingStrategy(kind=s)
-    return s
+    return to_internal(s)
 
 
 def get_runtime() -> Runtime:

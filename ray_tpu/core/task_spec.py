@@ -19,6 +19,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
 
 
+# num_returns sentinel for streaming-generator tasks (the API-level
+# num_returns="streaming"): return objects are created one per yielded
+# item instead of ahead of execution.
+STREAMING = -1
+
+
 def function_id_of(blob: bytes) -> bytes:
     return hashlib.sha256(blob).digest()[:16]
 
@@ -109,7 +115,13 @@ class TaskSpec:
     trace_ctx: Optional[Dict[str, str]] = None
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == STREAMING:
+            return []  # item ids are appended dynamically as yielded
         return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == STREAMING
 
 
 @dataclass
@@ -127,6 +139,10 @@ class ActorCreationSpec:
     is_async: bool = False
     name: Optional[str] = None  # named actor (reference: get_actor)
     namespace: str = "default"
+    # method names defined as (async) generators — recorded so handles
+    # rebuilt via get_actor stream them too (reference: method metadata
+    # in the GCS actor table)
+    streaming_methods: Tuple[str, ...] = ()
     strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     lifetime: Optional[str] = None  # "detached" keeps it past driver exit
     # {"env_vars": {...}, "working_dir": path} applied in the actor's

@@ -26,15 +26,32 @@ def main():
         level=os.environ.get("RT_LOG_LEVEL", "INFO"),
         format="%(asctime)s worker %(levelname)s %(message)s",
     )
+    # Import parity with the driver: functions pickled BY REFERENCE
+    # (module-level defs in importable modules — e.g. a pytest-imported
+    # test module) must resolve here too.  Single-host clusters share
+    # the filesystem, so adopting the driver's sys.path additions is
+    # exact; multi-host deployments ship code via runtime_env
+    # working_dir instead (reference: the driver's code_search_path /
+    # runtime_env py_modules mechanism).
+    extra = os.environ.get("RT_DRIVER_SYS_PATH")
+    if extra:
+        import json as _json
+
+        from ray_tpu.core.env_utils import adopt_sys_path
+
+        adopt_sys_path(_json.loads(extra))
     node_socket = os.environ["RT_NODE_SOCKET"]
     host, port = os.environ["RT_CONTROLLER"].rsplit(":", 1)
 
     from ray_tpu.core.runtime import Runtime, set_runtime
 
     rt = Runtime("worker")
+    # publish the runtime BEFORE registering with the daemon: a task can
+    # be pushed the instant registration lands, and its user code may
+    # call get_runtime() immediately
+    set_runtime(rt)
     rt.start(node_socket, (host, int(port)),
              serve_dir=os.path.dirname(node_socket))
-    set_runtime(rt)
 
     # exit when the node daemon goes away (socket closes) or parent dies
     ppid = os.getppid()

@@ -7,7 +7,7 @@ host-collective gradient allreduce (the CPU-fleet path).  PPO is the
 first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
-from ray_tpu.rllib.algorithms import DQN, PPO, Algorithm, AlgorithmConfig, DQNConfig, PPOConfig
+from ray_tpu.rllib.algorithms import APPO, BC, DQN, PPO, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, DQNConfig, PPOConfig
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
@@ -19,6 +19,10 @@ from ray_tpu.rllib.env import (
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
+    "BC",
+    "BCConfig",
     "CartPoleVectorEnv",
     "DQN",
     "DQNConfig",

@@ -8,6 +8,7 @@ returns a handle to the ingress deployment.
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 import time
@@ -272,6 +273,15 @@ def _collect_deployments(app: Application, out: Dict[str, dict]):
     _collect(app)
 
 
+def _callable_is_streaming(func_or_class) -> bool:
+    """True when the deployment's request entrypoint is a generator /
+    async generator: its HTTP responses stream chunked."""
+    c = func_or_class
+    if isinstance(c, type):
+        c = inspect.getattr_static(c, "__call__", None)
+    return inspect.isgeneratorfunction(c) or inspect.isasyncgenfunction(c)
+
+
 def run(
     target: Application,
     *,
@@ -292,6 +302,9 @@ def run(
         "name": name,
         "route_prefix": route_prefix,
         "ingress": target.deployment.name,
+        "ingress_streaming": _callable_is_streaming(
+            target.deployment.func_or_class
+        ),
         "deployments": list(collected.values()),
     }
     rt.get(controller.deploy_application.remote(app_config), timeout=timeout_s)

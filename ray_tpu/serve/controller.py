@@ -79,6 +79,9 @@ class ServeController:
         self._lock = threading.RLock()
         self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
         self._ingress: Dict[str, str] = {}  # app name -> ingress deployment
+        # app name -> ingress callable is a generator (HTTP responses
+        # stream chunked instead of buffering)
+        self._ingress_streaming: Dict[str, bool] = {}
         self._routes: Dict[str, str] = {}  # route prefix -> app name
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -120,6 +123,9 @@ class ServeController:
             self._ingress[app_name] = app_config.get(
                 "ingress", app_config["deployments"][-1]["name"]
             )
+            self._ingress_streaming[app_name] = bool(
+                app_config.get("ingress_streaming", False)
+            )
             route = app_config.get("route_prefix") or f"/{app_name}"
             self._routes = {
                 k: v for k, v in self._routes.items() if v != app_name
@@ -134,6 +140,7 @@ class ServeController:
         with self._lock:
             deployments = self._apps.pop(app_name, {})
             self._ingress.pop(app_name, None)
+            self._ingress_streaming.pop(app_name, None)
             self._routes = {k: v for k, v in self._routes.items() if v != app_name}
             victims: List[tuple] = []
             for ds in deployments.values():
@@ -172,7 +179,8 @@ class ServeController:
             if best is None:
                 return None
             prefix, app = best
-            return {"app": app, "ingress": self._ingress[app], "prefix": prefix}
+            return {"app": app, "ingress": self._ingress[app], "prefix": prefix,
+                    "streaming": self._ingress_streaming.get(app, False)}
 
     def list_applications(self) -> List[str]:
         with self._lock:

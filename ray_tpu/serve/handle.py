@@ -159,6 +159,79 @@ def _identity(x):
     return x
 
 
+class DeploymentResponseGenerator:
+    """Streaming result of a handle call made with
+    `handle.options(stream=True)` (reference: `serve/handle.py`
+    DeploymentResponseGenerator): iterating yields the values the
+    replica's generator produces, incrementally."""
+
+    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict):
+        self._router = router
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._gen = None  # ObjectRefGenerator once submitted
+        self._lock = threading.Lock()
+        if not _on_runtime_loop():
+            self._ensure_submitted()
+
+    def _ensure_submitted(self):
+        with self._lock:
+            if self._gen is None:
+                args = tuple(
+                    a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+                    for a in self._args
+                )
+                kwargs = {
+                    k: (v._to_object_ref()
+                        if isinstance(v, DeploymentResponse) else v)
+                    for k, v in self._kwargs.items()
+                }
+                self._gen = self._router.assign_request(
+                    self._method, args, kwargs, streaming=True
+                )
+        return self._gen
+
+    async def _ensure_submitted_async(self):
+        if self._gen is None:
+            args = []
+            for a in self._args:
+                if isinstance(a, DeploymentResponse):
+                    a = await a._to_object_ref_async()
+                    await _await_ready(a)
+                args.append(a)
+            kwargs = {}
+            for k, v in self._kwargs.items():
+                if isinstance(v, DeploymentResponse):
+                    v = await v._to_object_ref_async()
+                    await _await_ready(v)
+                kwargs[k] = v
+            gen = await self._router.assign_request_async(
+                self._method, tuple(args), kwargs, streaming=True
+            )
+            with self._lock:
+                if self._gen is None:
+                    self._gen = gen
+        return self._gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        gen = self._ensure_submitted()
+        return rt.get(next(gen))  # StopIteration propagates
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Any:
+        from ray_tpu.core.runtime import get_runtime
+
+        gen = await self._ensure_submitted_async()
+        ref = await gen.__anext__()  # StopAsyncIteration propagates
+        return await get_runtime()._get_one(ref)
+
+
 class _HandleMethod:
     def __init__(self, handle: "DeploymentHandle", method_name: str):
         self._handle = handle
@@ -170,17 +243,20 @@ class _HandleMethod:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 _model_id: str = ""):
+                 _model_id: str = "", _stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._model_id = _model_id
+        self._stream = _stream
 
-    def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+    def _call(self, method: str, args: tuple, kwargs: dict):
         if self._model_id:
             from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
             kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         router = _router_for(self.app_name, self.deployment_name)
+        if self._stream:
+            return DeploymentResponseGenerator(router, method, args, kwargs)
         return DeploymentResponse(router, method, args, kwargs)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -192,18 +268,22 @@ class DeploymentHandle:
         return _HandleMethod(self, name)
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
-                **_opts) -> "DeploymentHandle":
-        if multiplexed_model_id is not None:
-            return DeploymentHandle(
-                self.deployment_name, self.app_name,
-                _model_id=multiplexed_model_id,
-            )
-        return self
+                stream: Optional[bool] = None, **_opts) -> "DeploymentHandle":
+        if multiplexed_model_id is None and stream is None:
+            return self
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            _model_id=(multiplexed_model_id
+                       if multiplexed_model_id is not None
+                       else self._model_id),
+            _stream=self._stream if stream is None else stream,
+        )
 
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self.deployment_name, self.app_name, self._model_id),
+            (self.deployment_name, self.app_name, self._model_id,
+             self._stream),
         )
 
     def __repr__(self):

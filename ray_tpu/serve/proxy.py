@@ -13,14 +13,33 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import time
 import traceback
 from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.request import Request, Response
 
 _MAX_BODY = 256 * 1024 * 1024
+
+
+class _StreamOut:
+    """Marker wrapper: a dispatch produced a streaming response
+    generator to be written chunked."""
+
+    def __init__(self, gen):
+        self.gen = gen  # DeploymentResponseGenerator (async-iterable)
+
+
+def _encode_chunk(item) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        return bytes(item)
+    if isinstance(item, str):
+        return item.encode()
+    return (json.dumps(item) + "\n").encode()
 
 
 class HTTPProxy:
@@ -64,14 +83,21 @@ class HTTPProxy:
                 self._num_requests += 1
                 keep_alive = req.headers.get("connection", "keep-alive") != "close"
                 try:
-                    status, ctype, body, extra = await self._dispatch(req)
+                    out = await self._dispatch(req)
                 except Exception as e:  # noqa: BLE001 — boundary to HTTP
                     tb = traceback.format_exc()
-                    status, ctype, extra = 500, "text/plain", {}
-                    body = f"Internal Server Error: {e}\n{tb}".encode()
-                await self._write_response(
-                    writer, status, ctype, body, extra, keep_alive
-                )
+                    out = (500, "text/plain",
+                           f"Internal Server Error: {e}\n{tb}".encode(), {})
+                if isinstance(out, _StreamOut):
+                    # chunked transfer: one chunk per generator item
+                    # (reference: streaming responses through the proxy,
+                    # `proxy.py` send_request_to_replica_streaming)
+                    await self._write_stream(writer, out, keep_alive)
+                else:
+                    status, ctype, body, extra = out
+                    await self._write_response(
+                        writer, status, ctype, body, extra, keep_alive
+                    )
                 if not keep_alive:
                     break
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -143,6 +169,10 @@ class HTTPProxy:
         route = await self._route(req.path)
         if route is None:
             return 404, "text/plain", b"no application for route", {}
+        if route.get("streaming"):
+            handle = DeploymentHandle(route["ingress"], route["app"],
+                                      _stream=True)
+            return _StreamOut(handle.remote(req))
         handle = DeploymentHandle(route["ingress"], route["app"])
         value = await handle.remote(req)
         return self._encode(value)
@@ -170,6 +200,65 @@ class HTTPProxy:
         if isinstance(value, (bytes, bytearray)):
             return 200, "application/octet-stream", bytes(value), {}
         return 200, "text/plain; charset=utf-8", str(value).encode(), {}
+
+    async def _write_stream(self, writer, out: "_StreamOut", keep_alive: bool):
+        """Write one HTTP/1.1 chunked response, one chunk per item the
+        ingress generator yields — the client sees bytes as they are
+        produced, not after the generator completes.
+
+        The first item is pulled BEFORE the status line goes out: a
+        replica/router failure up to that point is a clean 500.  After
+        the 200 is committed, a mid-stream failure aborts the chunked
+        body WITHOUT the terminating 0-chunk and closes the connection —
+        the client sees a truncated transfer, never a well-formed
+        response that silently lost data (reference: proxy streaming
+        error handling in `proxy.py` send_request_to_replica_streaming).
+        """
+        gen = out.gen.__aiter__()
+        try:
+            first = await gen.__anext__()
+            ended = False
+        except StopAsyncIteration:
+            first, ended = None, True
+        except Exception as e:  # noqa: BLE001 — boundary to HTTP
+            tb = traceback.format_exc()
+            await self._write_response(
+                writer, 500, "text/plain",
+                f"Internal Server Error: {e}\n{tb}".encode(), {}, keep_alive,
+            )
+            return
+        if ended or isinstance(first, str):
+            ctype = "text/plain; charset=utf-8"
+        elif isinstance(first, (bytes, bytearray)):
+            ctype = "application/octet-stream"
+        else:
+            ctype = "application/x-ndjson"  # _encode_chunk JSON-encodes
+        head = [
+            "HTTP/1.1 200 OK",
+            f"Content-Type: {ctype}",
+            "Transfer-Encoding: chunked",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}",
+        ]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        await writer.drain()
+        try:
+            while not ended:
+                chunk = _encode_chunk(first)
+                if chunk:
+                    writer.write(
+                        f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                    )
+                    await writer.drain()
+                try:
+                    first = await gen.__anext__()
+                except StopAsyncIteration:
+                    ended = True
+        except Exception:  # noqa: BLE001 — mid-stream failure
+            logger.exception("streaming response aborted mid-body")
+            writer.close()  # truncated chunked body signals the abort
+            raise ConnectionResetError("stream aborted")
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
 
     async def _write_response(self, writer, status: int, ctype: str,
                               body: bytes, extra: Dict[str, str],

@@ -96,6 +96,70 @@ class Replica:
         finally:
             self._ongoing -= 1
 
+    async def handle_request_streaming(self, method_name: str, *args, **kwargs):
+        """Streaming request path (reference: `replica.py:463-492`
+        `handle_request_streaming`): the user target is a generator /
+        async generator (or returns an iterable) and each produced item
+        flows back to the caller incrementally as one streamed object —
+        this method is itself an async generator, so the actor runtime
+        streams it (`num_returns="streaming"`)."""
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
+
+        model_id = kwargs.pop(MODEL_ID_KWARG, "")
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name or "__call__")
+            _set_model_id(model_id)
+            if inspect.isasyncgenfunction(target):
+                async for item in target(*args, **kwargs):
+                    yield item
+                return
+            loop = asyncio.get_running_loop()
+            from ray_tpu.core.runtime import get_runtime
+
+            pool = get_runtime()._exec_pool
+            if inspect.iscoroutinefunction(target):
+                out = await target(*args, **kwargs)
+            else:
+                # sync targets run on pool threads, which do NOT inherit
+                # this task's contextvars — set the model id on the
+                # executing thread (same pattern as handle_request's
+                # _call_with_ctx)
+                def _call_with_ctx():
+                    _set_model_id(model_id)
+                    return target(*args, **kwargs)
+
+                out = await loop.run_in_executor(pool, _call_with_ctx)
+            if inspect.isgenerator(out):
+                _END = object()
+
+                def _next():
+                    _set_model_id(model_id)  # any pool thread may run this
+                    try:
+                        return next(out)
+                    except StopIteration:
+                        return _END
+
+                while True:
+                    item = await loop.run_in_executor(pool, _next)
+                    if item is _END:
+                        return
+                    yield item
+            elif hasattr(out, "__aiter__"):
+                async for item in out:
+                    yield item
+            elif isinstance(out, (list, tuple)):
+                for item in out:
+                    yield item
+            else:
+                yield out
+        finally:
+            self._ongoing -= 1
+
     # -- control plane ------------------------------------------------
     def get_metrics(self) -> Dict[str, Any]:
         return {

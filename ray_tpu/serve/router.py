@@ -128,11 +128,17 @@ class Router:
                 return pick
             return None
 
-    def _submit(self, info: _ReplicaInfo, method_name, args, kwargs):
+    def _submit(self, info: _ReplicaInfo, method_name, args, kwargs,
+                streaming: bool = False):
         # args flattened to top-level task args so ObjectRefs among them
         # (composed responses) are materialized by the runtime before
         # the replica method runs
-        ref = info.handle.handle_request.remote(method_name, *args, **kwargs)
+        if streaming:
+            out = info.handle.handle_request_streaming.remote(
+                method_name, *args, **kwargs
+            )
+        else:
+            out = info.handle.handle_request.remote(method_name, *args, **kwargs)
 
         def _done():
             with self._lock:
@@ -149,18 +155,22 @@ class Router:
 
         async def _watch():
             try:
-                st = rt_.objects.get(ref.binary())
-                if st is not None:
-                    await st.ready.wait()
+                if streaming:
+                    await rt_.stream_wait_done(out.task_id)
+                else:
+                    st = rt_.objects.get(out.binary())
+                    if st is not None:
+                        await st.ready.wait()
             finally:
                 _done()
 
         asyncio.run_coroutine_threadsafe(_watch(), rt_.loop)
-        return ref
+        return out
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0):
-        """Pick a replica and submit; returns the reply ObjectRef."""
+                       timeout_s: float = 30.0, streaming: bool = False):
+        """Pick a replica and submit; returns the reply ObjectRef (or
+        ObjectRefGenerator when streaming)."""
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
         affinity = kwargs.get(MODEL_ID_KWARG, "")
@@ -170,7 +180,8 @@ class Router:
             self._refresh()
             info = self._try_pick(affinity)
             if info is not None:
-                return self._submit(info, method_name, args, kwargs)
+                return self._submit(info, method_name, args, kwargs,
+                                    streaming=streaming)
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no available replica for {self._deployment} "
@@ -181,7 +192,8 @@ class Router:
             self._refresh(force=True)
 
     async def assign_request_async(self, method_name: str, args: tuple,
-                                   kwargs: dict, timeout_s: float = 30.0):
+                                   kwargs: dict, timeout_s: float = 30.0,
+                                   streaming: bool = False):
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
         affinity = kwargs.get(MODEL_ID_KWARG, "")
@@ -191,7 +203,8 @@ class Router:
             await self._refresh_async()
             info = self._try_pick(affinity)
             if info is not None:
-                return self._submit(info, method_name, args, kwargs)
+                return self._submit(info, method_name, args, kwargs,
+                                    streaming=streaming)
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no available replica for {self._deployment} "

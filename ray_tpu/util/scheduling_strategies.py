@@ -1,0 +1,77 @@
+"""User-facing scheduling strategies.
+
+Reference: `python/ray/util/scheduling_strategies.py` —
+`PlacementGroupSchedulingStrategy`, `NodeAffinitySchedulingStrategy`,
+and the "SPREAD"/"DEFAULT" string strategies accepted by
+`.options(scheduling_strategy=...)`.  These are thin declarative
+objects converted to the internal `SchedulingStrategy` at submission
+(`core/task_spec.py`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.core.task_spec import SchedulingStrategy as _Internal
+
+
+def pg_id_bytes(pg) -> bytes:
+    """Normalize a placement-group argument (PlacementGroup object, id
+    object, or raw bytes) to its binary id — the one extraction both
+    the `placement_group=` option path and the strategy objects use."""
+    if isinstance(pg, bytes):
+        return pg
+    pid = getattr(pg, "id", None)
+    if isinstance(pid, bytes):
+        return pid
+    return pid.binary()
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Run on a reserved bundle of a placement group (reference:
+    `scheduling_strategies.py` PlacementGroupSchedulingStrategy)."""
+
+    placement_group: object
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    def _to_internal(self) -> _Internal:
+        return _Internal(
+            kind="placement_group",
+            pg_id=pg_id_bytes(self.placement_group),
+            pg_bundle_index=self.placement_group_bundle_index,
+            pg_capture_child_tasks=self.placement_group_capture_child_tasks,
+        )
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node by id; `soft=True` allows fallback if the node is
+    gone (reference: NodeAffinitySchedulingStrategy)."""
+
+    node_id: str
+    soft: bool = False
+
+    def _to_internal(self) -> _Internal:
+        return _Internal(kind="node_affinity", node_id=self.node_id,
+                         soft=self.soft)
+
+
+def to_internal(strategy) -> Optional[_Internal]:
+    """Normalize any accepted `scheduling_strategy=` value."""
+    if strategy is None:
+        return None
+    if isinstance(strategy, _Internal):
+        return strategy
+    if isinstance(strategy, str):
+        s = strategy.upper()
+        if s == "DEFAULT":
+            return _Internal()
+        if s == "SPREAD":
+            return _Internal(kind="spread")
+        return _Internal(kind=strategy)
+    if hasattr(strategy, "_to_internal"):
+        return strategy._to_internal()
+    raise TypeError(f"unsupported scheduling_strategy: {strategy!r}")

@@ -137,3 +137,41 @@ def test_init_auto_discovers_cluster(cluster):
     )
     assert out.returncode == 0, out.stderr[-1500:]
     assert "nodes: 1" in out.stdout, out.stdout
+
+
+def test_scheduling_strategies_api(cluster):
+    """User-facing strategy objects (reference:
+    `util/scheduling_strategies.py`): node affinity pins to a node,
+    SPREAD distributes."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster.add_node(num_cpus=2, num_workers=2)
+    cluster.wait_for_nodes()
+    nodes = [n for n in rt.nodes() if n["alive"]]
+    assert len(nodes) >= 2
+
+    @rt.remote
+    def where():
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().node_id
+
+    target = nodes[-1]["node_id"]
+    got = rt.get(
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(target)
+        ).remote(),
+        timeout=30,
+    )
+    assert got == target
+
+    spread_nodes = set(
+        rt.get(
+            [where.options(scheduling_strategy="SPREAD").remote()
+             for _ in range(8)],
+            timeout=30,
+        )
+    )
+    assert len(spread_nodes) >= 2

@@ -1,0 +1,94 @@
+"""multiprocessing.Pool shim + joblib backend tests.
+
+Coverage modeled on the reference's `tests/test_multiprocessing.py` and
+`tests/test_joblib.py`: apply/map/imap surfaces, chunking, error
+propagation, the joblib registered backend end-to-end.
+"""
+
+import math
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util.multiprocessing import Pool, TimeoutError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=8, ignore_reinit_error=True)
+    yield
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_apply_and_async(cluster):
+    with Pool(2) as p:
+        assert p.apply(_add, (2, 3)) == 5
+        r = p.apply_async(_add, (10, 20))
+        assert r.get(timeout=30) == 30
+        assert r.ready() and r.successful()
+
+
+def test_map_variants(cluster):
+    with Pool(3) as p:
+        assert p.map(_sq, range(20)) == [i * i for i in range(20)]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        r = p.map_async(_sq, [5, 6])
+        assert r.get(30) == [25, 36]
+
+
+def test_imap_ordered_and_unordered(cluster):
+    with Pool(2) as p:
+        assert list(p.imap(_sq, range(8), chunksize=2)) == [
+            i * i for i in range(8)
+        ]
+        assert sorted(p.imap_unordered(_sq, range(8), chunksize=3)) == sorted(
+            i * i for i in range(8)
+        )
+
+
+def test_initializer_and_errors(cluster):
+    def init(v):
+        import os
+
+        os.environ["POOL_INIT"] = str(v)
+
+    def read_init(_):
+        import os
+
+        return os.environ.get("POOL_INIT")
+
+    with Pool(2, initializer=init, initargs=(7,)) as p:
+        assert p.map(read_init, [0, 1]) == ["7", "7"]
+
+    def boom(x):
+        raise RuntimeError("pool boom")
+
+    with Pool(2) as p:
+        r = p.apply_async(boom, (1,))
+        with pytest.raises(Exception, match="pool boom"):
+            r.get(30)
+        with pytest.raises(ValueError):
+            p.join()  # not closed yet
+        p.close()
+        p.join()
+
+
+def test_joblib_backend(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(math.sqrt)(i * i) for i in range(32)
+        )
+    assert out == [float(i) for i in range(32)]
