@@ -283,29 +283,52 @@ class Controller:
         (reference: `gcs_actor_scheduler.h` leasing a worker)."""
         demand = info.spec.resources.as_dict()
         strategy = info.spec.strategy
-        candidates = [n for n in self.nodes.values() if n.alive]
-        if strategy.kind == "node_affinity" and strategy.node_id:
-            candidates = [n for n in candidates if n.node_id == strategy.node_id]
-        if self._pg_manager is not None and strategy.kind == "placement_group":
-            node_id = self._pg_manager.node_for_bundle(
-                strategy.pg_id, strategy.pg_bundle_index
-            )
-            candidates = [n for n in candidates if n.node_id == node_id]
+
+        def _candidates() -> List[NodeInfo]:
+            out = [n for n in self.nodes.values() if n.alive]
+            if strategy.kind == "node_affinity" and strategy.node_id:
+                out = [n for n in out if n.node_id == strategy.node_id]
+            if (self._pg_manager is not None
+                    and strategy.kind == "placement_group"):
+                node_id = self._pg_manager.node_for_bundle(
+                    strategy.pg_id, strategy.pg_bundle_index
+                )
+                out = [n for n in out if n.node_id == node_id]
+            return out
+
         # weakest-fit: most available first (spread actors)
         def avail(n: NodeInfo):
             return sum(n.resources.values())
 
-        for node in sorted(candidates, key=avail, reverse=True):
+        # NOTE: failures return immediately (no in-place retry): callers
+        # like the tune controller and serve reconciler hold their own
+        # event loops while awaiting this, and resources only free when
+        # those loops get to reap finished actors — blocking here would
+        # deadlock exactly the churn it tried to ride out.  Transient
+        # failures ("resources no longer available", "no idle worker")
+        # are retried by the callers.
+        errors = []
+        for node in sorted(_candidates(), key=avail, reverse=True):
             if not _fits(demand, node.resources):
+                errors.append(f"{node.node_id[:8]}: infeasible {demand}")
                 continue
             try:
-                reply = await node.conn.call("host_actor", info.spec, timeout=60)
+                # must outlive the daemon's whole hosting window (60s
+                # idle-worker wait + 300s create_actor_instance — slow
+                # inits are real: first jax/TPU init in a fresh worker
+                # takes tens of seconds)
+                reply = await node.conn.call("host_actor", info.spec,
+                                             timeout=380)
             except Exception as e:
-                logger.warning("host_actor on %s failed: %s", node.node_id, e)
+                logger.warning("host_actor on %s failed: %s",
+                               node.node_id, e)
+                errors.append(f"{node.node_id[:8]}: {e}")
                 continue
             if reply.get("ok"):
                 return True, (node.node_id, reply["worker_id"])
-        return False, "no node can host actor (insufficient resources)"
+            errors.append(f"{node.node_id[:8]}: {reply.get('error')}")
+        detail = "; ".join(errors) if errors else "no alive candidate nodes"
+        return False, f"no node can host actor: {detail}"
 
     async def _handle_actor_failure(self, info: ActorInfo, cause: str):
         """Restart policy (reference: gcs_actor_manager.h:274 restart on

@@ -703,15 +703,32 @@ class NodeDaemon:
     handle_stream_item = handle_task_stream
 
     async def handle_stream_cancel(self, payload, conn):
-        """Abandoned-stream stop signal for a daemon-dispatched task:
-        the owner doesn't know which worker runs it — fan out to local
-        workers (a no-op on the ones not running it)."""
+        """Abandoned-stream stop signal for a daemon-dispatched task.
+        The owner doesn't know where it runs: target the local worker
+        whose in-flight set has it; if none, forward once to the other
+        daemons (spillback may have moved it cluster-wide)."""
+        tid = payload["task_id"]
         for w in list(self.workers.values()):
-            if w.conn and not w.conn.closed and not w.idle:
+            if tid in w.in_flight and w.conn and not w.conn.closed:
                 try:
-                    w.conn.send("stream_cancel", payload)
+                    w.conn.send("stream_cancel", {"task_id": tid})
                 except Exception:
                     pass
+                return
+        if payload.get("forwarded"):
+            return  # one hop only: every daemon has now checked locally
+        try:
+            nodes = await self.controller_conn.call("get_nodes", None)
+        except Exception:
+            return
+        for n in nodes or []:
+            if not n.get("alive") or n["node_id"] == self.node_id:
+                continue
+            try:
+                c = await self._node_conn(n["node_id"])
+                c.send("stream_cancel", {"task_id": tid, "forwarded": True})
+            except Exception:
+                pass
 
     async def _route_to_owner(self, owner: Tuple[str, str], method: str, payload):
         node_id, worker_id = owner

@@ -1716,7 +1716,12 @@ class Runtime:
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
             if spec.is_streaming:
-                n_items = await self._stream_out(spec, value, conn)
+                try:
+                    n_items = await self._stream_out(spec, value, conn)
+                finally:
+                    # cancel marks are per-execution: never leak into a
+                    # retry of the same task id
+                    getattr(self, "_cancelled_streams", set()).discard(tid)
                 result = TaskResult(
                     task_id=spec.task_id,
                     status="ok",
@@ -1834,6 +1839,15 @@ class Runtime:
                 await asyncio.sleep(0.05)
 
     async def _package_returns(self, spec: TaskSpec, value) -> List[Tuple]:
+        import inspect as _inspect
+
+        if _inspect.isgenerator(value) or _inspect.isasyncgen(value):
+            raise TypeError(
+                f"task {spec.name!r} returned a generator but was not "
+                "submitted as streaming — call it with "
+                "num_returns=\"streaming\" (generator functions and "
+                "public generator actor methods stream automatically)"
+            )
         if spec.num_returns == 1:
             values = [value]
         else:

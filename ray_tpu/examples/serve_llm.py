@@ -1,0 +1,114 @@
+"""LLM serving example: a Llama replica behind serve (BASELINE #5).
+
+Reference capability: "Ray Serve Llama-3 8B JAX replica (autoscaled TPU
+deployment)" — a deployment hosting a jax Llama with KV-cached decoding
+(`models/llama.py` prefill/decode_step/generate), dynamic request
+batching (`@serve.batch` — batches compile once per shape and reuse the
+program, the TPU-native win), and serve autoscaling from queue metrics.
+
+Token-id interface (no tokenizer dependency in-image): POST
+`{"tokens": [[1,2,3,...]], "max_new_tokens": 16}` -> generated ids.
+
+    from ray_tpu.examples.serve_llm import run
+    handle = run(model_size="tiny")          # or "llama2_7b"/"llama3_8b"
+    out = handle.generate.remote([[1, 2, 3]]).result()
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import List, Optional
+
+from ray_tpu import serve
+
+MODEL_SIZES = ("tiny", "llama2_7b", "llama3_8b")
+
+
+@serve.deployment(
+    max_ongoing_requests=32,
+    autoscaling_config={"min_replicas": 1, "max_replicas": 2,
+                        "target_ongoing_requests": 16},
+)
+class LlamaService:
+    """One replica = one model instance on this host's chips.
+
+    Scaling out is serve autoscaling (more replicas); scaling up is a
+    mesh passed to the model (tp/sp sharding rules) — the single-replica
+    path here keeps the example self-contained.
+    """
+
+    def __init__(self, model_size: str = "tiny", max_new_tokens: int = 16,
+                 seed: int = 0, max_batch_size: int = 8):
+        import jax
+
+        from ray_tpu.models import llama
+
+        if model_size not in MODEL_SIZES:
+            raise ValueError(f"model_size must be one of {MODEL_SIZES}")
+        self._llama = llama
+        self.cfg = {
+            "tiny": llama.LlamaConfig.tiny,
+            "llama2_7b": llama.LlamaConfig.llama2_7b,
+            "llama3_8b": llama.LlamaConfig.llama3_8b,
+        }[model_size]()
+        self.params = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.max_new_tokens = max_new_tokens
+        self._max_batch = max_batch_size
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def _generate_batch(self, requests: List[dict]) -> List[List[int]]:
+        """Batched generation.  Prompts are grouped by length so each
+        group is one [B, T] generate call — XLA compiles per shape, and
+        same-shape batches reuse the compiled prefill/decode programs."""
+        import jax.numpy as jnp
+
+        out: List[Optional[List[int]]] = [None] * len(requests)
+        groups = defaultdict(list)
+        for i, req in enumerate(requests):
+            groups[(len(req["tokens"]), req["max_new_tokens"])].append(i)
+        for (T, n_new), idxs in groups.items():
+            arr = jnp.asarray(
+                [requests[i]["tokens"] for i in idxs], jnp.int32
+            )
+            gen = self._llama.generate(
+                self.cfg, self.params, arr, n_new, temperature=0.0
+            )
+            for j, i in enumerate(idxs):
+                out[i] = [int(t) for t in gen[j]]
+        return out
+
+    async def generate(self, token_lists: List[List[int]],
+                       max_new_tokens: Optional[int] = None) -> List[List[int]]:
+        """Python-handle surface: a list of prompts (token ids)."""
+        import asyncio
+
+        n_new = max_new_tokens or self.max_new_tokens
+        return list(await asyncio.gather(*[
+            self._generate_batch({"tokens": toks, "max_new_tokens": n_new})
+            for toks in token_lists
+        ]))
+
+    async def __call__(self, request):
+        body = request.json() if request.body() else {}
+        tokens = body["tokens"]
+        n_new = int(body.get("max_new_tokens", self.max_new_tokens))
+        result = await self.generate(tokens, n_new)
+        return {"tokens": result}
+
+
+def build_app(model_size: str = "tiny", max_new_tokens: int = 16):
+    return LlamaService.bind(model_size=model_size,
+                             max_new_tokens=max_new_tokens)
+
+
+def run(model_size: str = "tiny", max_new_tokens: int = 16,
+        name: str = "llm", route_prefix: str = "/llm",
+        timeout_s: float = 300.0):
+    """Deploy and return the app handle.  The ready timeout covers a
+    cold replica init on real chips (first jax/TPU init in a fresh
+    worker is tens of seconds; big-model weight init longer)."""
+    return serve.run(
+        build_app(model_size, max_new_tokens),
+        name=name, route_prefix=route_prefix, timeout_s=timeout_s,
+    )

@@ -185,12 +185,13 @@ def _rms_norm(x, g, eps):
     return (x * lax.rsqrt(ms + eps).astype(x.dtype)) * g
 
 
-def _rope(x, theta: float, t0: int = 0):
-    """Rotary embedding over the last dim; x [B, T, H, hd]."""
+def _rope(x, theta: float, t0=0):
+    """Rotary embedding over the last dim; x [B, T, H, hd].  t0 may be
+    a traced offset (KV-cached decode positions)."""
     B, T, H, hd = x.shape
     half = hd // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    pos = jnp.arange(t0, t0 + T, dtype=jnp.float32)
+    pos = jnp.asarray(t0, jnp.float32) + jnp.arange(T, dtype=jnp.float32)
     ang = pos[:, None] * freqs[None, :]  # [T, half]
     cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
@@ -199,8 +200,15 @@ def _rope(x, theta: float, t0: int = 0):
 
 
 def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
-            mesh=None, lora: Optional[Dict] = None) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] (f32)."""
+            mesh=None, lora: Optional[Dict] = None,
+            return_kv: bool = False):
+    """tokens [B, T] int32 -> logits [B, T, vocab] (f32).
+
+    With return_kv=True also returns the per-layer post-RoPE K/V
+    ([L, B, T, KV, hd] each) — the prefill path of KV-cached decoding
+    (reference capability: vLLM-style serving on Ray; here the native
+    inference path for serve replicas).
+    """
     B, T = tokens.shape
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
     hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -225,8 +233,9 @@ def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
             k = _apply(h, layer["wk"], cfg.dtype, layer_lora, "wk")
             v = _apply(h, layer["wv"], cfg.dtype, layer_lora, "wv")
             q = _rope(q.reshape(B, T, H, hd), cfg.rope_theta)
-            k = _rope(k.reshape(B, T, KV, hd), cfg.rope_theta)
-            v = v.reshape(B, T, KV, hd)
+            k_kv = _rope(k.reshape(B, T, KV, hd), cfg.rope_theta)
+            v_kv = v.reshape(B, T, KV, hd)
+            k, v = k_kv, v_kv
             if group > 1:  # GQA: each kv head serves `group` query heads
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
@@ -241,28 +250,33 @@ def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
                 jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
                 layer_lora, "w_down",
             )
-            return x1 + down
+            return x1 + down, k_kv, v_kv
 
         fn = jax.checkpoint(one) if cfg.remat else one
-        return fn(x), None
+        out, k_kv, v_kv = fn(x)
+        return out, ((k_kv, v_kv) if return_kv else None)
 
     scan_tree = dict(blocks)
     if lora_blocks is not None:
         scan_tree.update(lora_blocks)
     x = x.astype(cfg.dtype)
-    x, _ = lax.scan(body, x, scan_tree)
+    x, kv = lax.scan(body, x, scan_tree)
     x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    if return_kv:
+        return logits, kv
+    return logits
 
 
 def loss_fn(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
             mesh=None, lora: Optional[Dict] = None) -> jax.Array:
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(cfg, params, inputs, mesh, lora)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    # lse - target_logit == -log_softmax[target] without materializing
+    # the full [B, T, vocab] log-prob tensor (see gpt2.loss_fn)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
 
 
 def num_params(params) -> int:
@@ -319,3 +333,145 @@ def merge_lora(cfg: LlamaConfig, params: Dict, lora: Dict) -> Dict:
         blocks[t] = blocks[t] + jnp.einsum("lir,lro->lio", a, b) * scale
     out["blocks"] = blocks
     return out
+
+
+# ----------------------------------------------------------------------
+# KV-cached decoding (the serving inference path)
+# ----------------------------------------------------------------------
+def prefill(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
+            max_len: int, mesh=None):
+    """Process the prompt in one pass and build the KV cache.
+
+    tokens [B, T] -> (last-position logits [B, vocab],
+    cache = (k [L, B, max_len, KV, hd], v [...]), length T).
+    Reference capability: the prefill phase of LLM serving (the
+    vLLM-on-Ray pattern); here a native jittable function.
+    """
+    B, T = tokens.shape
+    logits, (ks, vs) = forward(cfg, params, tokens, mesh, return_kv=True)
+    pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0)]
+    k_cache = jnp.pad(ks, pad)
+    v_cache = jnp.pad(vs, pad)
+    return logits[:, -1, :], (k_cache, v_cache)
+
+
+def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
+                cache, pos):
+    """One token of autoregressive decoding against the KV cache.
+
+    token [B] int32, pos scalar (current sequence length) ->
+    (logits [B, vocab], updated cache).  Static shapes throughout (the
+    cache is max_len-sized and masked by position), so the step compiles
+    once and every subsequent token reuses it.
+    """
+    k_cache, v_cache = cache  # [L, B, M, KV, hd]
+    B = token.shape[0]
+    M = k_cache.shape[2]
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    group = H // KV
+
+    x = params["tok_emb"].astype(cfg.dtype)[token][:, None, :]  # [B,1,d]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    # causal-by-position mask over the cache slots
+    valid = (jnp.arange(M) <= pos)[None, None, :, None]  # [1,1,M,1]
+
+    def body(x, inputs):
+        layer, kc, vc = inputs  # kc/vc [B, M, KV, hd]
+        h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
+        q = _apply(h, layer["wq"], cfg.dtype)
+        k = _apply(h, layer["wk"], cfg.dtype)
+        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _rope(q.reshape(B, 1, H, hd), cfg.rope_theta, t0=pos)
+        k_new = _rope(k.reshape(B, 1, KV, hd), cfg.rope_theta, t0=pos)
+        v_new = v.reshape(B, 1, KV, hd)
+        kc = lax.dynamic_update_slice(kc, k_new.astype(kc.dtype),
+                                      (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v_new.astype(vc.dtype),
+                                      (0, pos, 0, 0))
+        kk, vv = kc, vc
+        if group > 1:
+            kk = jnp.repeat(kk, group, axis=2)
+            vv = jnp.repeat(vv, group, axis=2)
+        # scores over all cache slots, masked beyond pos
+        s = jnp.einsum("bohd,bmhd->bhom", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale  # [B,H,1,M]
+        s = jnp.where(valid.transpose(0, 3, 1, 2), s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhom,bmhd->bohd", w, vv.astype(jnp.float32))
+        o = o.astype(cfg.dtype).reshape(B, 1, H * hd)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+
+        h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype)
+        up = _apply(h2, layer["w_up"], cfg.dtype)
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        return x1 + down, (kc, vc)
+
+    x = x.astype(cfg.dtype)
+    x, (k_cache, v_cache) = lax.scan(
+        body, x, (dict(params["blocks"]), k_cache, v_cache)
+    )
+    x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
+    logits = (x[:, 0, :] @ params["lm_head"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), (k_cache, v_cache)
+
+
+_DECODE_JIT_CACHE: Dict = {}
+
+
+def _jitted_decode_fns(cfg: LlamaConfig, mesh=None):
+    """One jitted (prefill, step) pair per config — jax.jit's cache is
+    keyed on the wrapper object, so rebuilding wrappers per generate()
+    call would recompile on EVERY request (minutes at 7B+)."""
+    import functools
+
+    key = (cfg, id(mesh) if mesh is not None else None)
+    fns = _DECODE_JIT_CACHE.get(key)
+    if fns is None:
+        fns = (
+            jax.jit(functools.partial(prefill, cfg, mesh=mesh),
+                    static_argnames=("max_len",)),
+            jax.jit(functools.partial(decode_step, cfg)),
+        )
+        _DECODE_JIT_CACHE[key] = fns
+    return fns
+
+
+def generate(cfg: LlamaConfig, params: Dict, prompt: jax.Array,
+             max_new_tokens: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None, mesh=None) -> jax.Array:
+    """Autoregressive generation: prefill + KV-cached decode loop.
+
+    prompt [B, T] int32 -> generated [B, max_new_tokens] int32.
+    temperature 0 = greedy; otherwise softmax sampling with `key`.
+    The prefill and the step compile once per (B, T+max_new_tokens)
+    shape; the python loop re-enters the cached jit.
+    """
+    B, T = prompt.shape
+    max_len = T + max_new_tokens
+    prefill_fn, step_fn = _jitted_decode_fns(cfg, mesh)
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    logits, cache = prefill_fn(params, prompt, max_len=max_len)
+    out = []
+    key, k0 = jax.random.split(key)
+    tok = pick(logits, k0)
+    out.append(tok)
+    for i in range(max_new_tokens - 1):
+        # pos travels as a device scalar so the step compiles ONCE and
+        # every token reuses it (a python int would retrace per step)
+        logits, cache = step_fn(
+            params, tok, cache, jnp.asarray(T + i, jnp.int32)
+        )
+        key, ki = jax.random.split(key)
+        tok = pick(logits, ki)
+        out.append(tok)
+    return jnp.stack(out, axis=1)

@@ -87,6 +87,7 @@ class _Rendezvous:
         self.rounds: Dict[int, Dict[int, Any]] = {}
         self.results: Dict[int, Any] = {}
         self.barrier_count: Dict[int, int] = {}
+        self.mailbox: Dict[tuple, Any] = {}  # p2p: (seq, src, dst) -> value
 
     def contribute(self, round_id: int, rank: int, value, op: str):
         slot = self.rounds.setdefault(round_id, {})
@@ -111,6 +112,14 @@ class _Rendezvous:
             self.barrier_count.pop(round_id, None)
         else:
             self.barrier_count[round_id] = c
+
+    def p2p_put(self, key: tuple, value):
+        self.mailbox[key] = value
+        return True
+
+    def p2p_take(self, key: tuple):
+        # pop-on-read: each (seq, src, dst) message is consumed once
+        return self.mailbox.pop(key, _PENDING)
 
 
 _PENDING = "__rt_pending__"
@@ -176,6 +185,45 @@ class CollectiveGroup:
     def barrier(self):
         self._exchange(0, "sum")
 
+    # -- p2p (reference surface: collective.py send:531 / recv:594) ---
+    def _p2p_next(self, src: int, dst: int) -> int:
+        seqs = getattr(self, "_p2p_seq", None)
+        if seqs is None:
+            seqs = self._p2p_seq = {}
+        n = seqs.get((src, dst), 0)
+        seqs[(src, dst)] = n + 1
+        return n
+
+    def send(self, array, dst_rank: int):
+        """Post one array to dst_rank; pairs with its recv in program
+        order per (src, dst) channel — both sides keep a pairwise
+        sequence counter, so interleaved sends to different peers don't
+        cross."""
+        import ray_tpu as rt
+
+        seq = self._p2p_next(self.rank, dst_rank)
+        rt.get(self._rdv.p2p_put.remote(
+            (seq, self.rank, dst_rank), np.asarray(array)
+        ))
+
+    def recv(self, src_rank: int, timeout_s: float = 60.0):
+        """Blocking receive of the next message from src_rank."""
+        import ray_tpu as rt
+
+        seq = self._p2p_next(src_rank, self.rank)
+        deadline = time.time() + timeout_s
+        while True:
+            out = rt.get(self._rdv.p2p_take.remote(
+                (seq, src_rank, self.rank)
+            ))
+            if not (isinstance(out, str) and out == _PENDING):
+                return out
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"recv from rank {src_rank} timed out after {timeout_s}s"
+                )
+            time.sleep(0.002)
+
 
 _groups: Dict[str, CollectiveGroup] = {}
 
@@ -212,6 +260,14 @@ def reducescatter(array, group_name: str = "default", op: str = "sum"):
 
 def barrier(group_name: str = "default"):
     get_group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default"):
+    get_group(group_name).send(array, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default", timeout_s: float = 60.0):
+    return get_group(group_name).recv(src_rank, timeout_s)
 
 
 def destroy_collective_group(group_name: str = "default"):

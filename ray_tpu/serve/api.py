@@ -145,6 +145,20 @@ def ingress(_app=None, **_kwargs):
 def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True):
     """Start the serve control plane (reference: `serve/api.py` serve.start)."""
     with _state_lock:
+        # stale module state survives a full runtime shutdown+restart in
+        # the same process (the cached handles point into the DEAD
+        # cluster) — validate before reuse, reset if the controller is
+        # gone
+        c = _state.get("controller")
+        if c is not None:
+            try:
+                rt.get(c.ping.remote(), timeout=10)
+            except Exception:
+                _state.clear()
+                from ray_tpu.serve import handle as _handle_mod
+
+                with _handle_mod._routers_lock:
+                    _handle_mod._routers.clear()
         if "controller" not in _state:
             try:
                 controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
@@ -355,6 +369,10 @@ def shutdown():
         controller = _state.pop("controller", None)
         proxy = _state.pop("proxy", None)
         _state.pop("http_address", None)
+    from ray_tpu.serve import handle as _handle_mod
+
+    with _handle_mod._routers_lock:
+        _handle_mod._routers.clear()
     # the control plane may have been started by ANOTHER process (REST
     # deploy via the dashboard): resolve the named actors so shutdown
     # tears them down from anywhere

@@ -9,6 +9,7 @@ from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -30,6 +31,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",
     "FunctionTrainable",

@@ -266,7 +266,12 @@ class TuneController:
                     t.start_retries = 0  # budget is per start attempt
                     running.append(t)
                 except Exception as e:
-                    if "insufficient resources" in str(e):
+                    if any(m in str(e) for m in (
+                        "insufficient resources",
+                        "resources no longer available",
+                        "no idle worker",
+                        "infeasible",
+                    )):
                         # resources from just-killed trial actors free
                         # asynchronously: stay PENDING and retry for a
                         # bounded window before declaring the request

@@ -98,6 +98,88 @@ class ASHAScheduler(TrialScheduler):
         return CONTINUE
 
 
+class HyperBandScheduler(TrialScheduler):
+    """Bracketed HyperBand (reference: `schedulers/hyperband.py`
+    HyperBandScheduler).
+
+    The HyperBand idea over plain successive halving: run SEVERAL
+    brackets in parallel, each trading off number-of-configs against
+    per-config budget — bracket s starts trials with budget
+    max_t / rf^s, so aggressive brackets kill early on little evidence
+    while conservative ones give every config the full budget.  Trials
+    are assigned round-robin to brackets on first result.
+
+    Simplification vs the reference: the controller here has no PAUSE
+    state, so halving inside a bracket is asynchronous (ASHA-style
+    re-check against the rung's current population) rather than
+    synchronized at rung boundaries.  Trials stop at max_t — budget
+    exhausted is a stop, like the reference's bracket completion.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", max_t: int = 81,
+                 reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # integer arithmetic: math.log floats truncate exact powers
+        # (log(243, 3) -> 4.999...), which would drop a bracket
+        s, t = 0, max_t
+        while t >= reduction_factor:
+            t //= reduction_factor
+            s += 1
+        self.s_max = s
+        # bracket s: rungs start at max_t / rf^s
+        self._brackets: List[List[int]] = []
+        for s in range(self.s_max + 1):
+            r0 = max(1, int(max_t / (reduction_factor ** s)))
+            rungs, t = [], r0
+            while t < max_t:
+                rungs.append(t)
+                t *= reduction_factor
+            self._brackets.append(rungs)
+        self._next_bracket = 0
+        self._assignment: Dict[Any, int] = {}
+        # (bracket, rung) -> recorded values
+        self._recorded: Dict[tuple, List[float]] = defaultdict(list)
+
+    def _better(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        t = result.get(self.time_attr, 0)
+        v = self._better(float(result[self.metric]))
+        b = self._assignment.get(trial.trial_id)
+        if b is None:
+            # most-exploratory bracket first, like the reference fills
+            # bracket s_max down to 0
+            b = self._assignment[trial.trial_id] = (
+                self._next_bracket % (self.s_max + 1)
+            )
+            self._next_bracket += 1
+        if t >= self.max_t:
+            return STOP
+        for rung in self._brackets[b]:
+            if t >= rung and rung not in trial.rungs_passed:
+                trial.rungs_passed.add(rung)
+                trial.rung_values[rung] = v
+                self._recorded[(b, rung)].append(v)
+        if trial.rung_values:
+            rung = max(trial.rung_values)
+            recorded = self._recorded[(b, rung)]
+            if len(recorded) >= 2:
+                k = max(1, math.ceil(len(recorded) / self.rf))
+                threshold = sorted(recorded, reverse=True)[k - 1]
+                if trial.rung_values[rung] < threshold:
+                    return STOP
+        return CONTINUE
+
+
 class MedianStoppingRule(TrialScheduler):
     """Reference: `schedulers/median_stopping_rule.py` — stop a trial
     whose best result is worse than the median of other trials' running

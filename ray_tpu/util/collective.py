@@ -14,7 +14,9 @@ from ray_tpu.parallel.collectives import (
     destroy_collective_group,
     get_group,
     init_collective_group,
+    recv,
     reducescatter,
+    send,
 )
 
 __all__ = [
@@ -26,5 +28,7 @@ __all__ = [
     "destroy_collective_group",
     "get_group",
     "init_collective_group",
+    "recv",
     "reducescatter",
+    "send",
 ]

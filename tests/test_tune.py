@@ -91,7 +91,10 @@ def test_tuner_class_trainable_with_checkpoint(rt_start, tmp_path):
 
 def test_asha_stops_bad_trials(rt_start, tmp_path):
     def objective(config):
+        import time as _t
+
         for i in range(16):
+            _t.sleep(0.15)  # in-flight long enough for culling decisions
             tune.report({"acc": config["q"] * (i + 1)})
 
     results = Tuner(
@@ -235,3 +238,41 @@ def test_tpe_searcher_beats_random_on_quadratic(rt_start, tmp_path):
     # late samples concentrate: top quartile clearly better than chance
     # (uniform-random 10th-best on this bowl is typically ~-0.15)
     assert scores[9] > -0.1, scores[:10]
+
+
+def test_hyperband_brackets_and_culling(rt_start, tmp_path):
+    """HyperBand (reference: `schedulers/hyperband.py`): brackets give
+    different grace budgets; weak trials are culled, the best reaches
+    max_t."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    def objective(config):
+        import time as _t
+
+        for i in range(9):
+            _t.sleep(0.15)  # in-flight long enough for culling decisions
+            tune.report({"acc": config["q"] * (i + 1)})
+
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.3, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=HyperBandScheduler(
+                metric="acc", mode="max", max_t=9, reduction_factor=3,
+            ),
+            max_concurrent_trials=5,
+        ),
+        run_config=train.RunConfig(name="hb", storage_path=str(tmp_path)),
+    ).fit()
+    assert results.num_errors == 0
+    iters = [
+        r.metrics.get("training_iteration", 0) for r in results
+    ]
+    assert max(iters) >= 8  # someone ran (nearly) the full budget
+    # bracket structure: rungs exist for several brackets
+    sched = HyperBandScheduler(metric="m", max_t=81, reduction_factor=3)
+    assert sched.s_max == 4
+    assert sched._brackets[0] == []  # s=0: full budget, no early rungs
+    assert sched._brackets[4] == [1, 3, 9, 27]  # s=4: starts at 1

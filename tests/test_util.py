@@ -95,3 +95,43 @@ def test_collective_reexport():
 
     assert callable(col.init_collective_group)
     assert callable(col.allreduce)
+
+
+def test_collective_group_ops_and_p2p(cluster):
+    """Host-tier collective group across actors: allreduce, broadcast,
+    and p2p send/recv (reference: `util/collective/collective.py`
+    allreduce:258, send:531, recv:594)."""
+    import numpy as np
+
+    @rt.remote
+    class Member:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collectives as col
+
+            self.col = col
+            self.g = col.init_collective_group(
+                world, rank, group_name="t_p2p"
+            )
+            self.rank = rank
+
+        def run(self):
+            out = {}
+            out["allreduce"] = self.g.allreduce(
+                np.full(4, self.rank + 1.0)
+            ).tolist()
+            out["bcast"] = self.g.broadcast(
+                np.arange(3.0) if self.rank == 0 else None, src_rank=0
+            ).tolist()
+            if self.rank == 0:
+                self.g.send(np.array([42.0, 43.0]), dst_rank=1)
+                out["p2p"] = None
+            else:
+                out["p2p"] = self.g.recv(src_rank=0, timeout_s=30).tolist()
+            self.g.barrier()
+            return out
+
+    members = [Member.remote(r, 2) for r in range(2)]
+    res = rt.get([m.run.remote() for m in members], timeout=60)
+    assert res[0]["allreduce"] == [3.0] * 4  # 1 + 2
+    assert res[1]["bcast"] == [0.0, 1.0, 2.0]
+    assert res[1]["p2p"] == [42.0, 43.0]
