@@ -125,6 +125,88 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._with_op(LimitOp(n))
 
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Keep each row independently with probability `fraction`
+        (reference: `Dataset.random_sample`)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        # per-block streams: with a fixed seed, derive the block's
+        # stream from (seed, first-row content) so the same data always
+        # samples identically while distinct blocks stay decorrelated
+        import zlib
+
+        def op(blk: B.Block) -> List[B.Block]:
+            n = B.num_rows(blk)
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                first = next(B.iter_rows(blk), None)
+                h = zlib.crc32(repr((n, first)).encode())
+                rng = np.random.default_rng((seed, h))
+            keep = np.nonzero(rng.random(n) < fraction)[0]
+            return [B.take_indices(blk, keep)]
+
+        return self._with_op(MapOp(op, name=f"RandomSample({fraction})"))
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: `Dataset.unique`)."""
+        seen = []
+        seen_set = set()
+        for blk in self._iter_blocks():
+            for v in np.asarray(blk[column]).tolist():
+                k = v if not isinstance(v, list) else tuple(v)
+                if k not in seen_set:
+                    seen_set.add(k)
+                    seen.append(v)
+        return seen
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = True,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """Split into (train, test) datasets (reference:
+        `Dataset.train_test_split`)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        import ray_tpu as rt
+
+        ds = (self.random_shuffle(seed=seed) if shuffle else self
+              ).materialize()
+        pairs = ds._cached_pairs
+        n = builtins.sum(int(m["num_rows"]) for _, m in pairs)
+        n_test = max(1, int(n * test_size))
+        n_train = n - n_test
+        # split at the row boundary WITHOUT pulling blocks to the
+        # driver: whole blocks keep their refs; only the boundary block
+        # is sliced, remotely
+        train_pairs, test_pairs = [], []
+        cum = 0
+        for ref, meta in pairs:
+            rows = int(meta["num_rows"])
+            if cum + rows <= n_train:
+                train_pairs.append((ref, meta))
+            elif cum >= n_train:
+                test_pairs.append((ref, meta))
+            else:
+                k = n_train - cum
+                left_ref, right_ref = rt.remote(_split_block).options(
+                    num_returns=2, num_cpus=1
+                ).remote(ref, k)
+                train_pairs.append(
+                    (left_ref, {"num_rows": k,
+                                "size_bytes": meta.get("size_bytes", 0)})
+                )
+                test_pairs.append(
+                    (right_ref, {"num_rows": rows - k,
+                                 "size_bytes": meta.get("size_bytes", 0)})
+                )
+            cum += rows
+        train = Dataset(LogicalPlan([ReadOp([], name="TrainSplit")]))
+        test = Dataset(LogicalPlan([ReadOp([], name="TestSplit")]))
+        train._cached_pairs = train_pairs
+        test._cached_pairs = test_pairs
+        return train, test
+
     # ---- all-to-all ---------------------------------------------------
     def repartition(self, num_blocks: int) -> "Dataset":
         def op(blocks: List[B.Block]) -> List[B.Block]:
@@ -471,6 +553,20 @@ def _zip_task(n_left: int, *blocks):
         merged[k if k not in merged else f"{k}_1"] = v
     ref = rt.put(merged)
     return [(ref, {"num_rows": B.num_rows(merged), "size_bytes": B.size_bytes(merged)})]
+
+
+def _split_block(blk: B.Block, k: int):
+    """Remote boundary-block split for train_test_split."""
+    return B.slice_block(blk, 0, k), B.slice_block(blk, k, B.num_rows(blk))
+
+
+def _pairs_of(block: B.Block) -> List[Tuple]:
+    """One materialized (ref, meta) pair for a host block."""
+    import ray_tpu as rt
+
+    ref = rt.put(block)
+    return [(ref, {"num_rows": B.num_rows(block),
+                   "size_bytes": B.size_bytes(block)})]
 
 
 def _coerce_batch(res) -> B.Block:

@@ -54,36 +54,52 @@ class LlamaService:
         }[model_size]()
         self.params = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
         self.max_new_tokens = max_new_tokens
-        self._max_batch = max_batch_size
+        # instance-level batching config consumed by @serve.batch
+        self.__serve_batch_overrides__ = {
+            "_generate_batch": {"max_batch_size": max_batch_size},
+        }
 
     @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
     async def _generate_batch(self, requests: List[dict]) -> List[List[int]]:
         """Batched generation.  Prompts are grouped by length so each
         group is one [B, T] generate call — XLA compiles per shape, and
         same-shape batches reuse the compiled prefill/decode programs."""
+        import asyncio
+
         import jax.numpy as jnp
 
-        out: List[Optional[List[int]]] = [None] * len(requests)
-        groups = defaultdict(list)
-        for i, req in enumerate(requests):
-            groups[(len(req["tokens"]), req["max_new_tokens"])].append(i)
-        for (T, n_new), idxs in groups.items():
-            arr = jnp.asarray(
-                [requests[i]["tokens"] for i in idxs], jnp.int32
-            )
-            gen = self._llama.generate(
-                self.cfg, self.params, arr, n_new, temperature=0.0
-            )
-            for j, i in enumerate(idxs):
-                out[i] = [int(t) for t in gen[j]]
-        return out
+        def _run_groups():
+            out: List[Optional[List[int]]] = [None] * len(requests)
+            groups = defaultdict(list)
+            for i, req in enumerate(requests):
+                groups[(len(req["tokens"]), req["max_new_tokens"])].append(i)
+            for (T, n_new), idxs in groups.items():
+                arr = jnp.asarray(
+                    [requests[i]["tokens"] for i in idxs], jnp.int32
+                )
+                gen = self._llama.generate(
+                    self.cfg, self.params, arr, n_new, temperature=0.0
+                )
+                for j, i in enumerate(idxs):
+                    out[i] = [int(t) for t in gen[j]]
+            return out
+
+        # the decode loop blocks (per-token device syncs): run it on
+        # the worker pool so the replica's event loop keeps gathering
+        # batches and serving health checks
+        from ray_tpu.core.runtime import get_runtime
+
+        return await asyncio.get_running_loop().run_in_executor(
+            get_runtime()._exec_pool, _run_groups
+        )
 
     async def generate(self, token_lists: List[List[int]],
                        max_new_tokens: Optional[int] = None) -> List[List[int]]:
         """Python-handle surface: a list of prompts (token ids)."""
         import asyncio
 
-        n_new = max_new_tokens or self.max_new_tokens
+        n_new = (max_new_tokens if max_new_tokens is not None
+                 else self.max_new_tokens)
         return list(await asyncio.gather(*[
             self._generate_batch({"tokens": toks, "max_new_tokens": n_new})
             for toks in token_lists

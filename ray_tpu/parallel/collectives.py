@@ -207,16 +207,23 @@ class CollectiveGroup:
         ))
 
     def recv(self, src_rank: int, timeout_s: float = 60.0):
-        """Blocking receive of the next message from src_rank."""
+        """Blocking receive of the next message from src_rank.  The
+        pairwise sequence advances only on success: a timed-out recv
+        leaves the channel aligned, so a retry picks up the message the
+        sender eventually posts."""
         import ray_tpu as rt
 
-        seq = self._p2p_next(src_rank, self.rank)
+        seqs = getattr(self, "_p2p_seq", None)
+        if seqs is None:
+            seqs = self._p2p_seq = {}
+        seq = seqs.get((src_rank, self.rank), 0)
         deadline = time.time() + timeout_s
         while True:
             out = rt.get(self._rdv.p2p_take.remote(
                 (seq, src_rank, self.rank)
             ))
             if not (isinstance(out, str) and out == _PENDING):
+                seqs[(src_rank, self.rank)] = seq + 1
                 return out
             if time.time() > deadline:
                 raise TimeoutError(

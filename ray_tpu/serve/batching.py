@@ -112,7 +112,17 @@ def batch(
                 )
             q = getattr(owner, attr, None)
             if q is None:
-                q = _BatchQueue(call, max_batch_size, batch_wait_timeout_s)
+                # per-instance overrides (reference:
+                # set_max_batch_size/handle options): an owner may carry
+                # `__serve_batch_overrides__ = {method_name: {...}}`
+                over = getattr(owner, "__serve_batch_overrides__", {}).get(
+                    getattr(fn, "__name__", ""), {}
+                )
+                q = _BatchQueue(
+                    call,
+                    over.get("max_batch_size", max_batch_size),
+                    over.get("batch_wait_timeout_s", batch_wait_timeout_s),
+                )
                 setattr(owner, attr, q)
             return await q.submit(item)
 

@@ -157,9 +157,11 @@ class HyperBandScheduler(TrialScheduler):
         b = self._assignment.get(trial.trial_id)
         if b is None:
             # most-exploratory bracket first, like the reference fills
-            # bracket s_max down to 0
+            # bracket s_max down to 0 — surplus trials (count not
+            # divisible by bracket count) land where culling is
+            # cheapest, not in the never-culled full-budget bracket
             b = self._assignment[trial.trial_id] = (
-                self._next_bracket % (self.s_max + 1)
+                self.s_max - self._next_bracket % (self.s_max + 1)
             )
             self._next_bracket += 1
         if t >= self.max_t:

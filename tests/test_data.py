@@ -210,3 +210,24 @@ def test_iter_torch_batches(rt_start):
         assert batch["x"].shape == (25,)
         seen += batch["id"].shape[0]
     assert seen == 100
+
+
+def test_random_sample_unique_train_test_split(rt_start):
+    """Reference surface: Dataset.random_sample / unique /
+    train_test_split."""
+    ds = rd.range(1000, parallelism=4)
+    frac = ds.random_sample(0.3, seed=7)
+    n = frac.count()
+    assert 200 < n < 400  # ~300 expected
+    # deterministic under the same seed
+    assert ds.random_sample(0.3, seed=7).count() == n
+
+    vals = rd.from_items([1, 2, 2, 3, 3, 3]).unique("item")
+    assert sorted(vals) == [1, 2, 3]
+
+    tr, te = rd.range(100).train_test_split(0.2, seed=0)
+    assert tr.count() == 80 and te.count() == 20
+    all_ids = sorted(
+        [r["id"] for r in tr.take_all()] + [r["id"] for r in te.take_all()]
+    )
+    assert all_ids == list(range(100))
