@@ -3,12 +3,16 @@
 Reference: `python/ray/experimental/channel/shared_memory_channel.py:176`
 backed by the native mutable-object manager
 (`experimental_mutable_object_manager.h:48`, `WriteAcquire:153`) —
-writer/reader acquire-release over one shm slot.  Here a channel is a
-small ring of sealed store objects: write = create+seal of slot
-`seq % ring`, read = blocking get + delete (the delete IS the release
-that lets the writer reuse the slot).  Ring depth > 1 gives pipelined
-executions backpressure-bounded exactly like the reference's buffered
-channels.
+writer/reader acquire-release over fixed shm slots.
+
+The fast path is the C++ mutable channel in `shm/shmstore.cc`
+(`rts_chan_*`): a fixed ring of slots with a process-shared
+mutex/condvar, ZERO allocation per message — write serializes straight
+into the slot, publication is a sequence bump + broadcast, and the
+reader's release hands the slot back (the same acquire/release protocol
+as the reference's native channels).  Payloads larger than a slot fall
+back to one store object per message; the slot then carries only the
+object id.
 
 Single-node scope (the compiled-graph fast path); cross-node stages fall
 back to the ordinary actor-call path.
@@ -18,17 +22,20 @@ from __future__ import annotations
 
 import hashlib
 import struct
-import time
 from typing import Any, Optional, Tuple
 
 from ray_tpu.core import serialization as ser
+from ray_tpu.shm import ChannelClosedError
 
-# payload kinds
+# payload kinds (the ChanSlot.kind field)
 KIND_DATA = 0
 KIND_ERROR = 1
 KIND_SENTINEL = 2  # teardown marker, forwarded downstream
+KIND_SPILL_DATA = 3  # oversized: payload lives in a store object
+KIND_SPILL_ERROR = 4
 
 _RING = 8  # in-flight executions before writers block
+_SLOT_BYTES = 128 * 1024  # inline payload budget per slot
 
 
 class ChannelClosed(Exception):
@@ -42,7 +49,7 @@ class ChannelPollTimeout(Exception):
 
 
 def _chan_hash(name: str) -> bytes:
-    return hashlib.blake2b(name.encode(), digest_size=16).digest()
+    return hashlib.blake2b(name.encode(), digest_size=18).digest()
 
 
 class Channel:
@@ -51,70 +58,118 @@ class Channel:
     def __init__(self, name: str):
         self.name = name
         self._h = _chan_hash(name)
+        # separate hash domain: a spill key must never collide with the
+        # channel's own id (deleting it would destroy the live region)
+        self._spill_h = hashlib.blake2b(
+            (name + "/spill").encode(), digest_size=16
+        ).digest()
         self._read_seq = 0
         self._write_seq = 0
+        self._opened = False
 
     def _store(self):
         from ray_tpu.core.runtime import get_runtime
 
-        return get_runtime().store
+        store = get_runtime().store
+        if not self._opened:
+            store.chan_create(self._h, nslots=_RING, slot_size=_SLOT_BYTES)
+            self._opened = True
+        return store
 
-    def _key(self, seq: int) -> bytes:
-        return self._h + struct.pack("<H", seq % 65536)
+    def _spill_key(self, seq: int) -> bytes:
+        return self._spill_h + struct.pack("<H", seq % 65536)
 
     # -- writer side ---------------------------------------------------
     def write(self, value: Any, kind: int = KIND_DATA,
               timeout_s: float = 120.0):
         store = self._store()
-        seq = self._write_seq
-        if seq >= _RING:
-            # slot reuse: wait for the reader to release (delete) the
-            # object written _RING executions ago
-            old = self._key(seq - _RING)
-            deadline = time.monotonic() + timeout_s
-            while store.contains(old):
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"channel {self.name}: reader lagging >{_RING} "
-                        "executions behind"
-                    )
-                time.sleep(0.0002)
         if kind == KIND_DATA:
             payload = ser.serialize_to_bytes(value)
         elif kind == KIND_ERROR:
             payload = ser.serialize_to_bytes(value, tag=ser.TAG_ERROR)
         else:
             payload = b""
-        store.put(self._key(seq), bytes([kind]) + bytes(payload))
+        timeout_ms = max(1, int(timeout_s * 1000))
+        try:
+            if len(payload) <= _SLOT_BYTES:
+                store.chan_write(self._h, payload, kind=kind,
+                                 timeout_ms=timeout_ms)
+            else:
+                key = self._spill_key(self._write_seq)
+                if store.contains(key):
+                    store.delete(key)  # leftover from a failed attempt
+                store.put(key, payload)
+                spill_kind = (KIND_SPILL_ERROR if kind == KIND_ERROR
+                              else KIND_SPILL_DATA)
+                try:
+                    store.chan_write(self._h, key, kind=spill_kind,
+                                     timeout_ms=timeout_ms)
+                except Exception:
+                    store.delete(key)  # unpublished: reclaim it
+                    raise
+        except ChannelClosedError:
+            raise ChannelClosed(self.name) from None
+        except TimeoutError:
+            raise TimeoutError(
+                f"channel {self.name}: reader lagging >{_RING} "
+                "executions behind"
+            ) from None
         self._write_seq += 1
 
     def write_error(self, err: BaseException):
         self.write(err, kind=KIND_ERROR)
 
     def close(self):
-        """Send the teardown sentinel."""
+        """Send the teardown sentinel, then mark the ring closed (the
+        reader drains published messages before seeing closed)."""
         try:
             self.write(None, kind=KIND_SENTINEL, timeout_s=5.0)
+        except Exception:
+            pass
+        try:
+            self._store().chan_close(self._h)
+        except Exception:
+            pass
+
+    def destroy(self):
+        """Free the channel's pinned shm region.  Called at DAG
+        teardown AFTER the endpoints exited — channels are allocated
+        non-evictable, so without this every compiled DAG would leak
+        arena permanently."""
+        from ray_tpu.core.runtime import get_runtime
+
+        store = get_runtime().store
+        try:
+            store.chan_close(self._h)
+        except Exception:
+            pass
+        try:
+            store.chan_delete(self._h)
         except Exception:
             pass
 
     # -- reader side ---------------------------------------------------
     def read_raw(self, timeout_s: Optional[float] = None) -> Tuple[int, bytes]:
         store = self._store()
-        key = self._key(self._read_seq)
         timeout_ms = -1 if timeout_s is None else max(1, int(timeout_s * 1000))
         try:
-            view = store.get(key, timeout_ms=timeout_ms)
+            kind, data = store.chan_read(self._h, timeout_ms=timeout_ms)
         except TimeoutError as e:
             raise ChannelPollTimeout(str(e)) from None
-        try:
-            data = bytes(view)
-        finally:
-            del view
-            store.release(key)
-            store.delete(key)
+        except ChannelClosedError:
+            raise ChannelClosed(self.name) from None
+        if kind in (KIND_SPILL_DATA, KIND_SPILL_ERROR):
+            key = bytes(data)
+            view = store.get(key, timeout_ms=timeout_ms)
+            try:
+                data = bytes(view)
+            finally:
+                del view
+                store.release(key)
+                store.delete(key)
+            kind = KIND_ERROR if kind == KIND_SPILL_ERROR else KIND_DATA
         self._read_seq += 1
-        return data[0], data[1:]
+        return kind, data
 
     def read(self, timeout_s: Optional[float] = None) -> Any:
         kind, payload = self.read_raw(timeout_s)

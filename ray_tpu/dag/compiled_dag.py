@@ -126,6 +126,7 @@ class CompiledDAG:
             aid: {"input_channel": None, "steps": []} for aid in by_actor
         }
         self._input_channels: List[Channel] = []
+        self._mid_channel_names: List[str] = []
 
         def arg_source(consumer: ClassMethodNode, arg) -> Tuple[str, Any]:
             if isinstance(arg, InputNode):
@@ -143,6 +144,7 @@ class CompiledDAG:
                 name = self._chan_name(arg._id, f"n{consumer._id}")
                 # register the edge on the producer's step
                 producer_step[arg._id]["out_channels"].append(name)
+                self._mid_channel_names.append(name)
                 return (ex.SRC_CHAN, name)
             if isinstance(arg, DAGNode):
                 raise TypeError(f"unsupported node type {type(arg)}")
@@ -268,6 +270,12 @@ class CompiledDAG:
                     timeout=10)
         except Exception:
             pass
+        # free every channel region: they are pinned + non-evictable,
+        # so skipping this would leak arena on every compile/teardown
+        for ch in [*self._input_channels, *self._output_channels]:
+            ch.destroy()
+        for name in getattr(self, "_mid_channel_names", ()):  # actor-to-
+            Channel(name).destroy()  # actor edges (opened in exec loops)
 
     def __del__(self):
         try:

@@ -560,15 +560,6 @@ def _split_block(blk: B.Block, k: int):
     return B.slice_block(blk, 0, k), B.slice_block(blk, k, B.num_rows(blk))
 
 
-def _pairs_of(block: B.Block) -> List[Tuple]:
-    """One materialized (ref, meta) pair for a host block."""
-    import ray_tpu as rt
-
-    ref = rt.put(block)
-    return [(ref, {"num_rows": B.num_rows(block),
-                   "size_bytes": B.size_bytes(block)})]
-
-
 def _coerce_batch(res) -> B.Block:
     if isinstance(res, dict):
         return {k: np.asarray(v) for k, v in res.items()}

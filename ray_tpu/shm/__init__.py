@@ -71,6 +71,17 @@ def _load():
             lib.rts_reap_creator.argtypes = [p, u64]
             lib.rts_spill_candidates.restype = u64
             lib.rts_spill_candidates.argtypes = [p, ctypes.c_char_p, u64]
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            u64p = ctypes.POINTER(u64)
+            lib.rts_chan_create.argtypes = [p, ctypes.c_char_p, u64, u64]
+            lib.rts_chan_write_acquire.argtypes = [
+                p, ctypes.c_char_p, ctypes.c_int64, u64p, u64p]
+            lib.rts_chan_write_seal.argtypes = [
+                p, ctypes.c_char_p, u64, ctypes.c_uint32]
+            lib.rts_chan_read_acquire.argtypes = [
+                p, ctypes.c_char_p, ctypes.c_int64, u64p, u64p, u32p]
+            lib.rts_chan_read_release.argtypes = [p, ctypes.c_char_p]
+            lib.rts_chan_close.argtypes = [p, ctypes.c_char_p]
             for fn in ("rts_used", "rts_capacity", "rts_count", "rts_evictions"):
                 getattr(lib, fn).restype = u64
                 getattr(lib, fn).argtypes = [p]
@@ -91,6 +102,10 @@ class ObjectNotFoundError(ShmStoreError):
 
 
 class StoreFullError(ShmStoreError):
+    pass
+
+
+class ChannelClosedError(ShmStoreError):
     pass
 
 
@@ -235,3 +250,80 @@ class ShmStore:
     @property
     def evictions(self) -> int:
         return _load().rts_evictions(self._h)
+
+    # -- mutable channels ----------------------------------------------
+    def chan_create(self, chan_id: bytes, nslots: int = 8,
+                    slot_size: int = 128 * 1024) -> bool:
+        """Create (or open, if the peer already created it) a mutable
+        SPSC channel — the native substrate for compiled-DAG channels
+        (reference: `experimental_mutable_object_manager.h:48`).
+        Returns True if this call created it."""
+        rc = _load().rts_chan_create(
+            self._h, _pad_id(chan_id), nslots, slot_size
+        )
+        if rc == OK:
+            return True
+        if rc == EXISTS:
+            return False
+        _check(rc, f"chan_create {chan_id.hex()}")
+        return False
+
+    def chan_write(self, chan_id: bytes, payload, kind: int = 0,
+                   timeout_ms: int = -1):
+        """Acquire a slot (blocking while the ring is full), copy the
+        payload in, publish.  Zero allocation per message."""
+        lib = _load()
+        cid = _pad_id(chan_id)
+        off = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        rc = lib.rts_chan_write_acquire(
+            self._h, cid, timeout_ms, ctypes.byref(off), ctypes.byref(cap)
+        )
+        if rc == BAD_STATE:
+            raise ChannelClosedError(chan_id.hex())
+        _check(rc, f"chan_write_acquire {chan_id.hex()}")
+        data = payload if isinstance(payload, (bytes, bytearray, memoryview)) \
+            else bytes(payload)
+        n = len(data)
+        if n > cap.value:
+            raise ValueError(
+                f"payload {n}B exceeds channel slot size {cap.value}B"
+            )
+        self._view[off.value:off.value + n] = bytes(data)
+        _check(
+            lib.rts_chan_write_seal(self._h, cid, n, kind),
+            f"chan_write_seal {chan_id.hex()}",
+        )
+
+    def chan_read(self, chan_id: bytes, timeout_ms: int = -1):
+        """Blocking read: returns (kind, bytes) of the next message and
+        releases the slot back to the writer."""
+        lib = _load()
+        cid = _pad_id(chan_id)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        kind = ctypes.c_uint32()
+        rc = lib.rts_chan_read_acquire(
+            self._h, cid, timeout_ms, ctypes.byref(off), ctypes.byref(size),
+            ctypes.byref(kind),
+        )
+        if rc == BAD_STATE:
+            raise ChannelClosedError(chan_id.hex())
+        _check(rc, f"chan_read_acquire {chan_id.hex()}")
+        data = bytes(self._view[off.value:off.value + size.value])
+        _check(
+            lib.rts_chan_read_release(self._h, cid),
+            f"chan_read_release {chan_id.hex()}",
+        )
+        return kind.value, data
+
+    def chan_close(self, chan_id: bytes):
+        """Mark closed: readers drain then see ChannelClosedError;
+        writers fail immediately."""
+        rc = _load().rts_chan_close(self._h, _pad_id(chan_id))
+        if rc not in (OK, NOT_FOUND):
+            _check(rc, f"chan_close {chan_id.hex()}")
+
+    def chan_delete(self, chan_id: bytes):
+        self.release(chan_id)  # drop the create-time pin
+        self.delete(chan_id)

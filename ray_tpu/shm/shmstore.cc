@@ -589,4 +589,250 @@ uint64_t rts_capacity(void* hv) { return ((Handle*)hv)->hdr->arena_size; }
 uint64_t rts_count(void* hv) { return ((Handle*)hv)->hdr->num_objects; }
 uint64_t rts_evictions(void* hv) { return ((Handle*)hv)->hdr->num_evictions; }
 
+// ---- mutable channels ------------------------------------------------
+//
+// Native substrate for compiled-DAG channels, the design of the
+// reference's mutable objects (`experimental_mutable_object_manager.h:48`
+// WriteAcquire:153 / ReadAcquire / ReadRelease): one fixed shm region
+// per channel with writer/reader acquire-release over a ring of slots.
+// Unlike the per-message create/seal/get/delete path through the object
+// table, a channel does ZERO allocation per message — the writer
+// serializes straight into its slot, publication is a seq bump +
+// condvar broadcast, and the reader's release hands the slot back.
+// SPSC by contract (one producer, one consumer per channel), which is
+// exactly the compiled-DAG topology.
+//
+// The channel region is an ordinary arena allocation registered in the
+// object table as a pinned sealed entry, so eviction/spilling never
+// touches it and teardown is a plain delete.
+
+struct ChanSlot {
+  uint64_t size;
+  uint32_t kind;
+  uint32_t pad_;
+};
+
+struct ChanHeader {
+  uint64_t magic;  // kChanMagic
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  uint64_t nslots;
+  uint64_t slot_size;
+  uint64_t write_seq;  // published messages
+  uint64_t read_seq;   // consumed messages
+  uint32_t closed;
+  uint32_t pad_;
+  // ChanSlot[nslots] follows, then payloads (each slot_size, aligned)
+};
+
+static const uint64_t kChanMagic = 0x525453434841'4eULL;  // "RTSCHAN"
+
+static ChanSlot* chan_slots(uint8_t* ch) {
+  return reinterpret_cast<ChanSlot*>(ch + sizeof(ChanHeader));
+}
+
+static uint64_t chan_payload_off(ChanHeader* c, uint64_t slot) {
+  uint64_t meta = align_up(sizeof(ChanHeader) + c->nslots * sizeof(ChanSlot), kAlign);
+  return meta + slot * align_up(c->slot_size, kAlign);
+}
+
+static uint64_t chan_region_bytes(uint64_t nslots, uint64_t slot_size) {
+  return align_up(sizeof(ChanHeader) + nslots * sizeof(ChanSlot), kAlign) +
+         nslots * align_up(slot_size, kAlign);
+}
+
+static ChanHeader* chan_of(Handle* h, const uint8_t* id, uint64_t* base_off) {
+  Entry* e = find_entry(h, id);
+  if (!e || e->state != ENTRY_SEALED) return nullptr;
+  ChanHeader* c = reinterpret_cast<ChanHeader*>(h->base + e->off);
+  if (c->magic != kChanMagic) return nullptr;
+  if (base_off) *base_off = e->off;
+  return c;
+}
+
+static void chan_lock(ChanHeader* c) {
+  if (pthread_mutex_lock(&c->mu) == EOWNERDEAD) pthread_mutex_consistent(&c->mu);
+}
+
+// Opener side of the race: the creating peer's entry exists but may
+// still be ENTRY_CREATED (header not yet initialized).  A blocking get
+// waits for the seal (rts_seal broadcasts), then the pin is returned —
+// the creator's create-time pin is the one that keeps the region alive.
+static int chan_wait_ready(void* hv, const uint8_t* id) {
+  uint64_t o, s;
+  int rc = rts_get(hv, id, /*timeout_ms=*/10000, &o, &s);
+  if (rc != RTS_OK) return rc;
+  rts_release(hv, id);
+  return RTS_EXISTS;
+}
+
+int rts_chan_create(void* hv, const uint8_t* id, uint64_t nslots,
+                    uint64_t slot_size) {
+  Handle* h = (Handle*)hv;
+  Header* hdr = h->hdr;
+  lock(hdr);
+  bool exists = find_entry(h, id) != nullptr;
+  unlock(hdr);
+  if (exists) return chan_wait_ready(hv, id);
+  uint64_t bytes = chan_region_bytes(nslots, slot_size);
+  uint64_t off;
+  int rc = rts_create_ex(hv, id, bytes, &off, /*allow_evict=*/0);
+  if (rc == RTS_EXISTS) return chan_wait_ready(hv, id);
+  if (rc != RTS_OK) return rc;
+  ChanHeader* c = reinterpret_cast<ChanHeader*>(h->base + off);
+  memset(c, 0, sizeof(ChanHeader));
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&c->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&c->cv, &ca);
+  c->nslots = nslots;
+  c->slot_size = slot_size;
+  c->magic = kChanMagic;
+  rc = rts_seal(hv, id);
+  if (rc != RTS_OK) return rc;
+  // pin forever (until delete): the channel must never be evicted
+  uint64_t o, s;
+  return rts_get(hv, id, 0, &o, &s);
+}
+
+static void chan_deadline(struct timespec* ts, int64_t timeout_ms) {
+  clock_gettime(CLOCK_MONOTONIC, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec++;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// Writer: block until a slot is free, return payload offset to fill.
+int rts_chan_write_acquire(void* hv, const uint8_t* id, int64_t timeout_ms,
+                           uint64_t* out_off, uint64_t* out_cap) {
+  Handle* h = (Handle*)hv;
+  uint64_t base_off;
+  ChanHeader* c = chan_of(h, id, &base_off);
+  if (!c) return RTS_NOT_FOUND;
+  struct timespec dl;
+  if (timeout_ms > 0) chan_deadline(&dl, timeout_ms);
+  chan_lock(c);
+  for (;;) {
+    if (c->closed) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_BAD_STATE;
+    }
+    if (c->write_seq - c->read_seq < c->nslots) {
+      uint64_t slot = c->write_seq % c->nslots;
+      *out_off = base_off + chan_payload_off(c, slot);
+      *out_cap = c->slot_size;
+      pthread_mutex_unlock(&c->mu);
+      return RTS_OK;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&c->cv, &c->mu);
+    } else if (timeout_ms == 0) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_TIMEOUT;
+    } else {
+      rc = pthread_cond_timedwait(&c->cv, &c->mu, &dl);
+    }
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_TIMEOUT;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&c->mu);
+  }
+}
+
+// Writer: publish the acquired slot.
+int rts_chan_write_seal(void* hv, const uint8_t* id, uint64_t size,
+                        uint32_t kind) {
+  Handle* h = (Handle*)hv;
+  ChanHeader* c = chan_of(h, id, nullptr);
+  if (!c) return RTS_NOT_FOUND;
+  if (size > c->slot_size) return RTS_OOM;
+  chan_lock(c);
+  uint64_t slot = c->write_seq % c->nslots;
+  ChanSlot* s = &chan_slots(reinterpret_cast<uint8_t*>(c))[slot];
+  s->size = size;
+  s->kind = kind;
+  c->write_seq++;
+  pthread_cond_broadcast(&c->cv);
+  pthread_mutex_unlock(&c->mu);
+  return RTS_OK;
+}
+
+// Reader: block until a message is published; returns payload location.
+int rts_chan_read_acquire(void* hv, const uint8_t* id, int64_t timeout_ms,
+                          uint64_t* out_off, uint64_t* out_size,
+                          uint32_t* out_kind) {
+  Handle* h = (Handle*)hv;
+  uint64_t base_off;
+  ChanHeader* c = chan_of(h, id, &base_off);
+  if (!c) return RTS_NOT_FOUND;
+  struct timespec dl;
+  if (timeout_ms > 0) chan_deadline(&dl, timeout_ms);
+  chan_lock(c);
+  for (;;) {
+    if (c->read_seq < c->write_seq) {
+      uint64_t slot = c->read_seq % c->nslots;
+      ChanSlot* s = &chan_slots(reinterpret_cast<uint8_t*>(c))[slot];
+      *out_off = base_off + chan_payload_off(c, slot);
+      *out_size = s->size;
+      *out_kind = s->kind;
+      pthread_mutex_unlock(&c->mu);
+      return RTS_OK;
+    }
+    if (c->closed) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_BAD_STATE;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&c->cv, &c->mu);
+    } else if (timeout_ms == 0) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_TIMEOUT;
+    } else {
+      rc = pthread_cond_timedwait(&c->cv, &c->mu, &dl);
+    }
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&c->mu);
+      return RTS_TIMEOUT;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&c->mu);
+  }
+}
+
+// Reader: consume the acquired message (slot returns to the writer).
+int rts_chan_read_release(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  ChanHeader* c = chan_of(h, id, nullptr);
+  if (!c) return RTS_NOT_FOUND;
+  chan_lock(c);
+  c->read_seq++;
+  pthread_cond_broadcast(&c->cv);
+  pthread_mutex_unlock(&c->mu);
+  return RTS_OK;
+}
+
+// Either endpoint: mark closed; blocked/future acquires fail BAD_STATE
+// (readers drain published messages first).
+int rts_chan_close(void* hv, const uint8_t* id) {
+  Handle* h = (Handle*)hv;
+  ChanHeader* c = chan_of(h, id, nullptr);
+  if (!c) return RTS_NOT_FOUND;
+  chan_lock(c);
+  c->closed = 1;
+  pthread_cond_broadcast(&c->cv);
+  pthread_mutex_unlock(&c->mu);
+  return RTS_OK;
+}
+
 }  // extern "C"

@@ -147,3 +147,27 @@ def test_unbounded_source_rejected(cluster):
     dag = w.double.bind(1)  # no InputNode anywhere
     with pytest.raises(ValueError, match="InputNode"):
         dag.experimental_compile()
+
+
+def test_dag_teardown_frees_channel_arena(cluster):
+    """Channel regions are pinned + non-evictable; teardown must return
+    them to the arena or repeated compile/teardown leaks it."""
+    from ray_tpu.core.runtime import get_runtime
+
+    @rt.remote
+    class S:
+        def f(self, x):
+            return x + 1
+
+    a = S.remote()
+    store = get_runtime().store
+    used_before = store.used
+    for _ in range(3):
+        with InputNode() as inp:
+            dag = a.f.bind(inp)
+        c = dag.experimental_compile()
+        assert c.execute(1).get() == 2
+        c.teardown()
+    # no monotonic growth: all channel regions freed (small slack for
+    # unrelated runtime objects)
+    assert store.used <= used_before + 256 * 1024, (used_before, store.used)

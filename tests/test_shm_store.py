@@ -8,6 +8,8 @@ delete semantics, blocking get across processes, orphan reaping.
 import multiprocessing as mp
 import os
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import numpy as np
 import pytest
 
@@ -151,3 +153,59 @@ def test_reap_orphans_from_dead_creator(store):
 def test_timeout(store):
     with pytest.raises(TimeoutError):
         store.get(oid(), timeout_ms=50)
+
+
+# ----------------------------------------------------------------------
+# native mutable channels (reference:
+# experimental_mutable_object_manager.h:48 WriteAcquire/ReadAcquire)
+# ----------------------------------------------------------------------
+def test_channel_roundtrip_and_ring(store):
+    from ray_tpu.shm import ChannelClosedError
+
+    cid = bytes(range(18))
+    assert store.chan_create(cid, nslots=4, slot_size=512)
+    assert not store.chan_create(cid)  # peer open is idempotent
+    for i in range(9):  # > nslots: ring reuse works
+        store.chan_write(cid, f"m{i}".encode(), kind=i % 3)
+        k, d = store.chan_read(cid)
+        assert (k, d) == (i % 3, f"m{i}".encode())
+    # full ring blocks the writer
+    for _ in range(4):
+        store.chan_write(cid, b"x", timeout_ms=200)
+    with pytest.raises(TimeoutError):
+        store.chan_write(cid, b"y", timeout_ms=100)
+    for _ in range(4):
+        store.chan_read(cid)
+    # close: reader drains then sees closed; writer fails
+    store.chan_write(cid, b"last")
+    store.chan_close(cid)
+    assert store.chan_read(cid)[1] == b"last"
+    with pytest.raises(ChannelClosedError):
+        store.chan_read(cid, timeout_ms=100)
+    store.chan_delete(cid)
+
+
+def test_channel_cross_process(store):
+    """Producer in a real subprocess; consumer here — the compiled-DAG
+    topology."""
+    import subprocess
+    import sys
+
+    cid = bytes(reversed(range(18)))
+    store.chan_create(cid, nslots=8, slot_size=4096)
+    code = f"""
+import sys
+sys.path.insert(0, {repr(ROOT)})
+from ray_tpu.shm import ShmStore
+s = ShmStore({store.name!r})
+cid = bytes(reversed(range(18)))
+for i in range(200):
+    s.chan_write(cid, (b"payload-%d" % i) * 10, kind=1)
+s.chan_close(cid)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", code])
+    for i in range(200):
+        k, d = store.chan_read(cid, timeout_ms=30000)
+        assert k == 1 and d == (b"payload-%d" % i) * 10
+    assert proc.wait(timeout=30) == 0
+    store.chan_delete(cid)
