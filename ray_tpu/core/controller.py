@@ -246,6 +246,9 @@ class Controller:
     async def handle_kv_get(self, payload, conn):
         return self.kv.get(payload["key"])
 
+    async def handle_kv_exists(self, payload, conn):
+        return payload["key"] in self.kv
+
     async def handle_kv_del(self, payload, conn):
         self.kv.pop(payload["key"], None)
         self._mark_dirty()

@@ -702,6 +702,22 @@ class NodeDaemon:
 
     handle_stream_item = handle_task_stream
 
+    async def handle_list_workers(self, payload, conn):
+        """Worker inventory for the state API and fault-injection
+        harnesses (reference: worker listing via the dashboard state
+        aggregator + `_private/test_utils.py` killer actors)."""
+        return [
+            {
+                "worker_id": w.worker_id,
+                "pid": w.pid,
+                "kind": w.kind,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+                "idle": w.idle,
+                "node_id": self.node_id,
+            }
+            for w in self.workers.values()
+        ]
+
     async def handle_stream_cancel(self, payload, conn):
         """Abandoned-stream stop signal for a daemon-dispatched task.
         The owner doesn't know where it runs: target the local worker

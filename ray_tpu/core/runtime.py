@@ -770,6 +770,34 @@ class Runtime:
         return self._run(self._create_actor(cls, args, kwargs, options))
 
     async def _create_actor(self, cls, args, kwargs, options):
+        renv = options.get("runtime_env")
+        if renv and renv.get("py_modules"):
+            # package locally, ship once via KV; the spec carries only
+            # (name, key) pairs (reference: runtime_env packaging
+            # uploads to the GCS, `runtime_env/packaging.py`)
+            from ray_tpu.core.runtime_env import package_py_modules
+
+            uploaded = getattr(self, "_pymod_uploaded", None)
+            if uploaded is None:
+                uploaded = self._pymod_uploaded = set()
+            entries = []
+            for name, key, pkg_blob in package_py_modules(
+                renv["py_modules"]
+            ):
+                # content-addressed: repeat creations (actor fleets)
+                # skip the re-upload entirely
+                if key not in uploaded and not await self.controller.call(
+                    "kv_exists", {"key": key}
+                ):
+                    await self.controller.call(
+                        "kv_put", {"key": key, "value": pkg_blob}
+                    )
+                uploaded.add(key)
+                entries.append((name, key))
+            renv = dict(renv)
+            renv["py_modules"] = entries
+            options = dict(options)
+            options["runtime_env"] = renv
         blob = ser.dumps_oob(cls)
         cid = function_id_of(blob)
         actor_id = ActorID.of(self.job_id)
@@ -1562,6 +1590,21 @@ class Runtime:
 
                 if wd not in _sys.path:
                     _sys.path.insert(0, wd)
+            for _name, key in renv.get("py_modules", ()):
+                # fetch + extract BEFORE the class blob deserializes:
+                # the pickle may import this module
+                from ray_tpu.core.runtime_env import materialize_py_module
+
+                pkg_blob = await self.controller.call("kv_get", {"key": key})
+                if pkg_blob is None:
+                    raise exc.RayTpuError(
+                        f"py_module package {key} missing from KV"
+                    )
+                dest = materialize_py_module(key, pkg_blob)
+                import sys as _sys
+
+                if dest not in _sys.path:
+                    _sys.path.insert(0, dest)
         cls = ser.loads(aspec.class_blob)
         self.actor_id = aspec.actor_id
         self._actor_aspec = aspec

@@ -1,0 +1,97 @@
+"""Runtime-environment materialization helpers.
+
+Reference: `python/ray/_private/runtime_env/` — per-actor environments
+shipped from the driver and materialized on the executing worker.
+Supported here: `env_vars`, `working_dir`, and `py_modules` (this
+module): local packages/files are zipped on the driver, stored once in
+the controller KV under their content hash (the reference uploads
+packages to the GCS the same way, `runtime_env/packaging.py`), and
+extracted into a content-addressed cache on the worker before the
+actor's class deserializes — so by-value pickles that import the
+module resolve even on hosts that never saw the driver's filesystem
+layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from typing import Any, List, Sequence, Tuple
+
+_CACHE_ROOT = os.path.join(
+    os.environ.get("RT_TMPDIR", "/tmp/ray_tpu"), "py_modules_cache"
+)
+
+
+def _module_root(mod: Any) -> str:
+    """Filesystem root of a module object or an explicit path string."""
+    if isinstance(mod, str):
+        return os.path.abspath(mod)
+    path = getattr(mod, "__path__", None)
+    if path:  # package
+        return os.path.abspath(list(path)[0])
+    f = getattr(mod, "__file__", None)
+    if f:
+        return os.path.abspath(f)
+    raise ValueError(f"cannot locate module source for {mod!r}")
+
+
+def package_py_modules(mods: Sequence[Any]) -> List[Tuple[str, str, bytes]]:
+    """Zip each module/path.  Returns [(import_name, kv_key, zip_bytes)]
+    — kv_key is content-addressed, so identical code ships once."""
+    out = []
+    for mod in mods:
+        root = _module_root(mod)
+        name = os.path.basename(root.rstrip("/"))
+        buf = io.BytesIO()
+
+        def _add(z, full, rel):
+            # fixed timestamp + sorted walk: the key must depend on
+            # CONTENT only, or fresh checkouts (new mtimes) re-upload
+            # byte-identical code under new keys
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            with open(full, "rb") as f:
+                z.writestr(info, f.read())
+
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            if os.path.isdir(root):
+                for dirpath, dirnames, filenames in os.walk(root):
+                    dirnames[:] = sorted(
+                        d for d in dirnames if d != "__pycache__"
+                    )
+                    for fn in sorted(filenames):
+                        if fn.endswith(".pyc"):
+                            continue
+                        full = os.path.join(dirpath, fn)
+                        rel = os.path.join(
+                            name, os.path.relpath(full, root)
+                        )
+                        _add(z, full, rel)
+            else:
+                _add(z, root, name)
+        blob = buf.getvalue()
+        key = "pymod:" + hashlib.sha256(blob).hexdigest()[:32]
+        out.append((name, key, blob))
+    return out
+
+
+def materialize_py_module(key: str, blob: bytes) -> str:
+    """Extract one packaged module into the content-addressed cache and
+    return the directory to put on sys.path.  Idempotent across
+    processes: first extractor wins via atomic rename."""
+    dest = os.path.join(_CACHE_ROOT, key.split(":", 1)[1])
+    if not os.path.isdir(dest):
+        os.makedirs(_CACHE_ROOT, exist_ok=True)
+        tmp = f"{dest}.tmp.{os.getpid()}"
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # peer won the race
+    return dest

@@ -142,8 +142,18 @@ def ingress(_app=None, **_kwargs):
 # ----------------------------------------------------------------------
 # controller / proxy lifecycle
 # ----------------------------------------------------------------------
-def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True):
-    """Start the serve control plane (reference: `serve/api.py` serve.start)."""
+def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
+          grpc_options: Optional[Dict[str, Any]] = None):
+    """Start the serve control plane (reference: `serve/api.py` serve.start).
+
+    grpc_options mirrors the reference's gRPCProxy surface
+    (`proxy.py:545`); it is gated on grpcio, which this deployment
+    image does not ship — pass None (default) to serve over HTTP."""
+    if grpc_options is not None:
+        raise NotImplementedError(
+            "the gRPC proxy is not wired in this build (grpcio is not "
+            "part of the supported image); serve over HTTP (http_options)"
+        )
     with _state_lock:
         # stale module state survives a full runtime shutdown+restart in
         # the same process (the cached handles point into the DEAD

@@ -1,0 +1,76 @@
+"""Fault-injection utilities for tests and chaos runs.
+
+Reference: `python/ray/_private/test_utils.py` — `WorkerKillerActor`
+(:1597), `RayletKiller` (:1536), `ResourceKillerActor` (:1433): actors
+that kill cluster components on a cadence while a workload runs, the
+substrate of the reference's chaos suites
+(`release/nightly_tests/setup_chaos.py`).  Single-host clusters (the
+`cluster_utils.Cluster` test shape) let killers deliver straight
+SIGKILLs by pid.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import time
+from typing import List, Optional
+
+import ray_tpu as rt
+
+
+def list_workers() -> List[dict]:
+    """All pool workers on the local node (id, pid, kind, idle)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().noded_call("list_workers") or []
+
+
+def kill_random_worker(*, busy_only: bool = True,
+                       exclude_actors: bool = True,
+                       rng: Optional[random.Random] = None) -> Optional[int]:
+    """SIGKILL one worker; returns the pid or None if no candidate.
+    The runtime's worker-death path turns this into retriable task
+    failures / actor restarts — the property chaos tests assert."""
+    rng = rng or random
+    candidates = [
+        w for w in list_workers()
+        if w["kind"] == "worker"
+        and (not busy_only or not w["idle"])
+        and (not exclude_actors or w["actor_id"] is None)
+        and w["pid"] != os.getpid()
+    ]
+    if not candidates:
+        return None
+    victim = rng.choice(candidates)
+    try:
+        os.kill(victim["pid"], signal.SIGKILL)
+    except ProcessLookupError:
+        return None
+    return victim["pid"]
+
+
+@rt.remote(max_concurrency=2)  # stop() must interleave with run()
+class WorkerKiller:
+    """Resident killer: SIGKILLs a random busy task worker every
+    `interval_s` until stopped (reference: WorkerKillerActor)."""
+
+    def __init__(self, interval_s: float = 0.5, seed: int = 0):
+        self.interval_s = interval_s
+        self.rng = random.Random(seed)
+        self.killed: List[int] = []
+        self._stop = False
+
+    def run(self, duration_s: float = 10.0) -> List[int]:
+        deadline = time.time() + duration_s
+        while not self._stop and time.time() < deadline:
+            pid = kill_random_worker(rng=self.rng)
+            if pid is not None:
+                self.killed.append(pid)
+            time.sleep(self.interval_s)
+        return self.killed
+
+    def stop(self) -> List[int]:
+        self._stop = True
+        return self.killed

@@ -1,0 +1,75 @@
+"""Chaos tests: workloads complete while components are killed.
+
+Modeled on the reference's fault-injection suites
+(`release/nightly_tests/setup_chaos.py`, killer actors in
+`_private/test_utils.py`, chaos-kill tests like
+`tests/test_actor_failures.py` / `test_network_failure_e2e.py`).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_task_storm_survives_worker_kills(cluster):
+    """Retriable tasks all complete while a killer SIGKILLs busy
+    workers underneath them."""
+    from ray_tpu.testing import WorkerKiller
+
+    @rt.remote(max_retries=8)
+    def work(i):
+        time.sleep(0.05)
+        return i * 3
+
+    killer = WorkerKiller.options(num_cpus=0).remote(interval_s=0.3, seed=1)
+    kill_run = killer.run.remote(duration_s=6.0)
+    refs = [work.remote(i) for i in range(300)]
+    results = rt.get(refs, timeout=120)
+    assert results == [i * 3 for i in range(300)]
+    killed = rt.get(kill_run, timeout=30)
+    assert killed, "chaos run killed nothing — test proved nothing"
+    rt.kill(killer)
+
+
+def test_actor_calls_survive_worker_kill(cluster):
+    """A restartable actor keeps serving across a SIGKILL of its
+    worker (reference: test_actor_failures.py restart coverage)."""
+    from ray_tpu.testing import list_workers
+
+    import os
+    import signal
+
+    @rt.remote(max_restarts=3, max_task_retries=4)
+    class Survivor:
+        def __init__(self):
+            self.boot = time.time()
+
+        def ping(self, x):
+            return x + 1
+
+    s = Survivor.remote()
+    assert rt.get(s.ping.remote(1), timeout=30) == 2
+    victim = next(
+        w for w in list_workers()
+        if w["actor_id"] == s._actor_id.hex()
+    )
+    os.kill(victim["pid"], signal.SIGKILL)
+    deadline = time.time() + 60
+    value = None
+    while time.time() < deadline:
+        try:
+            value = rt.get(s.ping.remote(10), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert value == 11
+    rt.kill(s)

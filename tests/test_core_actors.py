@@ -202,3 +202,27 @@ def test_cancel_actor_task_preserves_ordering(cluster):
     assert rt.get(a.ping.remote(), timeout=30) == "pong"
     assert rt.get(first, timeout=30) == 1.0
     assert outcome in ("cancelled", 0.5)
+
+
+def test_runtime_env_py_modules(cluster, tmp_path):
+    """Actors with runtime_env py_modules import driver-local packages
+    the workers have never seen (reference: runtime_env packaging via
+    the GCS, `_private/runtime_env/packaging.py`)."""
+    import os
+
+    pkg = tmp_path / "secretpkg"
+    os.makedirs(pkg)
+    (pkg / "__init__.py").write_text("MAGIC = 'from-the-driver'\n")
+    (pkg / "helper.py").write_text("def double(x):\n    return x * 2\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(pkg)]})
+    class Uses:
+        def probe(self):
+            import secretpkg
+            from secretpkg.helper import double
+
+            return secretpkg.MAGIC, double(21)
+
+    a = Uses.remote()
+    assert rt.get(a.probe.remote(), timeout=60) == ("from-the-driver", 42)
+    rt.kill(a)
