@@ -702,6 +702,17 @@ class NodeDaemon:
 
     handle_stream_item = handle_task_stream
 
+    async def handle_route_node(self, payload, conn):
+        """Forward a daemon method call to another node's daemon (the
+        state API's cross-node fan-out rides this)."""
+        node_id = payload["node_id"]
+        method = payload["method"]
+        if node_id == self.node_id:
+            handler = getattr(self, "handle_" + method)
+            return await handler(payload.get("payload"), conn)
+        c = await self._node_conn(node_id)
+        return await c.call(method, payload.get("payload"), timeout=10)
+
     async def handle_list_workers(self, payload, conn):
         """Worker inventory for the state API and fault-injection
         harnesses (reference: worker listing via the dashboard state

@@ -195,8 +195,8 @@ class Runtime:
         self._actor_connecting: set = set()
         self._actor_addr: Dict[bytes, Tuple[str, str]] = {}
 
-        # function export cache: id(fn) -> (fid, blob) and fid set
-        self._fn_export: Dict[int, Tuple[bytes, bytes]] = {}
+        # function export cache: id(fn) -> (fid, blob, pinned fn)
+        self._fn_export: Dict[int, Tuple[bytes, bytes, Any]] = {}
         self._exported_fids: set = set()
         self._fn_cache: Dict[bytes, Any] = {}
 
@@ -546,13 +546,20 @@ class Runtime:
         return refs
 
     def _export_function(self, fn) -> Tuple[bytes, Optional[bytes]]:
+        # keyed by id(fn) with the FUNCTION PINNED in the entry AND an
+        # identity check on hit: without both, a GC'd function's address
+        # can be reused by a brand-new function, which would silently
+        # inherit the old export and run the WRONG code on the executor.
+        # Growth is bounded by distinct exported functions — the same
+        # lifetime _fn_cache (fid -> fn) already has, mirroring the
+        # reference's per-job function table.
         cached = self._fn_export.get(id(fn))
-        if cached is not None:
-            fid, _blob = cached
+        if cached is not None and cached[2] is fn:
+            fid, _blob, _pin = cached
             return fid, None  # executors kv_get on miss
         blob = ser.dumps_oob(fn)
         fid = function_id_of(blob)
-        self._fn_export[id(fn)] = (fid, blob)
+        self._fn_export[id(fn)] = (fid, blob, fn)
         self._fn_cache[fid] = fn
         if fid not in self._exported_fids:
             self._exported_fids.add(fid)
@@ -775,17 +782,30 @@ class Runtime:
             # package locally, ship once via KV; the spec carries only
             # (name, key) pairs (reference: runtime_env packaging
             # uploads to the GCS, `runtime_env/packaging.py`)
-            from ray_tpu.core.runtime_env import package_py_modules
+            from ray_tpu.core.runtime_env import (
+                _module_root,
+                module_stat_sig,
+                package_py_modules,
+            )
 
             uploaded = getattr(self, "_pymod_uploaded", None)
             if uploaded is None:
                 uploaded = self._pymod_uploaded = set()
+            pkg_cache = getattr(self, "_pymod_pkg_cache", None)
+            if pkg_cache is None:
+                pkg_cache = self._pymod_pkg_cache = {}
             entries = []
-            for name, key, pkg_blob in package_py_modules(
-                renv["py_modules"]
-            ):
-                # content-addressed: repeat creations (actor fleets)
-                # skip the re-upload entirely
+            for mod in renv["py_modules"]:
+                # repeat creations (actor fleets) skip BOTH the re-zip
+                # and the re-upload: a stat-walk signature detects
+                # unchanged trees far cheaper than deflate
+                root = _module_root(mod)
+                sig = module_stat_sig(root)
+                cached = pkg_cache.get(root)
+                if cached is not None and cached[0] == sig:
+                    entries.append((cached[1], cached[2]))
+                    continue
+                [(name, key, pkg_blob)] = package_py_modules([root])
                 if key not in uploaded and not await self.controller.call(
                     "kv_exists", {"key": key}
                 ):
@@ -793,6 +813,7 @@ class Runtime:
                         "kv_put", {"key": key, "value": pkg_blob}
                     )
                 uploaded.add(key)
+                pkg_cache[root] = (sig, name, key)
                 entries.append((name, key))
             renv = dict(renv)
             renv["py_modules"] = entries
@@ -1591,16 +1612,24 @@ class Runtime:
                 if wd not in _sys.path:
                     _sys.path.insert(0, wd)
             for _name, key in renv.get("py_modules", ()):
-                # fetch + extract BEFORE the class blob deserializes:
-                # the pickle may import this module
-                from ray_tpu.core.runtime_env import materialize_py_module
+                # extract BEFORE the class blob deserializes (the pickle
+                # may import this module); the KV fetch is skipped when
+                # the content-addressed cache dir already exists locally
+                from ray_tpu.core.runtime_env import (
+                    materialize_py_module,
+                    py_module_cache_dir,
+                )
 
-                pkg_blob = await self.controller.call("kv_get", {"key": key})
-                if pkg_blob is None:
-                    raise exc.RayTpuError(
-                        f"py_module package {key} missing from KV"
+                dest = py_module_cache_dir(key)
+                if not os.path.isdir(dest):
+                    pkg_blob = await self.controller.call(
+                        "kv_get", {"key": key}
                     )
-                dest = materialize_py_module(key, pkg_blob)
+                    if pkg_blob is None:
+                        raise exc.RayTpuError(
+                            f"py_module package {key} missing from KV"
+                        )
+                    dest = materialize_py_module(key, pkg_blob)
                 import sys as _sys
 
                 if dest not in _sys.path:

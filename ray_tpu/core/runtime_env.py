@@ -78,11 +78,39 @@ def package_py_modules(mods: Sequence[Any]) -> List[Tuple[str, str, bytes]]:
     return out
 
 
+def py_module_cache_dir(key: str) -> str:
+    """Cache location for a packaged module — derivable from the key
+    alone, so workers can skip the KV fetch when already extracted."""
+    return os.path.join(_CACHE_ROOT, key.split(":", 1)[1])
+
+
+def module_stat_sig(root: str) -> str:
+    """Cheap content signature (relpath, size, mtime_ns) — a stat walk,
+    no compression — for the driver-side packaging cache."""
+    h = hashlib.sha256()
+    if os.path.isdir(root):
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".pyc"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                st = os.stat(full)
+                h.update(
+                    f"{os.path.relpath(full, root)}:{st.st_size}:"
+                    f"{st.st_mtime_ns};".encode()
+                )
+    else:
+        st = os.stat(root)
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()
+
+
 def materialize_py_module(key: str, blob: bytes) -> str:
     """Extract one packaged module into the content-addressed cache and
     return the directory to put on sys.path.  Idempotent across
     processes: first extractor wins via atomic rename."""
-    dest = os.path.join(_CACHE_ROOT, key.split(":", 1)[1])
+    dest = py_module_cache_dir(key)
     if not os.path.isdir(dest):
         os.makedirs(_CACHE_ROOT, exist_ok=True)
         tmp = f"{dest}.tmp.{os.getpid()}"

@@ -27,7 +27,7 @@ def main(argv=None):
     sub.add_parser("status", help="cluster summary")
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("what", choices=["tasks", "actors", "nodes", "jobs",
-                                     "placement-groups"])
+                                     "placement-groups", "workers"])
     lp.add_argument("--limit", type=int, default=100)
     tp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     tp.add_argument("--output", default="timeline.json")
@@ -55,6 +55,7 @@ def main(argv=None):
                 "nodes": state.list_nodes,
                 "jobs": state.list_jobs,
                 "placement-groups": state.list_placement_groups,
+                "workers": state.list_workers,
             }[args.what]
             print(json.dumps(fn(), indent=2, default=str))
         elif args.cmd == "timeline":

@@ -42,6 +42,28 @@ def list_jobs() -> List[Dict[str, Any]]:
     return out if out is not None else []
 
 
+def list_workers() -> List[Dict[str, Any]]:
+    """Pool workers across alive nodes (reference: `ray list workers`):
+    id, pid, kind, hosted actor, idleness, node."""
+    rt = get_runtime()
+    out: List[Dict[str, Any]] = []
+    for n in rt.controller_call("get_nodes") or []:
+        if not n.get("alive"):
+            continue
+        try:
+            # bounded: a node that blackholes connections must cost one
+            # timeout, not a kernel TCP connect stall per dead node
+            ws = rt.noded_call(
+                "route_node",
+                {"node_id": n["node_id"], "method": "list_workers"},
+                timeout=15,
+            )
+        except Exception:
+            ws = None  # node died between listing and the call
+        out.extend(ws or [])
+    return out
+
+
 _STATE_RANK = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
 
 
@@ -119,6 +141,7 @@ __all__ = [
     "list_nodes",
     "list_placement_groups",
     "list_tasks",
+    "list_workers",
     "summarize_tasks",
     "timeline",
 ]

@@ -380,3 +380,24 @@ def test_streaming_abandoned_stops_producer(cluster):
         time.sleep(0.1)
     assert closed == b"yes"
     assert tid not in get_runtime()._streams
+
+
+def test_function_export_survives_id_reuse(cluster):
+    """A GC'd remote function's memory address must not alias a new
+    function into the old export (the id()-keyed cache pins the
+    function for exactly this reason)."""
+    import gc
+
+    results = []
+    for i in range(20):
+        def make(tag):
+            @rt.remote
+            def fn():
+                return tag
+            return fn
+
+        f = make(i)
+        results.append(rt.get(f.remote(), timeout=30))
+        del f
+        gc.collect()  # maximize address reuse pressure
+    assert results == list(range(20))

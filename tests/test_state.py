@@ -142,3 +142,13 @@ def test_cli_status(cluster):
     assert out.returncode == 0, out.stderr[-2000:]
     payload = json.loads(out.stdout)
     assert payload["nodes_alive"] >= 1
+
+
+def test_list_workers_cluster_wide(cluster):
+    """`list workers` covers every alive node (reference:
+    `ray list workers` via the state aggregator)."""
+    from ray_tpu.util import state
+
+    ws = state.list_workers()
+    assert ws and all("pid" in w and "node_id" in w for w in ws)
+    assert any(w["kind"] == "worker" for w in ws)
