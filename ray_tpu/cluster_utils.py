@@ -67,6 +67,7 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float = 4, num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
                  num_workers: int = 2, wait: bool = True) -> NodeHandle:
         """Reference: `cluster_utils.py:201` add_node."""
         from ray_tpu.core.node_launcher import launch_noded
@@ -88,6 +89,7 @@ class Cluster:
             num_cpus=num_cpus,
             num_tpus=num_tpus,
             resources=resources,
+            labels=labels,
             num_workers=num_workers,
         )
         node = NodeHandle(proc, session_dir, ready, is_head)

@@ -26,6 +26,7 @@ def launch_noded(
     num_cpus: Optional[float] = None,
     num_tpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
     num_workers: int = 0,
     env_extra: Optional[Dict[str, str]] = None,
     timeout: float = 60.0,
@@ -47,6 +48,8 @@ def launch_noded(
         cmd += ["--num-tpus", str(num_tpus)]
     if resources:
         cmd += ["--resources", json.dumps(resources)]
+    if labels:
+        cmd += ["--labels", json.dumps(labels)]
     if head:
         cmd += ["--head"]
     else:

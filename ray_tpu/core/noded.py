@@ -34,7 +34,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from ray_tpu.core import rpc
+from ray_tpu.core import accelerators, rpc
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID
 from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits
@@ -73,7 +73,8 @@ class NodeDaemon:
     def __init__(self, session_dir: str, is_head: bool, controller_addr=None,
                  num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
-                 num_workers: int = 0, node_name: str = ""):
+                 num_workers: int = 0, node_name: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.cfg: Config = get_config()
         self.session_dir = session_dir
         self.is_head = is_head
@@ -84,8 +85,23 @@ class NodeDaemon:
 
         ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 4)
         self.total_resources: Dict[str, float] = {"CPU": ncpu}
+        if num_tpus is None:
+            # autodetect local chips (reference: accelerator managers
+            # run at node start, `_private/accelerators/tpu.py:102`)
+            detected = accelerators.detect_num_chips()
+            if detected:
+                num_tpus = float(detected)
         if num_tpus:
             self.total_resources["TPU"] = float(num_tpus)
+        self.node_labels: Dict[str, str] = dict(labels or {})
+        self._chip_pool: Optional[accelerators.ChipPool] = None
+        if num_tpus and num_tpus >= 1 and float(num_tpus).is_integer():
+            extra_res, tpu_labels = accelerators.node_tpu_extras(int(num_tpus))
+            for k, v in extra_res.items():
+                self.total_resources.setdefault(k, v)
+            for k, v in tpu_labels.items():
+                self.node_labels.setdefault(k, v)
+            self._chip_pool = accelerators.ChipPool(int(num_tpus))
         self.total_resources.update(resources or {})
         self.available = dict(self.total_resources)
 
@@ -158,6 +174,7 @@ class NodeDaemon:
                 "addr": ("127.0.0.1", self.tcp_port),
                 "resources": dict(self.total_resources),
                 "is_head": self.is_head,
+                "labels": dict(self.node_labels),
             },
         )
         for _ in range(self.num_workers):
@@ -252,6 +269,8 @@ class NodeDaemon:
             return
         del self.workers[w.worker_id]
         logger.warning("worker %s died: %s", w.worker_id[:8], reason)
+        if self._chip_pool is not None:
+            self._chip_pool.release_worker(w.worker_id)
         if self.store is not None:
             self.store.reap_creator(w.pid)
         # fail in-flight tasks back to their owners
@@ -371,11 +390,20 @@ class NodeDaemon:
                 and len(w.in_flight) < _PIPELINE_DEPTH
             ):
                 return w
-        # 2) idle worker + available resources
+        # 2) idle worker + available resources (chip-pinning aware)
         if _fits(demand, self.available):
-            for w in self.workers.values():
-                if w.kind == "worker" and w.idle and w.lease is None and w.conn:
-                    return w
+            tpu_n = self._tpu_chips_needed(demand)
+            w = self._pick_idle_worker(tpu_n, require_no_lease=True)
+            if w is None:
+                if tpu_n:
+                    # every idle worker may be pinned to the wrong chip
+                    # count; retire one so the queued task can't starve
+                    self._reclaim_idle_pinned(tpu_n)
+                return None
+            if tpu_n and not self._assign_chips(w, tpu_n):
+                self._reclaim_idle_pinned(tpu_n)
+                return None
+            return w
         return None
 
     def _dispatch(self, w: WorkerState, spec: TaskSpec):
@@ -625,6 +653,93 @@ class NodeDaemon:
     # worker leasing: direct-push protocol (reference two-level
     # scheduling — leases granted here, tasks pushed caller->worker)
     # ------------------------------------------------------------------
+    # -- TPU chip isolation (see core/accelerators.py) -----------------
+    def _tpu_chips_needed(self, demand: Dict[str, float]) -> int:
+        t = float(demand.get("TPU", 0.0))
+        return int(t) if t >= 1 and t.is_integer() else 0
+
+    def _assign_chips(self, w: WorkerState, n: int) -> bool:
+        """Pin `n` chips to worker `w` (no-op match if already pinned to
+        exactly n) and push the isolation env over its conn.  Safe for
+        the daemon-dispatch path: the env rides the same ordered stream
+        as the execute_task push that follows.  The direct-push lease
+        path must use `_assign_chips_acked` instead — there the task
+        arrives on a different conn (caller -> worker) and nothing else
+        orders the two streams."""
+        if self._chip_pool is None:
+            return True
+        chips = self._chip_pool.assign(w.worker_id, n)
+        if chips is None:
+            return False
+        env = accelerators.chip_isolation_env(
+            list(chips), self._chip_pool.num_chips
+        )
+        try:
+            w.conn.send("set_accel_env", env)
+        except Exception:
+            return False
+        return True
+
+    async def _assign_chips_acked(self, w: WorkerState, n: int) -> bool:
+        """Like `_assign_chips` but waits for the worker to acknowledge
+        the env before returning, so a lease reply cannot race the
+        caller's first direct task push past the isolation setup."""
+        if self._chip_pool is None:
+            return True
+        chips = self._chip_pool.assign(w.worker_id, n)
+        if chips is None:
+            return False
+        env = accelerators.chip_isolation_env(
+            list(chips), self._chip_pool.num_chips
+        )
+        try:
+            await w.conn.call("set_accel_env", env, timeout=10)
+        except Exception:
+            return False
+        return True
+
+    def _pick_idle_worker(
+        self, tpu_n: int, require_no_lease: bool = False
+    ) -> Optional[WorkerState]:
+        """Idle-worker choice, chip-pinning aware: an n-chip demand
+        prefers a worker already pinned to n chips (its runtime is
+        initialized against them), then an unpinned one; CPU demands
+        prefer unpinned workers so pinned ones stay free for TPU work."""
+        pinned_match = unpinned = any_idle = None
+        for w in self.workers.values():
+            if not (w.kind == "worker" and w.idle and w.conn and w.socket_path):
+                continue
+            if require_no_lease and w.lease is not None:
+                continue
+            any_idle = any_idle or w
+            held = (
+                self._chip_pool.pinned(w.worker_id)
+                if self._chip_pool is not None
+                else None
+            )
+            if held is None:
+                unpinned = unpinned or w
+            elif tpu_n and len(held) == tpu_n:
+                pinned_match = pinned_match or w
+        if tpu_n:
+            return pinned_match or unpinned
+        return unpinned or any_idle
+
+    def _reclaim_idle_pinned(self, tpu_n: int) -> None:
+        """Chip fragmentation: every free chip is pinned to an idle
+        worker of the wrong shape.  Retire one such worker (its death
+        releases the chips and respawns a fresh process)."""
+        if self._chip_pool is None or self._chip_pool.free_count >= tpu_n:
+            return
+        for w in self.workers.values():
+            held = self._chip_pool.pinned(w.worker_id)
+            if w.kind == "worker" and w.idle and held and len(held) != tpu_n:
+                try:
+                    os.kill(w.pid, signal.SIGKILL)
+                except Exception:
+                    pass
+                return
+
     async def handle_request_lease(self, payload, conn):
         """Grant a leased worker to a caller; returns (worker_id,
         socket_path) or None if nothing is available right now
@@ -638,14 +753,25 @@ class NodeDaemon:
             return {"infeasible": True}
         if not _fits(demand, self.available):
             return None
-        for w in self.workers.values():
-            if w.kind == "worker" and w.idle and w.conn and w.socket_path:
-                for k, v in demand.items():
-                    self.available[k] = self.available.get(k, 0.0) - v
-                w.lease = dict(demand)
-                w.leased_to = holder
-                w.busy_since = time.time()
-                return (w.worker_id, w.socket_path)
+        tpu_n = self._tpu_chips_needed(demand)
+        w = self._pick_idle_worker(tpu_n)
+        if w is not None and tpu_n and not await self._assign_chips_acked(
+            w, tpu_n
+        ):
+            w = None
+        if w is not None and not w.idle:
+            # the env ack awaited above yielded the loop: somebody else
+            # may have taken this worker meanwhile
+            w = None
+        if w is not None:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            w.lease = dict(demand)
+            w.leased_to = holder
+            w.busy_since = time.time()
+            return (w.worker_id, w.socket_path)
+        if tpu_n:
+            self._reclaim_idle_pinned(tpu_n)
         if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
             self._spawn_worker()
         return None
@@ -898,13 +1024,16 @@ class NodeDaemon:
         # cannot both pass the feasibility check and oversubscribe
         for k, v in demand.items():
             self.available[k] = self.available.get(k, 0.0) - v
+        tpu_n = self._tpu_chips_needed(demand)
         target = None
         deadline = time.monotonic() + 60
         while target is None:
-            for w in self.workers.values():
-                if w.kind == "worker" and w.idle and w.lease is None and w.conn:
-                    target = w
-                    break
+            target = self._pick_idle_worker(tpu_n, require_no_lease=True)
+            if target is not None and tpu_n and not self._assign_chips(
+                target, tpu_n
+            ):
+                target = None
+                self._reclaim_idle_pinned(tpu_n)
             if target is None:
                 if time.monotonic() > deadline:
                     for k, v in demand.items():
@@ -1034,6 +1163,7 @@ async def _amain(args):
         num_tpus=args.num_tpus,
         resources=json.loads(args.resources) if args.resources else None,
         num_workers=args.num_workers,
+        labels=json.loads(args.labels) if args.labels else None,
     )
     if daemon.controller_addr and not args.head:
         host, port = daemon.controller_addr
@@ -1076,6 +1206,7 @@ def main():
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default=None, help="json dict")
+    p.add_argument("--labels", default=None, help="json dict of node labels")
     p.add_argument("--num-workers", type=int, default=0)
     p.add_argument("--ready-file", default=None)
     args = p.parse_args()

@@ -1592,6 +1592,31 @@ class Runtime:
     async def _h_ping(self, payload, conn):
         return "pong"
 
+    async def _h_set_accel_env(self, payload, conn):
+        """Daemon push at lease-grant time: accelerator isolation env
+        (TPU_VISIBLE_CHIPS et al — `core/accelerators.py`).  Must land
+        before user code first initializes the ML framework; the daemon
+        sends it on the same ordered stream as the task push.  An empty
+        string unsets the variable (all-chip grants clear restrictions).
+        """
+        import sys as _sys
+
+        changed = False
+        for k, v in (payload or {}).items():
+            if v == "":
+                if k in os.environ:
+                    del os.environ[k]
+                    changed = True
+            elif os.environ.get(k) != v:
+                os.environ[k] = v
+                changed = True
+        if changed and "jax" in _sys.modules:
+            logger.warning(
+                "accelerator env changed after jax was imported; the new "
+                "chip visibility takes effect only in a fresh worker"
+            )
+        return {"ok": True}
+
     # ---- executor side ----------------------------------------------
     async def _h_execute_task(self, spec: TaskSpec, conn):
         if spec.actor_id is not None:

@@ -68,9 +68,16 @@ class Resources:
     @staticmethod
     def from_options(opts: Dict[str, Any]) -> "Resources":
         res = dict(opts.get("resources") or {})
+        num_tpus = opts.get("num_tpus", res.pop("TPU", 0.0)) or 0.0
+        if num_tpus:
+            from ray_tpu.core.accelerators import validate_chip_request
+
+            err = validate_chip_request(float(num_tpus))
+            if err:
+                raise ValueError(err)
         return Resources(
             num_cpus=opts.get("num_cpus", 1.0) or 0.0,
-            num_tpus=opts.get("num_tpus", res.pop("TPU", 0.0)) or 0.0,
+            num_tpus=num_tpus,
             memory=opts.get("memory", 0.0) or 0.0,
             custom=res,
         )

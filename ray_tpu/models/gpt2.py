@@ -42,14 +42,19 @@ class GPT2Config:
     remat: bool = True
     # remat policy: "full" recomputes the whole block backward (min
     # memory); "dots" saves matmul outputs (checkpoint_policies
-    # dots_with_no_batch_dims_saveable) trading HBM for recompute FLOPs
+    # dots_with_no_batch_dims_saveable); "names" saves exactly the
+    # tagged matmul inputs (see `_SAVED_NAMES`) so the backward
+    # recomputes ONLY the attention score/prob internals — the
+    # quadratic part — instead of the whole block (~15% of fwd FLOPs
+    # recomputed vs 100% for "full", at ~750 MB/layer saved residuals
+    # for the 124M bench shapes)
     remat_policy: str = "full"
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "names"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
-                "expected 'full' or 'dots'"
+                "expected 'full', 'dots', or 'names'"
             )
 
     @property
@@ -131,15 +136,24 @@ def logical_axes(cfg: GPT2Config) -> Dict:
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
+# Activations saved (not recomputed) under remat_policy="names": every
+# matmul/gelu input except the attention score+prob tensors.
+_SAVED_NAMES = (
+    "ln1_out", "qkv", "attn_out_in", "resid_attn", "ln2_out",
+    "pre_gelu", "gelu_out",
+)
+
+
 def _layer_norm(x, g, b, eps=1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
-def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
-            mesh=None) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] (f32)."""
+def backbone(cfg: GPT2Config, params: Dict, tokens: jax.Array,
+             mesh=None) -> jax.Array:
+    """tokens [B, T] int32 -> final hidden states [B, T, embd] (compute
+    dtype), i.e. everything up to (not including) the lm-head matmul."""
     B, T = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(cfg.dtype)[:T]
 
@@ -147,35 +161,43 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
 
     def body(x, layer_params):
         # layer_params: one layer's slice of every block param
+        from jax.ad_checkpoint import checkpoint_name
+
         def one(cfg_x):
             h = _layer_norm(
                 cfg_x,
                 layer_params["ln1_g"].astype(cfg.dtype),
                 layer_params["ln1_b"].astype(cfg.dtype),
             )
+            h = checkpoint_name(h, "ln1_out")
             B_, T_, E = cfg_x.shape
             qkv = h @ layer_params["attn_qkv_w"].astype(cfg.dtype) + layer_params[
                 "attn_qkv_b"
             ].astype(cfg.dtype)
+            qkv = checkpoint_name(qkv, "qkv")
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B_, T_, cfg.n_head, cfg.head_dim)
             k = k.reshape(B_, T_, cfg.n_head, cfg.head_dim)
             v = v.reshape(B_, T_, cfg.n_head, cfg.head_dim)
             o = select_attention(cfg.attention, q, k, v, mesh, causal=True)
-            o = o.reshape(B_, T_, E)
+            o = checkpoint_name(o.reshape(B_, T_, E), "attn_out_in")
             x1 = cfg_x + (
                 o @ layer_params["attn_out_w"].astype(cfg.dtype)
                 + layer_params["attn_out_b"].astype(cfg.dtype)
             )
+            x1 = checkpoint_name(x1, "resid_attn")
             h2 = _layer_norm(
                 x1,
                 layer_params["ln2_g"].astype(cfg.dtype),
                 layer_params["ln2_b"].astype(cfg.dtype),
             )
+            h2 = checkpoint_name(h2, "ln2_out")
             h2 = h2 @ layer_params["mlp_fc_w"].astype(cfg.dtype) + layer_params[
                 "mlp_fc_b"
             ].astype(cfg.dtype)
+            h2 = checkpoint_name(h2, "pre_gelu")
             h2 = jax.nn.gelu(h2)
+            h2 = checkpoint_name(h2, "gelu_out")
             h2 = h2 @ layer_params["mlp_out_w"].astype(cfg.dtype) + layer_params[
                 "mlp_out_b"
             ].astype(cfg.dtype)
@@ -187,6 +209,13 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
                     one,
                     policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 )
+            elif cfg.remat_policy == "names":
+                fn = jax.checkpoint(
+                    one,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        *_SAVED_NAMES
+                    ),
+                )
             else:
                 fn = jax.checkpoint(one)
         else:
@@ -195,22 +224,35 @@ def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
 
     x = x.astype(cfg.dtype)
     x, _ = lax.scan(body, x, blocks)
-    x = _layer_norm(x, params["lnf_g"].astype(cfg.dtype), params["lnf_b"].astype(cfg.dtype))
+    return _layer_norm(
+        x, params["lnf_g"].astype(cfg.dtype), params["lnf_b"].astype(cfg.dtype)
+    )
+
+
+def forward(cfg: GPT2Config, params: Dict, tokens: jax.Array,
+            mesh=None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (f32)."""
+    x = backbone(cfg, params, tokens, mesh)
     logits = x @ params["wte"].astype(cfg.dtype).T  # weight tying
     return logits.astype(jnp.float32)
 
 
 def loss_fn(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             mesh=None) -> jax.Array:
-    """Next-token cross entropy; tokens [B, T+1] or [B, T] (shifted
-    internally when possible)."""
+    """Next-token cross entropy; tokens [B, T+1] (shift done here).
+
+    Uses the lse-reduction form: XLA fuses the logsumexp into the
+    lm-head matmul's epilogue, so the [B, T, vocab] *log-prob* tensor
+    never materializes (the logits do, transiently).  Measured faster
+    at 124M/seq1024 on v5e than `ops.xent.fused_cross_entropy` (76.0k
+    vs 65.7k tok/s): the explicit row-chunk scan serializes the lm-head
+    matmul and pays [vocab, embd] f32 dW-accumulator traffic per chunk.
+    The fused op remains the right tool when the logits themselves
+    don't fit (long-seq / big-vocab), not as this benchmark's default.
+    """
     inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
     logits = forward(cfg, params, inputs, mesh)
-    # lse - target_logit == -log_softmax[target], WITHOUT materializing
-    # the [B, T, vocab] log-prob tensor (only the reduction and the
-    # gathered column) — measured ~4% step-time win at 124M/seq1024 on
-    # v5e, where the 50k-vocab logp tensor is pure HBM traffic
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(lse - tgt)

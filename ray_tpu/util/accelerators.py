@@ -1,0 +1,46 @@
+"""User-facing TPU helpers (reference: `python/ray/util/accelerators/tpu.py`).
+
+Call these from inside tasks/actors to discover the slice the current
+node belongs to and fan work out across its member hosts, e.g.::
+
+    @rt.remote(resources={"TPU-v5e-16-head": 1})
+    def coordinator():
+        name = rt.util.accelerators.get_current_pod_name()
+        n = rt.util.accelerators.get_current_pod_worker_count()
+        fn = per_host_fn.options(resources={"TPU": 4, name: 1})
+        return rt.get([fn.remote() for _ in range(n)])
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ray_tpu.core import accelerators as _core
+
+
+def get_current_pod_name() -> Optional[str]:
+    """Name of the TPU pod/slice this node belongs to (also registered
+    as a 1.0 custom resource on every member host)."""
+    return _core.get_tpu_name()
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    """Number of member hosts in this node's slice, derived from the
+    `v{gen}-{chips}` slice type."""
+    st = _core.get_slice_type()
+    return _core.num_hosts_in_slice(st) if st else None
+
+
+def get_num_tpu_chips_on_node() -> int:
+    """Locally attached chip count (0 off-TPU)."""
+    return _core.detect_num_chips()
+
+
+def get_current_process_visible_chip_ids() -> Optional[List[str]]:
+    """Chip ids this worker process was granted at lease time, or None
+    when unrestricted (whole host visible)."""
+    raw = os.environ.get(_core.VISIBLE_CHIPS_ENV)
+    if raw is None:
+        return None
+    return [c for c in raw.split(",") if c]

@@ -44,11 +44,75 @@ def test_flash_attention_grad_matches_plain():
         )
 
 
+@pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16)])
+def test_flash_attention_grad_rect_blocks(bq, bk):
+    """Rectangular blocks exercise the causal block-skip predicates and
+    cross-block online-softmax carries in both backward kernels."""
+    q, k, v = _qkv(T=64)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, bq, bk, True) ** 2)
+
+    def f_plain(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_plain, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3
+        )
+
+
+def test_flash_attention_bf16():
+    q, k, v = _qkv(T=64, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, True, 32, 32, True)
+    ref = plain_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(ref, dtype=np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
 def test_flash_attention_fallback_on_odd_shapes():
     q, k, v = _qkv(T=60, D=12)  # not divisible: falls back to XLA path
     out = flash_attention(q, k, v)
     ref = plain_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_fused_cross_entropy_matches_direct():
+    """ops.xent.fused_cross_entropy: value and grads vs the direct
+    logsumexp form (the op trades one extra lm-head matmul for never
+    materializing [N, V] logits — used for long-seq/big-vocab)."""
+    from ray_tpu.ops.xent import fused_cross_entropy
+
+    kx, kw, kt = jax.random.split(jax.random.PRNGKey(0), 3)
+    N, E, V = 48, 16, 97
+    x = jax.random.normal(kx, (N, E), jnp.float32) * 0.5
+    w = jax.random.normal(kw, (V, E), jnp.float32) * 0.5
+    t = jax.random.randint(kt, (N,), 0, V, dtype=jnp.int32)
+
+    def direct(x, w):
+        logits = x @ w.T
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
+        return jnp.mean(lse - tgt)
+
+    l1 = fused_cross_entropy(x, w, t, 16)
+    l2 = direct(x, w)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    g1 = jax.grad(lambda x, w: fused_cross_entropy(x, w, t, 16),
+                  argnums=(0, 1))(x, w)
+    g2 = jax.grad(direct, argnums=(0, 1))(x, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+    # non-dividing chunk size: falls back to a divisor
+    l3 = fused_cross_entropy(x, w, t, 13)
+    np.testing.assert_allclose(float(l3), float(l2), rtol=1e-5)
 
 
 def test_moe_local_forward_and_grad():
