@@ -21,6 +21,7 @@ from ray_tpu.api import (
     get,
     get_actor,
     init,
+    is_initialized,
     is_started,
     kill,
     nodes,
@@ -54,6 +55,7 @@ __all__ = [
     "get",
     "get_actor",
     "init",
+    "is_initialized",
     "is_started",
     "kill",
     "nodes",

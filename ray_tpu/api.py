@@ -272,10 +272,6 @@ class RemoteFunction:
         )
 
 
-_seq_counters: Dict[bytes, int] = {}
-_seq_lock = threading.Lock()
-
-
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
         self._handle = handle
@@ -322,10 +318,9 @@ class ActorHandle:
         self._streaming_methods = tuple(streaming_methods)
 
     def _next_seq(self) -> int:
-        with _seq_lock:
-            n = _seq_counters.get(self._actor_id.binary(), 0)
-            _seq_counters[self._actor_id.binary()] = n + 1
-            return n
+        from ray_tpu.core.runtime import next_actor_seq
+
+        return next_actor_seq(self._actor_id.binary())
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):

@@ -195,11 +195,12 @@ def chip_isolation_env(chip_ids: List[int], total_chips: int) -> Dict[str, str]:
             HOST_BOUNDS_ENV: "",
         }
     env = {VISIBLE_CHIPS_ENV: ",".join(str(c) for c in chip_ids)}
-    if len(chip_ids) == 1:
-        env[CHIPS_PER_HOST_BOUNDS_ENV] = "1,1,1"
-        env[HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
-    elif len(chip_ids) == 2:
-        env[CHIPS_PER_HOST_BOUNDS_ENV] = "1,2,1"
+    bounds = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1"}.get(len(chip_ids))
+    if bounds:
+        # sub-host grant: libtpu needs the physical bounds of the
+        # visible subset (1=single chip, 2=1x2, 4=2x2 — the contiguous
+        # blocks the sequential allocator hands out on 2x4 hosts)
+        env[CHIPS_PER_HOST_BOUNDS_ENV] = bounds
         env[HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
     return env
 

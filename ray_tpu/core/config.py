@@ -56,6 +56,12 @@ class Config:
     num_workers_per_node: int = 0
     #: soft cap on lease pipelining per worker
     max_tasks_in_flight_per_worker: int = 64
+    #: how long an idle leased worker is kept before being returned to
+    #: the node daemon; steady submit->get loops reuse the warm worker
+    #: + conn instead of paying a lease round trip per task (reference:
+    #: idle worker caching in the worker pool rather than instant
+    #: return, `worker_pool.h` idle policy)
+    lease_keepalive_ms: int = 500
     #: top-k fraction for hybrid scheduling randomization (reference
     #: hybrid policy top-k, `hybrid_scheduling_policy.h:50`)
     scheduler_top_k_fraction: float = 0.2

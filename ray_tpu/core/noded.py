@@ -755,17 +755,24 @@ class NodeDaemon:
             return None
         tpu_n = self._tpu_chips_needed(demand)
         w = self._pick_idle_worker(tpu_n)
-        if w is not None and tpu_n and not await self._assign_chips_acked(
-            w, tpu_n
-        ):
-            w = None
-        if w is not None and not w.idle:
-            # the env ack awaited above yielded the loop: somebody else
-            # may have taken this worker meanwhile
-            w = None
         if w is not None:
+            # reserve BEFORE any await: a concurrent lease request must
+            # see these resources as taken or the node oversubscribes
+            # (same reserve-then-wait shape as handle_host_actor)
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) - v
+            ok = True
+            if tpu_n:
+                ok = await self._assign_chips_acked(w, tpu_n)
+            if ok and not w.idle:
+                # the env ack yielded the loop: somebody else took this
+                # worker meanwhile
+                ok = False
+            if not ok:
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0.0) + v
+                w = None
+        if w is not None:
             w.lease = dict(demand)
             w.leased_to = holder
             w.busy_since = time.time()

@@ -130,16 +130,33 @@ class _PendingTask:
     retries_left: int
 
 
+# Process-wide per-actor sequence numbers: every caller path (handles,
+# lineage reconstruction) draws from the same counter so the executor's
+# in-order delivery sees one consistent stream per caller process.
+_actor_seq_counters: Dict[bytes, int] = {}
+_actor_seq_lock = threading.Lock()
+
+
+def next_actor_seq(aid: bytes) -> int:
+    with _actor_seq_lock:
+        n = _actor_seq_counters.get(aid, 0)
+        _actor_seq_counters[aid] = n + 1
+        return n
+
+
 class _Lease:
     """One leased worker with pipelined pushes."""
 
-    __slots__ = ("worker_id", "conn", "in_flight", "assigned")
+    __slots__ = ("worker_id", "conn", "in_flight", "assigned", "idle_token")
 
     def __init__(self, worker_id: str, conn: rpc.Connection):
         self.worker_id = worker_id
         self.conn = conn
         self.in_flight = 0
         self.assigned: Dict[bytes, TaskSpec] = {}
+        # bumped each time the lease goes idle; lets the delayed-return
+        # timer detect an intervening busy period and stand down
+        self.idle_token = 0
 
 
 class _LeasePool:
@@ -218,6 +235,9 @@ class Runtime:
         # values are zero-copy views into the segment (the reference
         # pins plasma buffers the same way while Python buffers exist)
         self._held_pins: set = set()
+        self._lease_timers: set = set()  # pending keep-alive returns
+        # container object id -> borrows/pins it holds on inner refs
+        self._contained_in: Dict[bytes, list] = {}
         self._shutdown = False
         from ray_tpu.core.task_events import TaskEventBuffer
 
@@ -283,6 +303,9 @@ class Runtime:
             flush = getattr(self, "_flush_task", None)
             if flush is not None:
                 flush.cancel()
+            for timer in list(self._lease_timers):
+                timer.cancel()
+            self._lease_timers.clear()
             # final task-event drain so the last flush period's events
             # reach the controller before the connection dies
             events = self.task_events.drain()
@@ -439,7 +462,17 @@ class Runtime:
         scope = getattr(self._task_local, "task_id", None) or TaskID.for_job(self.job_id)
         oid = ObjectID.for_put(scope, self._put_counter)
         chunks, total, captured = ser.serialize(value)
-        self._pin_contained(captured)
+        if captured:
+            # tie borrows to THIS container so they release when the
+            # put object is freed, not at job exit.  Self-owned refs go
+            # through the counted selfborrow path too (a boolean pin
+            # clobbers when one inner sits in two containers).
+            with self._state_lock:
+                self._register_contained(oid.binary(), [
+                    (r.binary(), tuple(r.owner))
+                    for r in captured
+                    if r.owner is not None
+                ])
         st = _ObjectState(ready=asyncio.Event())
         if total <= self.cfg.max_direct_call_object_size:
             buf = bytearray(total)
@@ -478,10 +511,33 @@ class Runtime:
         if single:
             refs = [refs]
 
-        async def _get_all():
-            return await asyncio.gather(*[self._get_one(r) for r in refs])
+        # Fast path: owned objects that are already ready and inline
+        # deserialize in the calling thread — no event-loop round trip
+        # (reference: in-process memory store hits skip the plasma
+        # path the same way).  Event.is_set() is a thread-safe read.
+        # A partial hit keeps the prefix and round-trips only the rest.
+        vals = []
+        for r in refs:
+            st = self.objects.get(r.binary())
+            if (
+                st is not None
+                and st.ready.is_set()
+                and st.error is None
+                and st.where == _INLINE
+                and st.value is not None
+            ):
+                tag, val = ser.deserialize(memoryview(st.value))
+                vals.append(_unwrap(tag, val))
+            else:
+                break
+        if len(vals) == len(refs):
+            return vals[0] if single else vals
+        rest = refs[len(vals):]
 
-        vals = self._run(_get_all(), timeout=timeout)
+        async def _get_all():
+            return await asyncio.gather(*[self._get_one(r) for r in rest])
+
+        vals.extend(self._run(_get_all(), timeout=timeout))
         return vals[0] if single else vals
 
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
@@ -893,6 +949,11 @@ class Runtime:
         with self._state_lock:
             for oid in spec.return_ids():
                 self.objects[oid.binary()] = _ObjectState(ready=asyncio.Event())
+                # actor-task returns reconstruct by re-executing the
+                # method on the (live) actor — same lineage machinery
+                # as normal tasks (reference: actor task resubmission,
+                # `task_manager.h` lineage for actor children)
+                self.lineage[oid.binary()] = spec
                 self._add_local_ref(oid.binary())
                 refs.append(ObjectRef(oid, self.address, _register=True))
             if num_returns == STREAMING:
@@ -1076,8 +1137,12 @@ class Runtime:
                         continue
                     if ret[0] == _INLINE:
                         st.where, st.value, st.size = _INLINE, ret[1], len(ret[1])
+                        contained = ret[2] if len(ret) > 2 else None
                     else:
                         st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
+                        contained = ret[3] if len(ret) > 3 else None
+                    if contained:
+                        self._register_contained(oid.binary(), contained)
                     st.ready.set()
                 for a in pt.spec.args:
                     if isinstance(a, ArgRef):
@@ -1276,7 +1341,15 @@ class Runtime:
                     if rc:
                         rc.submitted += 1
         logger.info("reconstructing %s via lineage resubmit", ref.hex())
-        self._push_or_queue(spec)
+        if spec.actor_id is not None:
+            # actor-task returns re-execute ON the actor: route through
+            # the ordered actor queue with a fresh sequence number (the
+            # original seq was consumed; replaying it would wedge the
+            # executor's in-order delivery)
+            spec.seq_no = next_actor_seq(spec.actor_id.binary())
+            self._push_actor_task(spec.actor_id.binary(), spec)
+        else:
+            self._push_or_queue(spec)
         await st.ready.wait()
         if st.error is not None:
             raise _error_from_envelope(st.error)
@@ -1359,6 +1432,65 @@ class Runtime:
                 if r.owner is not None and tuple(r.owner) == self.address:
                     self.refs.setdefault(r.binary(), _RefCount()).contained = 1
 
+    def _register_contained(self, container_id: bytes, entries):
+        """The container object `container_id` (a task return we own, or
+        a local put) holds references to the listed inner objects.  We
+        register a borrow per inner ref on its owner so the inner can't
+        be freed while the container lives, and release those borrows
+        when the container itself is freed (`_maybe_free`).  Caller
+        holds `_state_lock`."""
+        if not entries:
+            return
+        recorded = []
+        for inner_id, owner in entries:
+            owner = tuple(owner)
+            if owner == self.address:
+                rc = self.refs.setdefault(inner_id, _RefCount())
+                rc.borrowers += 1
+                rc.contained = 0  # pin converts to the container borrow
+                recorded.append(("selfborrow", inner_id, None))
+            else:
+                try:
+                    self.noded.send_threadsafe("route", {
+                        "target": owner,
+                        "method": "add_borrow",
+                        "payload": {"id": inner_id},
+                        "want_reply": False,
+                    })
+                    recorded.append(("borrow", inner_id, owner))
+                except Exception:
+                    pass
+        if recorded:
+            self._contained_in.setdefault(container_id, []).extend(recorded)
+
+    def _release_contained(self, container_id: bytes):
+        """Container freed: drop the borrows it held on inner refs.
+        Caller holds `_state_lock`."""
+        entries = self._contained_in.pop(container_id, None)
+        if not entries:
+            return
+        for kind, inner_id, owner in entries:
+            if kind == "pin":
+                rc = self.refs.get(inner_id)
+                if rc:
+                    rc.contained = 0
+                    self._maybe_free(inner_id)
+            elif kind == "selfborrow":
+                rc = self.refs.get(inner_id)
+                if rc:
+                    rc.borrowers -= 1
+                    self._maybe_free(inner_id)
+            else:
+                try:
+                    self.noded.send_threadsafe("route", {
+                        "target": owner,
+                        "method": "remove_borrow",
+                        "payload": {"id": inner_id},
+                        "want_reply": False,
+                    })
+                except Exception:
+                    pass
+
     def _add_local_ref(self, id_bytes: bytes):
         rc = self.refs.setdefault(id_bytes, _RefCount())
         rc.local += 1
@@ -1370,6 +1502,7 @@ class Runtime:
         del self.refs[id_bytes]
         st = self.objects.pop(id_bytes, None)
         self.lineage.pop(id_bytes, None)
+        self._release_contained(id_bytes)
         if st is None:
             return
         if st.where == _SHM:
@@ -1433,21 +1566,66 @@ class Runtime:
             await self._maybe_return_lease(pool, lease)
 
     async def _maybe_return_lease(self, pool: _LeasePool, lease: _Lease):
+        """Idle lease handling: keep the worker warm for a grace period
+        so steady submit->get loops reuse it (conn and all) instead of
+        paying a lease round trip per task; a delayed task returns it if
+        still idle when the grace expires."""
         with self._state_lock:
-            idle_and_done = (
+            idle = (
                 not pool.queue
                 and lease.in_flight == 0
                 and pool.leases.get(lease.worker_id) is lease
             )
-            if idle_and_done:
-                pool.leases.pop(lease.worker_id, None)
-                self._conn_lease.pop(lease.conn, None)
-        if idle_and_done:
-            try:
-                self.noded.send("return_lease", {"worker_id": lease.worker_id})
-            except Exception:
-                pass
-            await lease.conn.close()
+            if idle:
+                lease.idle_token += 1
+                token = lease.idle_token
+        if not idle:
+            return
+        keepalive = self.cfg.lease_keepalive_ms / 1000.0
+        if keepalive > 0 and not self._shutdown:
+            timer = asyncio.ensure_future(
+                self._return_lease_later(pool, lease, token, keepalive)
+            )
+            self._lease_timers.add(timer)
+            timer.add_done_callback(self._lease_timers.discard)
+        else:
+            await self._return_lease_now(pool, lease)
+
+    async def _return_lease_later(self, pool, lease, token, delay):
+        await asyncio.sleep(delay)
+        if self._shutdown:
+            return
+        with self._state_lock:
+            still_idle = (
+                not pool.queue
+                and lease.in_flight == 0
+                and pool.leases.get(lease.worker_id) is lease
+                and lease.idle_token == token  # no busy period since
+            )
+        if still_idle:
+            await self._return_lease_now(pool, lease)
+
+    async def _return_lease_now(self, pool: _LeasePool, lease: _Lease):
+        with self._state_lock:
+            # full re-verify under ONE critical section: between any
+            # earlier idle check and this lock, a submitter may have
+            # pushed work onto this lease — popping it then would sever
+            # the in-flight task's result channel without the
+            # _on_lease_conn_closed recovery (its map entry would
+            # already be gone)
+            if (
+                pool.leases.get(lease.worker_id) is not lease
+                or lease.in_flight != 0
+                or pool.queue
+            ):
+                return
+            pool.leases.pop(lease.worker_id, None)
+            self._conn_lease.pop(lease.conn, None)
+        try:
+            self.noded.send("return_lease", {"worker_id": lease.worker_id})
+        except Exception:
+            pass
+        await lease.conn.close()
 
     async def _h_stream_item(self, payload, conn):
         """One yielded item of a streaming-generator task we own arrived
@@ -1465,8 +1643,12 @@ class Runtime:
             st = _ObjectState(ready=asyncio.Event())
             if ret[0] == _INLINE:
                 st.where, st.value, st.size = _INLINE, ret[1], len(ret[1])
+                contained = ret[2] if len(ret) > 2 else None
             else:
                 st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
+                contained = ret[3] if len(ret) > 3 else None
+            if contained:
+                self._register_contained(oid.binary(), contained)
             st.ready.set()
             self.objects[oid.binary()] = st
             self._add_local_ref(oid.binary())
@@ -1565,6 +1747,24 @@ class Runtime:
         )
         cancelled.add(payload["task_id"])
 
+    async def _verify_shm_primary(self, id_bytes: bytes, st):
+        """A borrower is about to be pointed at our shm primary: make
+        sure it still exists.  Evicted/lost primaries restore from
+        spill or rebuild via lineage BEFORE the location is handed out —
+        this is what makes chained reconstruction work (rebuilding task
+        B pulls arg A through this path, and A may itself be gone)."""
+        if st.node_id != self.node_id or self.store.contains(id_bytes):
+            return st
+        ref = ObjectRef(ObjectID(id_bytes), self.address)
+        try:
+            # restores from spill or lineage-reconstructs; value is
+            # discarded (its get-pin releases on GC) — the side effect
+            # is the object being back in a store
+            await self._read_shm(ref, st.node_id)
+        except Exception:
+            logger.warning("could not restore %s for borrower", ref.hex())
+        return self.objects.get(id_bytes) or st
+
     async def _h_get_object_value(self, payload, conn):
         st = self.objects.get(payload["id"])
         if st is None:
@@ -1573,6 +1773,11 @@ class Runtime:
         if st.error is not None:
             return ("error", st.error)
         if st.where == _INLINE:
+            return ("inline", st.value)
+        st = await self._verify_shm_primary(payload["id"], st)
+        if st.error is not None:
+            return ("error", st.error)
+        if st.where == _INLINE:  # reconstruction may have inlined it
             return ("inline", st.value)
         return ("shm", st.node_id)
 
@@ -1962,18 +2167,29 @@ class Runtime:
 
     async def _package_value(self, oid: ObjectID, v) -> Tuple:
         """Serialize one return value: inline bytes when small, sealed
-        into the local shm store when large."""
+        into the local shm store when large.  Refs captured inside the
+        value ride along as `(id, owner)` pairs so the receiving owner
+        can register borrows keyed to the container — that converts this
+        executor's transient contained-pin and lets the pins release
+        when the container is freed instead of at job exit (closing the
+        leak the round-1 design documented; reference:
+        `reference_count.h:64` contained-refs edges)."""
         chunks, total, captured = ser.serialize(v)
         self._pin_contained(captured)
+        contained = [
+            (r.binary(), tuple(r.owner))
+            for r in captured
+            if r.owner is not None
+        ]
         if total <= self.cfg.max_direct_call_object_size:
             buf = bytearray(total)
             ser.write_chunks(chunks, memoryview(buf))
-            return (_INLINE, bytes(buf))
+            return (_INLINE, bytes(buf), contained)
         dest = await self._create_with_backpressure(oid.binary(), total)
         ser.write_chunks(chunks, dest)
         del dest
         self.store.seal(oid.binary())
-        return (_SHM, self.node_id, total)
+        return (_SHM, self.node_id, total, contained)
 
     async def _load_function(self, spec: TaskSpec):
         if spec.actor_id is not None:

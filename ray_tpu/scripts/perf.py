@@ -1,0 +1,242 @@
+"""Runtime-plane microbenchmarks (reference capability:
+`python/ray/_private/ray_perf.py` — `ray microbenchmark` — and
+`release/benchmarks/`; numbers table in BASELINE.md).
+
+Measures the task/actor/object-plane hot paths end-to-end against a
+real local cluster:
+
+    python -m ray_tpu.scripts.perf [--filter pat] [--json out.json]
+           [--rounds N] [--round-sec S]
+
+Each benchmark reports ops/s (mean ± sd over rounds).  The matrix
+mirrors the reference's microbenchmark names so BASELINE.md rows are
+directly comparable (hardware caveats apply — record machine specs
+next to any saved run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: float = 1.0,
+           rounds: int = 3, round_sec: float = 1.0,
+           warmup_sec: float = 0.5) -> Tuple[str, float, float]:
+    """Run `fn` repeatedly; returns (name, ops/s mean, sd)."""
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < warmup_sec:
+        fn()
+        count += 1
+    step = max(1, count // 5)
+    stats = []
+    for _ in range(rounds):
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < round_sec:
+            for _ in range(step):
+                fn()
+            count += step
+        stats.append(multiplier * count / (time.perf_counter() - start))
+    mean = statistics.fmean(stats)
+    sd = statistics.stdev(stats) if len(stats) > 1 else 0.0
+    print(f"{name}: {mean:,.2f} +- {sd:,.2f} per second", flush=True)
+    return (name, mean, sd)
+
+
+# ---------------------------------------------------------------------
+# benchmark bodies (module-level so tasks pickle by reference)
+# ---------------------------------------------------------------------
+def _small_value():
+    return 0
+
+
+def _put_small_batch(rt_mod, n=100):
+    import ray_tpu as rt
+
+    for _ in range(n):
+        rt.put(0)
+    return 0
+
+
+class _PerfActor:
+    def small_value(self):
+        return 0
+
+    def small_value_batch(self, n):
+        return [0] * n
+
+    def submit_task_batch(self, n):
+        """Acts as an independent client: submits n tasks of its own
+        (the reference's multi-client benchmark shape)."""
+        import ray_tpu as rt
+
+        fn = rt.remote(num_cpus=0)(_small_value)
+        return len(rt.get([fn.remote() for _ in range(n)]))
+
+
+class _AsyncPerfActor:
+    async def small_value(self):
+        return 0
+
+
+def build_matrix(rt, args):
+    """(name, factory, ops-multiplier) triples.  Each factory returns
+    (body, cleanup); actors are created lazily inside the factory and
+    killed by cleanup so earlier rows aren't polluted by the background
+    load of processes later rows need (matters on small hosts)."""
+    small_value = rt.remote(num_cpus=0)(_small_value)
+    put_batch = rt.remote(num_cpus=0)(_put_small_batch)
+    Actor = rt.remote(num_cpus=0)(_PerfActor)
+    AsyncActor = rt.remote(num_cpus=0)(_AsyncPerfActor)
+    _none = lambda: None  # noqa: E731
+
+    def get_small_f():
+        value_ref = rt.put(0)
+        return (lambda: rt.get(value_ref)), _none
+
+    def put_small_f():
+        return (lambda: rt.put(0)), _none
+
+    def put_large_f():
+        arr = np.zeros(100 * 1024 * 1024 // 8, dtype=np.int64)  # 100 MB
+        return (lambda: rt.put(arr)), _none
+
+    def multi_client_put_f():
+        body = lambda: rt.get(  # noqa: E731
+            [put_batch.remote(None) for _ in range(4)]
+        )
+        return body, _none
+
+    def task_sync_f():
+        return (lambda: rt.get(small_value.remote())), _none
+
+    def tasks_async_f():
+        body = lambda: rt.get(  # noqa: E731
+            [small_value.remote() for _ in range(1000)]
+        )
+        return body, _none
+
+    def multi_client_tasks_f():
+        # each actor is an independent client submitting its own tasks
+        actors = [Actor.remote() for _ in range(4)]
+        rt.get([a.small_value.remote() for a in actors])
+        body = lambda: rt.get(  # noqa: E731
+            [a.submit_task_batch.remote(250) for a in actors]
+        )
+        return body, lambda: [rt.kill(a) for a in actors]
+
+    def actor_sync_f():
+        a = Actor.remote()
+        rt.get(a.small_value.remote())
+        return (lambda: rt.get(a.small_value.remote())), lambda: rt.kill(a)
+
+    def actor_async_f():
+        a = Actor.remote()
+        rt.get(a.small_value.remote())
+        body = lambda: rt.get(  # noqa: E731
+            [a.small_value.remote() for _ in range(1000)]
+        )
+        return body, lambda: rt.kill(a)
+
+    def async_actor_f():
+        a = AsyncActor.remote()
+        rt.get(a.small_value.remote())
+        body = lambda: rt.get(  # noqa: E731
+            [a.small_value.remote() for _ in range(1000)]
+        )
+        return body, lambda: rt.kill(a)
+
+    def n_n_actors_f():
+        actors = [Actor.remote() for _ in range(4)]
+        rt.get([a.small_value.remote() for a in actors])
+
+        def body():
+            refs = []
+            for a in actors:
+                refs.extend(a.small_value.remote() for _ in range(250))
+            rt.get(refs)
+
+        return body, lambda: [rt.kill(a) for a in actors]
+
+    def wait_1k_f():
+        def body():
+            not_ready = [small_value.remote() for _ in range(1000)]
+            while not_ready:
+                _ready, not_ready = rt.wait(not_ready)
+
+        return body, _none
+
+    def pg_f():
+        from ray_tpu.util import placement_group, remove_placement_group
+
+        def body():
+            pg = placement_group([{"CPU": 0.01}])
+            pg.ready(timeout=10)
+            remove_placement_group(pg)
+
+        return body, _none
+
+    return [
+        ("single client get calls (shm store)", get_small_f, 1),
+        ("single client put calls (shm store)", put_small_f, 1),
+        ("single client put gigabytes", put_large_f, 0.1),
+        ("multi client put calls (shm store)", multi_client_put_f, 400),
+        ("single client tasks sync", task_sync_f, 1),
+        ("single client tasks async", tasks_async_f, 1000),
+        ("multi client tasks async", multi_client_tasks_f, 1000),
+        ("1:1 actor calls sync", actor_sync_f, 1),
+        ("1:1 actor calls async", actor_async_f, 1000),
+        ("1:1 async-actor calls async", async_actor_f, 1000),
+        ("n:n actor calls async", n_n_actors_f, 1000),
+        ("single client wait 1k refs", wait_1k_f, 1),
+        ("placement group create/removal", pg_f, 1),
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--filter", default=None, help="substring filter")
+    p.add_argument("--json", default=None, help="write results to file")
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--round-sec", type=float, default=1.0)
+    p.add_argument("--num-workers", type=int, default=4)
+    args = p.parse_args(argv)
+
+    import ray_tpu as rt
+
+    owns = not rt.is_initialized()
+    if owns:
+        rt.init(num_workers=args.num_workers, num_cpus=max(
+            16, args.num_workers * 2
+        ))
+    results: Dict[str, Dict[str, float]] = {}
+    try:
+        for name, factory, mult in build_matrix(rt, args):
+            if args.filter and args.filter not in name:
+                continue
+            body, cleanup = factory()
+            try:
+                n, mean, sd = timeit(name, body, mult, rounds=args.rounds,
+                                     round_sec=args.round_sec)
+            finally:
+                cleanup()
+            results[n] = {"ops_per_s": round(mean, 2), "sd": round(sd, 2)}
+    finally:
+        if owns:
+            rt.shutdown()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    main()

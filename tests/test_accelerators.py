@@ -64,7 +64,7 @@ def test_chip_isolation_env():
     assert env[acc.CHIPS_PER_HOST_BOUNDS_ENV] == "1,2,1"
     env = acc.chip_isolation_env([0, 1, 2, 3], 8)
     assert env[acc.VISIBLE_CHIPS_ENV] == "0,1,2,3"
-    assert acc.CHIPS_PER_HOST_BOUNDS_ENV not in env
+    assert env[acc.CHIPS_PER_HOST_BOUNDS_ENV] == "2,2,1"
     # all-chip grant clears restrictions (empty string = unset)
     env = acc.chip_isolation_env([0, 1, 2, 3, 4, 5, 6, 7], 8)
     assert env[acc.VISIBLE_CHIPS_ENV] == ""

@@ -1,0 +1,130 @@
+"""Contained-refs lifetimes: an ObjectRef serialized inside another
+object (a put or a task return) must keep the inner object alive for
+exactly as long as the container lives (reference: contained-refs edges
+in `reference_count.h:64`).  Round 1 held such pins until job exit;
+these tests assert the pin now releases when the container is freed.
+"""
+
+import gc
+import time
+
+import numpy as np
+
+import ray_tpu as rt
+
+BIG = 300_000  # > inline threshold -> shm-backed
+
+
+@rt.remote
+def make_big():
+    return np.ones(BIG // 8, dtype=np.int64)
+
+
+@rt.remote
+def pack(lst):
+    # lst arrives as [ObjectRef] (refs inside containers stay refs);
+    # returning it makes the task's return object a ref container
+    return lst
+
+
+def _store_contains(ref) -> bool:
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().store.contains(ref.binary())
+
+
+def _settle(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        gc.collect()
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_put_container_pins_inner_until_container_freed(rt_start):
+    inner = make_big.remote()
+    rt.get(inner)  # materialize in shm
+    inner_id = inner.binary()
+    container = rt.put([inner])
+    del inner
+    gc.collect()
+    time.sleep(0.3)
+    # only the container holds it now: must still exist
+    from ray_tpu.core.runtime import get_runtime
+
+    rtm = get_runtime()
+    assert rtm.store.contains(inner_id)
+    # consume the container: extracted ref keeps the inner alive
+    extracted = rt.get(container)[0]
+    assert int(rt.get(extracted)[0]) == 1
+    # drop everything -> inner must actually be freed (no job-exit leak)
+    del extracted, container
+    assert _settle(lambda: not rtm.store.contains(inner_id)), (
+        "inner object leaked after its container was freed"
+    )
+
+
+def test_unconsumed_put_container_releases_on_free(rt_start):
+    """The round-1 leak: a container nobody ever reads held its pin to
+    job exit.  Now dropping the container drops the inner."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rtm = get_runtime()
+    inner = make_big.remote()
+    rt.get(inner)
+    inner_id = inner.binary()
+    container = rt.put({"ref": inner})
+    del inner
+    gc.collect()
+    time.sleep(0.2)
+    assert rtm.store.contains(inner_id)
+    del container  # never consumed
+    assert _settle(lambda: not rtm.store.contains(inner_id)), (
+        "unconsumed container leaked its contained pin"
+    )
+
+
+def test_inner_in_two_containers_survives_first_free(rt_start):
+    """A boolean pin would clobber here: freeing one container must not
+    free an inner that a second container still holds."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rtm = get_runtime()
+    inner = make_big.remote()
+    rt.get(inner)
+    inner_id = inner.binary()
+    c1 = rt.put([inner])
+    c2 = rt.put([inner])
+    del inner
+    gc.collect()
+    del c1
+    gc.collect()
+    time.sleep(0.5)
+    assert rtm.store.contains(inner_id), (
+        "freeing one container freed an inner held by another"
+    )
+    del c2
+    assert _settle(lambda: not rtm.store.contains(inner_id))
+
+
+def test_task_return_container_keeps_inner_alive(rt_start):
+    from ray_tpu.core.runtime import get_runtime
+
+    rtm = get_runtime()
+    inner = make_big.remote()
+    rt.get(inner)
+    inner_id = inner.binary()
+    container = pack.remote([inner])
+    rt.wait([container])
+    del inner
+    gc.collect()
+    time.sleep(0.3)
+    assert rtm.store.contains(inner_id), (
+        "inner freed while a task-return container still holds it"
+    )
+    got = rt.get(container)[0]
+    assert int(rt.get(got)[0]) == 1
+    del got, container
+    assert _settle(lambda: not rtm.store.contains(inner_id))

@@ -1,0 +1,34 @@
+"""Smoke test for the runtime microbenchmark harness (reference:
+`ray microbenchmark`, `_private/ray_perf.py`).  Runs a fast subset with
+tiny durations — validates the harness end-to-end, not the numbers."""
+
+import json
+
+
+def test_perf_harness_subset(tmp_path):
+    from ray_tpu.scripts.perf import main
+
+    out = tmp_path / "perf.json"
+    results = main([
+        "--filter", "client tasks sync",
+        "--rounds", "1",
+        "--round-sec", "0.2",
+        "--num-workers", "2",
+        "--json", str(out),
+    ])
+    assert "single client tasks sync" in results
+    assert results["single client tasks sync"]["ops_per_s"] > 0
+    saved = json.loads(out.read_text())
+    assert saved == results
+
+
+def test_perf_harness_actor_row():
+    from ray_tpu.scripts.perf import main
+
+    results = main([
+        "--filter", "1:1 actor calls sync",
+        "--rounds", "1",
+        "--round-sec", "0.2",
+        "--num-workers", "2",
+    ])
+    assert results["1:1 actor calls sync"]["ops_per_s"] > 0
