@@ -38,7 +38,7 @@ from ray_tpu.core import accelerators, rpc
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID
 from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits
-from ray_tpu.shm import ShmStore
+from ray_tpu.shm import ObjectExistsError, ShmStore
 
 logger = logging.getLogger(__name__)
 
@@ -116,6 +116,10 @@ class NodeDaemon:
         self._node_conns: Dict[str, rpc.Connection] = {}  # node_id -> conn
         self._node_addrs: Dict[str, Tuple[str, int]] = {}
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # inbound-transfer admission (reference: pull_manager.h:92)
+        self._inflight_pull_bytes = 0
+        self._pull_cv: Optional[asyncio.Condition] = None
+        self._chan_pool = None  # dedicated pool for blocking ring writes
         # disk-spilled primary copies: id -> file path (reference:
         # `local_object_manager.h:41` spilling/restoring)
         self._spilled: Dict[bytes, str] = {}
@@ -954,7 +958,14 @@ class NodeDaemon:
     # object plane: transfer + free (reference: object_manager.h)
     # ------------------------------------------------------------------
     async def handle_pull_object(self, payload, conn):
-        """Pull an object from a remote node into the local store."""
+        """Pull an object from a remote node into the local store,
+        chunked and admission-controlled (reference: `ObjectManager`
+        chunked transfer, `object_manager.h:206`; memory-bounded pull
+        admission, `pull_manager.h:92`).  Concurrent pulls of the same
+        object dedup onto one future; large objects stream in
+        `object_transfer_chunk_bytes` pieces written straight into a
+        pre-created shm buffer, so daemon RSS stays O(chunk), not
+        O(object)."""
         id_bytes, node_id = payload["id"], payload["node_id"]
         if self.store.contains(id_bytes):
             return {"ok": True}
@@ -963,20 +974,227 @@ class NodeDaemon:
             fut = asyncio.get_running_loop().create_future()
             self._pulls[id_bytes] = fut
             try:
-                c = await self._node_conn(node_id)
-                data = await c.call("fetch_object", {"id": id_bytes}, timeout=120)
-                if data is None:
-                    fut.set_exception(rpc.RpcError("object not on remote node"))
-                else:
-                    if not self.store.contains(id_bytes):
-                        self.store.put(id_bytes, data)
-                    fut.set_result(True)
+                await self._pull_into_store(id_bytes, node_id)
+                fut.set_result(True)
             except Exception as e:
                 fut.set_exception(e)
             finally:
                 self._pulls.pop(id_bytes, None)
         await fut
         return {"ok": True}
+
+    async def _pull_into_store(self, id_bytes: bytes, node_id: str):
+        c = await self._node_conn(node_id)
+        chunk = self.cfg.object_transfer_chunk_bytes
+        info = await c.call("object_info", {"id": id_bytes}, timeout=60)
+        if info is None:
+            raise rpc.RpcError("object not on remote node")
+        size = info["size"]
+        if size <= chunk:
+            data = await c.call("fetch_object", {"id": id_bytes}, timeout=120)
+            if data is None:
+                raise rpc.RpcError("object not on remote node")
+            if not self.store.contains(id_bytes):
+                self.store.put(id_bytes, data)
+            return
+        await self._admit_pull(size)
+        try:
+            try:
+                dest = self.store.create(id_bytes, size)
+            except ObjectExistsError:
+                return  # raced another path that materialized it
+            sealed = False
+            nxt = None
+            try:
+                # one-ahead prefetch: the next chunk's network round
+                # trip overlaps this chunk's shm memcpy
+                nxt = asyncio.ensure_future(c.call(
+                    "fetch_chunk",
+                    {"id": id_bytes, "offset": 0, "len": chunk},
+                    timeout=60,
+                ))
+                for off in range(0, size, chunk):
+                    data = await nxt
+                    nxt = None
+                    next_off = off + chunk
+                    if next_off < size:
+                        nxt = asyncio.ensure_future(c.call(
+                            "fetch_chunk",
+                            {"id": id_bytes, "offset": next_off,
+                             "len": min(chunk, size - next_off)},
+                            timeout=60,
+                        ))
+                    if data is None:
+                        raise rpc.RpcError(
+                            "remote dropped object mid-transfer"
+                        )
+                    dest[off:off + len(data)] = data
+                del data
+                self.store.seal(id_bytes)
+                sealed = True
+            finally:
+                if nxt is not None:  # error path: reap the prefetch
+                    nxt.cancel()
+                del dest
+                if not sealed:
+                    try:
+                        self.store.delete(id_bytes)
+                    except Exception:
+                        pass
+        finally:
+            self._release_pull(size)
+
+    async def _admit_pull(self, size: int):
+        """Bound total bytes of concurrent inbound transfers by what
+        the store can hold (reference: pull_manager.h:92
+        UpdatePullsBasedOnAvailableMemory).  At least one pull always
+        proceeds so a single object larger than the budget still
+        transfers (and hits the store's own create backpressure)."""
+        budget = max(
+            self.cfg.object_transfer_chunk_bytes,
+            int(self.store.capacity * 0.5),
+        )
+        if self._pull_cv is None:
+            self._pull_cv = asyncio.Condition()
+        async with self._pull_cv:
+            await self._pull_cv.wait_for(
+                lambda: self._inflight_pull_bytes == 0
+                or self._inflight_pull_bytes + size <= budget
+            )
+            self._inflight_pull_bytes += size
+
+    def _release_pull(self, size: int):
+        self._inflight_pull_bytes -= size
+        if self._pull_cv is None:
+            return
+
+        async def _notify():
+            async with self._pull_cv:
+                self._pull_cv.notify_all()
+
+        asyncio.ensure_future(_notify())
+
+    async def handle_object_info(self, payload, conn):
+        """Size lookup for a local object, restoring spilled primaries
+        so subsequent chunk fetches can be served."""
+        id_bytes = payload["id"]
+        for attempt in (0, 1):
+            try:
+                buf = self.store.get(id_bytes, timeout_ms=0)
+                try:
+                    return {"size": buf.nbytes}
+                finally:
+                    self.store.release(id_bytes)
+            except Exception:
+                if attempt or not await asyncio.get_running_loop().run_in_executor(
+                    None, self._restore_spilled, id_bytes
+                ):
+                    return None
+
+    async def handle_fetch_chunk(self, payload, conn):
+        id_bytes, off, ln = payload["id"], payload["offset"], payload["len"]
+        try:
+            buf = self.store.get(id_bytes, timeout_ms=0)
+        except Exception:
+            return None
+        try:
+            return bytes(buf[off:off + ln])
+        finally:
+            self.store.release(id_bytes)
+
+    # ------------------------------------------------------------------
+    # cross-node DAG channels (reference: remote mutable objects,
+    # `experimental_mutable_object_provider.h`) — the ring lives on the
+    # reader's node; remote writers relay through the daemons.  The
+    # blocking ring ops run in worker threads so a full ring stalls the
+    # writer's pending reply, not this daemon's event loop.
+    # ------------------------------------------------------------------
+    async def handle_chan_remote_write(self, payload, conn):
+        node_id = payload["node_id"]
+        if node_id != self.node_id:
+            c = await self._node_conn(node_id)
+            timeout_s = payload.get("timeout_ms", 120000) / 1000.0
+            return await c.call(
+                "chan_remote_write", payload, timeout=timeout_s + 15
+            )
+        # dedicated pool: a write blocks up to its timeout while the
+        # reader's ring is full — parking those on the loop's shared
+        # default executor would starve every other run_in_executor
+        # user (spill restores, the close that would unblock them, ...)
+        if self._chan_pool is None:
+            import concurrent.futures
+
+            self._chan_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="noded-chan"
+            )
+        return await asyncio.get_running_loop().run_in_executor(
+            self._chan_pool, self._chan_write_local, payload
+        )
+
+    def _chan_write_local(self, payload) -> Dict[str, Any]:
+        from ray_tpu.dag.channel import (
+            _RING, _SLOT_BYTES, KIND_ERROR, KIND_SPILL_DATA, KIND_SPILL_ERROR,
+        )
+        from ray_tpu.shm import ChannelClosedError
+
+        chan_h = payload["chan"]
+        data = payload["payload"]
+        kind = payload["kind"]
+        spill_key = payload.get("spill_key")
+        timeout_ms = payload.get("timeout_ms", 120000)
+        try:
+            # returns False when the ring already exists (idempotent)
+            self.store.chan_create(chan_h, nslots=_RING,
+                                   slot_size=_SLOT_BYTES)
+            if spill_key is None:
+                self.store.chan_write(chan_h, data, kind=kind,
+                                      timeout_ms=timeout_ms)
+            else:
+                if self.store.contains(spill_key):
+                    self.store.delete(spill_key)
+                self.store.put(spill_key, data)
+                spill_kind = (
+                    KIND_SPILL_ERROR if kind == KIND_ERROR else KIND_SPILL_DATA
+                )
+                try:
+                    self.store.chan_write(chan_h, spill_key, kind=spill_kind,
+                                          timeout_ms=timeout_ms)
+                except Exception:
+                    self.store.delete(spill_key)
+                    raise
+            return {"status": "ok"}
+        except ChannelClosedError:
+            return {"status": "closed"}
+        except TimeoutError:
+            return {"status": "timeout"}
+        except Exception as e:
+            return {"status": "error", "error": str(e)}
+
+    async def handle_chan_remote_close(self, payload, conn):
+        return await self._chan_ring_op(payload, close_only=True)
+
+    async def handle_chan_remote_destroy(self, payload, conn):
+        return await self._chan_ring_op(payload, close_only=False)
+
+    async def _chan_ring_op(self, payload, close_only: bool):
+        node_id = payload["node_id"]
+        if node_id != self.node_id:
+            c = await self._node_conn(node_id)
+            method = "chan_remote_close" if close_only else "chan_remote_destroy"
+            return await c.call(method, payload, timeout=30)
+
+        # close/delete are non-blocking C calls (brief mutex hold): run
+        # inline so they can never queue behind stalled ring writes
+        try:
+            self.store.chan_close(payload["chan"])
+        except Exception:
+            pass
+        if not close_only:
+            try:
+                self.store.chan_delete(payload["chan"])
+            except Exception:
+                pass
+        return {"status": "ok"}
 
     async def handle_fetch_object(self, payload, conn):
         id_bytes = payload["id"]

@@ -1320,9 +1320,10 @@ class Runtime:
             raise _error_from_envelope(reply[1])
         raise exc.ObjectLostError(object_id=ref.id)
 
-    async def _reconstruct_and_get(self, ref: ObjectRef):
+    async def _reconstruct_object(self, ref: ObjectRef):
         """Lineage reconstruction (reference:
-        `object_recovery_manager.h:90`): resubmit the creating task."""
+        `object_recovery_manager.h:90`): resubmit the creating task and
+        wait for the object to exist again (no value read)."""
         spec = self.lineage.get(ref.binary())
         if spec is None:
             raise exc.ObjectLostError(
@@ -1353,6 +1354,10 @@ class Runtime:
         await st.ready.wait()
         if st.error is not None:
             raise _error_from_envelope(st.error)
+        return st
+
+    async def _reconstruct_and_get(self, ref: ObjectRef):
+        st = await self._reconstruct_object(ref)
         if st.where == _INLINE:
             tag, val = ser.deserialize(memoryview(st.value))
             return _unwrap(tag, val)
@@ -1446,8 +1451,12 @@ class Runtime:
             owner = tuple(owner)
             if owner == self.address:
                 rc = self.refs.setdefault(inner_id, _RefCount())
+                # NOTE: rc.contained (the in-flight inline-arg pin) is
+                # deliberately untouched — it has its own consumption
+                # events (_h_add_borrow / owner deserialization); a
+                # container registration is an additional holder, not a
+                # consumer
                 rc.borrowers += 1
-                rc.contained = 0  # pin converts to the container borrow
                 recorded.append(("selfborrow", inner_id, None))
             else:
                 try:
@@ -1470,12 +1479,7 @@ class Runtime:
         if not entries:
             return
         for kind, inner_id, owner in entries:
-            if kind == "pin":
-                rc = self.refs.get(inner_id)
-                if rc:
-                    rc.contained = 0
-                    self._maybe_free(inner_id)
-            elif kind == "selfborrow":
+            if kind == "selfborrow":
                 rc = self.refs.get(inner_id)
                 if rc:
                     rc.borrowers -= 1
@@ -1757,10 +1761,13 @@ class Runtime:
             return st
         ref = ObjectRef(ObjectID(id_bytes), self.address)
         try:
-            # restores from spill or lineage-reconstructs; value is
-            # discarded (its get-pin releases on GC) — the side effect
-            # is the object being back in a store
-            await self._read_shm(ref, st.node_id)
+            # restore from spill, else rebuild via lineage — WITHOUT
+            # deserializing the value (no get-pin, no wasted decode)
+            reply = await self.noded.call("restore_object", {"id": id_bytes})
+            if not (
+                reply and reply.get("ok") and self.store.contains(id_bytes)
+            ):
+                await self._reconstruct_object(ref)
         except Exception:
             logger.warning("could not restore %s for borrower", ref.hex())
         return self.objects.get(id_bytes) or st

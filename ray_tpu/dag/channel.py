@@ -14,8 +14,13 @@ as the reference's native channels).  Payloads larger than a slot fall
 back to one store object per message; the slot then carries only the
 object id.
 
-Single-node scope (the compiled-graph fast path); cross-node stages fall
-back to the ordinary actor-call path.
+Cross-node channels (reference:
+`experimental_mutable_object_provider.h` — remote mutable objects):
+the ring always lives on the READER's node; a writer on another node
+relays writes through the daemons (`chan_remote_write`), which land in
+the reader's local ring — the reader's hot path is identical either
+way, and ring-full backpressure propagates to the remote writer through
+the blocking daemon call.
 """
 
 from __future__ import annotations
@@ -53,10 +58,16 @@ def _chan_hash(name: str) -> bytes:
 
 
 class Channel:
-    """SPSC channel; open lazily in each endpoint process."""
+    """SPSC channel; open lazily in each endpoint process.
 
-    def __init__(self, name: str):
+    `location` is the node id whose store hosts the ring (the reader's
+    node).  None, or a location equal to the current process's node,
+    means all ops are local; otherwise writes/close/destroy relay
+    through the node daemons."""
+
+    def __init__(self, name: str, location: Optional[str] = None):
         self.name = name
+        self.location = location
         self._h = _chan_hash(name)
         # separate hash domain: a spill key must never collide with the
         # channel's own id (deleting it would destroy the live region)
@@ -66,6 +77,13 @@ class Channel:
         self._read_seq = 0
         self._write_seq = 0
         self._opened = False
+
+    def _is_remote(self) -> bool:
+        if self.location is None:
+            return False
+        from ray_tpu.core.runtime import get_runtime
+
+        return self.location != get_runtime().node_id
 
     def _store(self):
         from ray_tpu.core.runtime import get_runtime
@@ -82,7 +100,6 @@ class Channel:
     # -- writer side ---------------------------------------------------
     def write(self, value: Any, kind: int = KIND_DATA,
               timeout_s: float = 120.0):
-        store = self._store()
         if kind == KIND_DATA:
             payload = ser.serialize_to_bytes(value)
         elif kind == KIND_ERROR:
@@ -90,6 +107,11 @@ class Channel:
         else:
             payload = b""
         timeout_ms = max(1, int(timeout_s * 1000))
+        if self._is_remote():
+            self._remote_write(payload, kind, timeout_s, timeout_ms)
+            self._write_seq += 1
+            return
+        store = self._store()
         try:
             if len(payload) <= _SLOT_BYTES:
                 store.chan_write(self._h, payload, kind=kind,
@@ -116,6 +138,44 @@ class Channel:
             ) from None
         self._write_seq += 1
 
+    def _remote_write(self, payload: bytes, kind: int,
+                      timeout_s: float, timeout_ms: int):
+        """Relay a write to the ring on `location` through the node
+        daemons.  The daemon-side chan write blocks (in a worker
+        thread) while the remote ring is full, so backpressure reaches
+        this writer through the pending reply."""
+        from ray_tpu.core.runtime import get_runtime
+
+        spill_key = (
+            self._spill_key(self._write_seq)
+            if len(payload) > _SLOT_BYTES else None
+        )
+        reply = get_runtime().noded_call(
+            "chan_remote_write",
+            {
+                "node_id": self.location,
+                "chan": self._h,
+                "kind": kind,
+                "payload": payload,
+                "spill_key": spill_key,
+                "timeout_ms": timeout_ms,
+            },
+            timeout=timeout_s + 30,
+        )
+        status = (reply or {}).get("status", "error")
+        if status == "ok":
+            return
+        if status == "closed":
+            raise ChannelClosed(self.name)
+        if status == "timeout":
+            raise TimeoutError(
+                f"channel {self.name}: reader lagging >{_RING} "
+                "executions behind"
+            )
+        raise RuntimeError(
+            f"remote channel write failed: {(reply or {}).get('error')}"
+        )
+
     def write_error(self, err: BaseException):
         self.write(err, kind=KIND_ERROR)
 
@@ -127,15 +187,31 @@ class Channel:
         except Exception:
             pass
         try:
-            self._store().chan_close(self._h)
+            if self._is_remote():
+                self._remote_ring_op("chan_remote_close")
+            else:
+                self._store().chan_close(self._h)
         except Exception:
             pass
+
+    def _remote_ring_op(self, method: str):
+        from ray_tpu.core.runtime import get_runtime
+
+        get_runtime().noded_call(
+            method, {"node_id": self.location, "chan": self._h}, timeout=30
+        )
 
     def destroy(self):
         """Free the channel's pinned shm region.  Called at DAG
         teardown AFTER the endpoints exited — channels are allocated
         non-evictable, so without this every compiled DAG would leak
         arena permanently."""
+        if self._is_remote():
+            try:
+                self._remote_ring_op("chan_remote_destroy")
+            except Exception:
+                pass
+            return
         from ray_tpu.core.runtime import get_runtime
 
         store = get_runtime().store
@@ -150,6 +226,11 @@ class Channel:
 
     # -- reader side ---------------------------------------------------
     def read_raw(self, timeout_s: Optional[float] = None) -> Tuple[int, bytes]:
+        if self._is_remote():
+            raise RuntimeError(
+                f"channel {self.name}: ring lives on node "
+                f"{self.location}; only that node's processes may read"
+            )
         store = self._store()
         timeout_ms = -1 if timeout_s is None else max(1, int(timeout_s * 1000))
         try:

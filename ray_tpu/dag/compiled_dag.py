@@ -105,28 +105,42 @@ class CompiledDAG:
             actor_handles[aid] = n.actor
             node_actor[n._id] = aid
 
-        # channels are node-local shm rings: every participant (and the
-        # driver) must live on one node — fail at compile time rather
-        # than hang at the first cross-node read
+        # each channel's ring lives on its READER's node; writers on
+        # other nodes relay through the daemons (channel.py) — so the
+        # graph may span nodes freely (reference: cross-node mutable
+        # objects, `experimental_mutable_object_provider.h`)
         from ray_tpu.core.runtime import get_runtime
 
         driver_node = get_runtime().node_id
+        actor_node: Dict[bytes, str] = {}
         for aid, h in actor_handles.items():
-            addr = h._address
-            if addr is not None and addr[0] != driver_node:
-                raise NotImplementedError(
-                    "compiled DAGs currently require all actors on the "
-                    f"driver's node (actor {aid.hex()[:12]} is on node "
-                    f"{addr[0][:12]}); cross-node stages should use "
-                    "ordinary actor calls"
+            # always refresh via the controller: a handle caches its
+            # creation-time address, and an actor restarted on another
+            # node would otherwise get its rings placed on the old node
+            addr = None
+            try:
+                info = get_runtime().controller_call(
+                    "get_actor", {"actor_id": aid}
                 )
+                if info and info.get("address"):
+                    addr = tuple(info["address"])
+            except Exception:
+                pass
+            if addr is None:
+                addr = h._address
+            if addr is None:
+                raise RuntimeError(
+                    f"cannot compile DAG: actor {aid.hex()[:12]} has no "
+                    "known address (still scheduling?)"
+                )
+            actor_node[aid] = addr[0]
 
         # consumers per produced node, to know which edges cross actors
         plans: Dict[bytes, Dict] = {
             aid: {"input_channel": None, "steps": []} for aid in by_actor
         }
         self._input_channels: List[Channel] = []
-        self._mid_channel_names: List[str] = []
+        self._mid_channels: List[Tuple[str, str]] = []
 
         def arg_source(consumer: ClassMethodNode, arg) -> Tuple[str, Any]:
             if isinstance(arg, InputNode):
@@ -135,17 +149,19 @@ class CompiledDAG:
                     # full actor id: ids embed a shared job prefix, so a
                     # short prefix collides across actors
                     name = f"dag{self._id}_in_{aid.hex()}"
-                    plans[aid]["input_channel"] = name
-                    self._input_channels.append(Channel(name))
+                    loc = actor_node[aid]  # ring on the reading actor
+                    plans[aid]["input_channel"] = (name, loc)
+                    self._input_channels.append(Channel(name, loc))
                 return (ex.SRC_INPUT, None)
             if isinstance(arg, ClassMethodNode):
                 if node_actor[arg._id] == node_actor[consumer._id]:
                     return (ex.SRC_LOCAL, arg._id)
                 name = self._chan_name(arg._id, f"n{consumer._id}")
+                loc = actor_node[node_actor[consumer._id]]  # reader side
                 # register the edge on the producer's step
-                producer_step[arg._id]["out_channels"].append(name)
-                self._mid_channel_names.append(name)
-                return (ex.SRC_CHAN, name)
+                producer_step[arg._id]["out_channels"].append((name, loc))
+                self._mid_channels.append((name, loc))
+                return (ex.SRC_CHAN, (name, loc))
             if isinstance(arg, DAGNode):
                 raise TypeError(f"unsupported node type {type(arg)}")
             return (ex.SRC_CONST, arg)
@@ -166,12 +182,12 @@ class CompiledDAG:
             step["args"] = [arg_source(n, a) for a in n.args]
             step["kwargs"] = {k: arg_source(n, v) for k, v in n.kwargs.items()}
 
-        # output channels: leaves -> driver
+        # output channels: leaves -> driver (rings on the driver's node)
         self._output_channels: List[Channel] = []
         for i, o in enumerate(self._outputs):
             name = self._chan_name(o._id, f"out{i}")
-            producer_step[o._id]["out_channels"].append(name)
-            self._output_channels.append(Channel(name))
+            producer_step[o._id]["out_channels"].append((name, driver_node))
+            self._output_channels.append(Channel(name, driver_node))
 
         # launch one resident loop per actor (framework-reserved method;
         # the runtime routes it to execution.dag_exec_loop)
@@ -274,8 +290,8 @@ class CompiledDAG:
         # so skipping this would leak arena on every compile/teardown
         for ch in [*self._input_channels, *self._output_channels]:
             ch.destroy()
-        for name in getattr(self, "_mid_channel_names", ()):  # actor-to-
-            Channel(name).destroy()  # actor edges (opened in exec loops)
+        for name, loc in getattr(self, "_mid_channels", ()):  # actor-to-
+            Channel(name, loc).destroy()  # actor edges (exec-loop opened)
 
     def __del__(self):
         try:

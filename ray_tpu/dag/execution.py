@@ -27,23 +27,27 @@ SRC_INPUT = "input"  # the per-execution driver input
 
 def dag_exec_loop(instance: Any, plan: Dict) -> int:
     """plan = {
-        "input_channel": name | None,
+        "input_channel": (name, location) | None,
         "steps": [
             {"node_id", "method", "args": [(src, payload), ...],
              "kwargs": {k: (src, payload)},
-             "out_channels": [names]},  # consumers on other actors
+             "out_channels": [(name, location)]},  # cross-actor edges
         ],
     }
+    Channel refs are (name, ring-location-node); rings live on their
+    reader's node, so reads here are always local and writes relay
+    through the daemons when the consumer is on another node.
     Returns the number of completed executions (after teardown)."""
     input_chan = (
-        Channel(plan["input_channel"]) if plan.get("input_channel") else None
+        Channel(*plan["input_channel"]) if plan.get("input_channel") else None
     )
     chans: Dict[str, Channel] = {}
 
-    def chan(name: str) -> Channel:
+    def chan(ref) -> Channel:
+        name, loc = ref
         c = chans.get(name)
         if c is None:
-            c = chans[name] = Channel(name)
+            c = chans[name] = Channel(name, loc)
         return c
 
     executions = 0

@@ -1,0 +1,91 @@
+"""Chunked node-to-node object transfer + pull admission
+(reference: `object_manager.h:206` 5 MiB chunked push/pull,
+`pull_manager.h:92` memory-bounded admission).
+
+The key property: transferring a large object must NOT materialize the
+whole payload in daemon process memory — chunks stream straight into a
+pre-created shm buffer, so daemon RSS grows by O(chunk), not O(object).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+
+MB = 1024 * 1024
+
+
+def _rss(pid: int) -> int:
+    """Anonymous RSS: Python-heap copies of the payload show up here;
+    the shm destination pages (file-backed, shared) do not — exactly
+    the 'no whole-object bytes in Python' property under test."""
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("RssAnon:"):
+                return int(line.split()[1]) * 1024
+    return 0
+
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    monkeypatch.setenv("RT_OBJECT_TRANSFER_CHUNK_BYTES", str(4 * MB))
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 2})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@rt.remote
+def make_remote_array(n_bytes, seed):
+    return np.full(n_bytes // 8, seed, dtype=np.int64)
+
+
+@rt.remote
+def checksum(arr):
+    return int(arr[0]), int(arr[-1]), len(arr)
+
+
+def test_chunked_cross_node_pull_bounded_rss(cluster):
+    node2 = cluster.add_node(num_cpus=2, resources={"src": 1}, num_workers=2)
+    cluster.wait_for_nodes()
+    size = 64 * MB
+    ref = make_remote_array.options(resources={"src": 1}).remote(size, 7)
+    rt.wait([ref])
+
+    head_pid = cluster.head_node.proc.pid
+    rss_before = _rss(head_pid)
+    arr = rt.get(ref)  # pulls head <- node2 through the head daemon
+    rss_after = _rss(head_pid)
+    assert int(arr[0]) == 7 and len(arr) == size // 8
+    delta = rss_after - rss_before
+    # whole-object transfer held >= size bytes of Python buffers in the
+    # daemon; chunked streaming keeps a couple of chunks in flight
+    assert delta < size // 2, f"daemon anon RSS grew {delta/MB:.1f} MB"
+
+
+def test_broadcast_to_multiple_nodes(cluster):
+    for i in range(2):
+        cluster.add_node(num_cpus=2, resources={f"n{i}": 1}, num_workers=2)
+    cluster.wait_for_nodes()
+    size = 12 * MB
+    ref = make_remote_array.remote(size, 3)
+    rt.wait([ref])
+    # every node pulls the same object concurrently (dedup on each
+    # puller; reference: push dedup in PushManager)
+    sums = rt.get([
+        checksum.options(resources={f"n{i}": 1}).remote(ref)
+        for i in range(2)
+    ] + [checksum.remote(ref)])
+    assert all(s == (3, 3, size // 8) for s in sums)
+
+
+def test_small_object_single_rpc(cluster):
+    cluster.add_node(num_cpus=2, resources={"src": 1}, num_workers=2)
+    cluster.wait_for_nodes()
+    ref = make_remote_array.options(resources={"src": 1}).remote(256 * 1024, 9)
+    arr = rt.get(ref)
+    assert int(arr[0]) == 9
