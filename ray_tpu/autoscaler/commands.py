@@ -1,0 +1,189 @@
+"""Cluster launcher: `rt up / down / status <cluster.yaml>`.
+
+Reference: `python/ray/autoscaler/_private/commands.py` (`ray up/down`)
++ the cluster YAML schema (`autoscaler/ray-schema.json`), collapsed to
+the fields this framework's two providers need:
+
+```yaml
+cluster_name: my-tpu-cluster
+provider:
+  type: gcp_tpu            # or: local
+  project: my-project
+  zone: us-central2-b
+  accelerator_type: v5e-8
+  runtime_version: tpu-ubuntu2204-base
+head:
+  controller_host: 10.0.0.2  # head VM IP; REQUIRED to create workers
+  controller_port: 7777      # where workers join
+min_workers: 1
+max_workers: 4
+worker:
+  accelerator_type: v5e-8
+  num_workers: 4           # worker processes per node
+```
+
+`up` creates the head node then min_workers workers whose startup
+script joins the head; `down` terminates every node carrying the
+cluster label.  All API traffic goes through the provider's injectable
+transport, so the whole flow dry-runs against a mock (tests) and the
+CLI offers --dry-run for real configs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider, worker_startup_script
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    for key in ("cluster_name", "provider"):
+        if key not in cfg:
+            raise ValueError(f"cluster config missing required key {key!r}")
+    ptype = cfg["provider"].get("type")
+    if ptype not in ("gcp_tpu", "local"):
+        raise ValueError(f"unknown provider type {ptype!r}")
+    if ptype == "gcp_tpu":
+        for key in ("project", "zone"):
+            if key not in cfg["provider"]:
+                raise ValueError(f"gcp_tpu provider needs {key!r}")
+    return cfg
+
+
+class _DryRunTransport:
+    """Records the API calls `up/down` would make."""
+
+    def __init__(self):
+        self.calls: List[tuple] = []
+        self.nodes: Dict[str, dict] = {}
+
+    def __call__(self, method: str, url: str, body: Optional[dict]) -> dict:
+        self.calls.append((method, url, body))
+        if method == "POST":
+            node_id = url.rsplit("nodeId=", 1)[-1]
+            self.nodes[node_id] = {
+                "name": url.split("?")[0] + "/" + node_id,
+                "state": "READY",
+                **(body or {}),
+            }
+        if method == "DELETE":
+            self.nodes.pop(url.rsplit("/", 1)[-1], None)
+        if method == "GET":
+            return {"nodes": list(self.nodes.values())}
+        return {}
+
+
+def _provider_for(cfg: Dict[str, Any], transport=None) -> GcpTpuNodeProvider:
+    p = cfg["provider"]
+    head = cfg.get("head", {})
+    controller_host = head.get("controller_host", "HEAD_IP")
+    controller_port = int(head.get("controller_port", 7777))
+    script = worker_startup_script(
+        controller_host, controller_port,
+        num_workers=int(cfg.get("worker", {}).get("num_workers", 0)),
+    )
+    return GcpTpuNodeProvider(
+        project=p["project"],
+        zone=p["zone"],
+        cluster_name=cfg["cluster_name"],
+        accelerator_type=p.get("accelerator_type", "v5e-8"),
+        runtime_version=p.get("runtime_version", "tpu-ubuntu2204-base"),
+        startup_script=script,
+        network=p.get("network"),
+        transport=transport,
+    )
+
+
+def up(cfg: Dict[str, Any], *, transport=None, _print=print) -> Dict[str, Any]:
+    """Create head + min_workers workers.  Returns a summary dict."""
+    provider = _provider_for(cfg, transport)
+    # one list call: ids carry no type information, labels do
+    nodes = provider._list()
+    live = {
+        n["name"].rsplit("/", 1)[-1]: n.get("labels", {}).get(
+            "rt-node-type", "worker"
+        )
+        for n in nodes
+        if n.get("state") in ("CREATING", "READY", "STARTING", "REPAIRING")
+    }
+    created: Dict[str, List[str]] = {"head": [], "worker": []}
+    have_head = "head" in live.values()
+    n_workers = int(cfg.get("min_workers", 0))
+    if n_workers and not cfg.get("head", {}).get("controller_host"):
+        raise ValueError(
+            "head.controller_host is required to create workers: their "
+            "startup script must point at the head's controller.  Run "
+            "`up` with min_workers: 0 first, read the head VM's IP, set "
+            "head.controller_host, then `up` again (or let the in-"
+            "cluster autoscaler add workers)."
+        )
+    if not have_head:
+        created["head"] = provider.create_node(
+            {"node_type": "head",
+             "accelerator_type": cfg.get("head", {}).get(
+                 "accelerator_type",
+                 cfg["provider"].get("accelerator_type", "v5e-8"))},
+            1,
+        )
+        _print(f"created head node {created['head'][0]}")
+    existing_workers = sum(1 for t in live.values() if t != "head")
+    to_create = max(0, n_workers - existing_workers)
+    if to_create:
+        created["worker"] = provider.create_node(
+            {"node_type": "worker",
+             "accelerator_type": cfg.get("worker", {}).get(
+                 "accelerator_type",
+                 cfg["provider"].get("accelerator_type", "v5e-8"))},
+            to_create,
+        )
+        _print(f"created {to_create} worker node(s)")
+    return {"created": created, "live_before": sorted(live)}
+
+
+def down(cfg: Dict[str, Any], *, transport=None, _print=print) -> List[str]:
+    """Terminate every node of the cluster; returns their ids."""
+    provider = _provider_for(cfg, transport)
+    ids = provider.non_terminated_nodes()
+    for pid in ids:
+        provider.terminate_node(pid)
+        _print(f"terminated {pid}")
+    return ids
+
+
+def status(cfg: Dict[str, Any], *, transport=None) -> List[Dict[str, Any]]:
+    provider = _provider_for(cfg, transport)
+    return [
+        {"id": pid, "resources": provider.node_resources(pid)}
+        for pid in provider.non_terminated_nodes()
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="rt-cluster", description=__doc__)
+    p.add_argument("command", choices=["up", "down", "status"])
+    p.add_argument("config", help="cluster YAML path")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the API calls instead of making them")
+    args = p.parse_args(argv)
+    cfg = load_cluster_config(args.config)
+    transport = _DryRunTransport() if args.dry_run else None
+    fn = {"up": up, "down": down, "status": status}[args.command]
+    out = fn(cfg, transport=transport) if args.command != "status" else status(
+        cfg, transport=transport
+    )
+    if args.dry_run:
+        for method, url, _body in transport.calls:
+            print(f"DRY-RUN {method} {url}")
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

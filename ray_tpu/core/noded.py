@@ -986,17 +986,19 @@ class NodeDaemon:
     async def _pull_into_store(self, id_bytes: bytes, node_id: str):
         c = await self._node_conn(node_id)
         chunk = self.cfg.object_transfer_chunk_bytes
-        info = await c.call("object_info", {"id": id_bytes}, timeout=60)
-        if info is None:
+        # single round trip for the common small case: fetch_object
+        # returns the bytes directly, or ("too_large", size) when the
+        # object needs the chunked path
+        reply = await c.call(
+            "fetch_object", {"id": id_bytes, "max_bytes": chunk}, timeout=120
+        )
+        if reply is None:
             raise rpc.RpcError("object not on remote node")
-        size = info["size"]
-        if size <= chunk:
-            data = await c.call("fetch_object", {"id": id_bytes}, timeout=120)
-            if data is None:
-                raise rpc.RpcError("object not on remote node")
+        if not (isinstance(reply, tuple) and reply[0] == "too_large"):
             if not self.store.contains(id_bytes):
-                self.store.put(id_bytes, data)
+                self.store.put(id_bytes, reply)
             return
+        size = reply[1]
         await self._admit_pull(size)
         try:
             try:
@@ -1093,14 +1095,21 @@ class NodeDaemon:
 
     async def handle_fetch_chunk(self, payload, conn):
         id_bytes, off, ln = payload["id"], payload["offset"], payload["len"]
-        try:
-            buf = self.store.get(id_bytes, timeout_ms=0)
-        except Exception:
-            return None
-        try:
-            return bytes(buf[off:off + ln])
-        finally:
-            self.store.release(id_bytes)
+        for attempt in (0, 1):
+            try:
+                buf = self.store.get(id_bytes, timeout_ms=0)
+            except Exception:
+                # the object may have been spilled mid-transfer (it is
+                # unpinned between chunk fetches): restore and retry
+                if attempt or not await asyncio.get_running_loop(
+                ).run_in_executor(None, self._restore_spilled, id_bytes):
+                    return None
+                continue
+            try:
+                return bytes(buf[off:off + ln])
+            finally:
+                self.store.release(id_bytes)
+        return None
 
     # ------------------------------------------------------------------
     # cross-node DAG channels (reference: remote mutable objects,
@@ -1211,6 +1220,10 @@ class NodeDaemon:
             except Exception:
                 return None
         try:
+            max_bytes = payload.get("max_bytes")
+            if max_bytes is not None and buf.nbytes > max_bytes:
+                # chunked-transfer handshake: size only, no payload
+                return ("too_large", buf.nbytes)
             return bytes(buf)
         finally:
             self.store.release(id_bytes)

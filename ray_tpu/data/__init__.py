@@ -7,6 +7,7 @@ bounded in-flight work, and per-consumer streaming splits for Train.
 
 from ray_tpu.data import aggregate
 from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.context import ActorPoolStrategy, DataContext
 from ray_tpu.data.dataset import (
     Dataset,
     GroupedData,
@@ -24,7 +25,9 @@ from ray_tpu.data.dataset import (
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
+    "ActorPoolStrategy",
     "Count",
+    "DataContext",
     "DataIterator",
     "Dataset",
     "GroupedData",

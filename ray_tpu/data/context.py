@@ -1,0 +1,57 @@
+"""Execution context for Data pipelines.
+
+Reference: `data/context.py` DataContext + the execution resource
+manager / backpressure policies
+(`_internal/execution/resource_manager.py:25`,
+`backpressure_policy/`).  One process-wide current context, overridable
+per call the way the reference does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    #: max in-flight tasks per streaming stage (count-based pressure)
+    window: int = 8
+    #: max estimated bytes being processed per stage at once
+    #: (byte-based pressure; estimated from input-block metadata when
+    #: the upstream task has completed)
+    max_stage_inflight_bytes: int = 256 * 1024 * 1024
+    #: pipelined calls per actor in actor-pool map stages
+    actor_pool_pipeline_depth: int = 2
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        global _current_context
+        if _current_context is None:
+            _current_context = DataContext()
+        return _current_context
+
+
+_current_context: Optional[DataContext] = None
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """compute= strategy for `map_batches` with a class UDF: a pool of
+    actors holding one constructed UDF instance each, autoscaled
+    between min_size and max_size by queue pressure (reference:
+    `actor_pool_map_operator.py` + `execution/autoscaler/`)."""
+
+    size: Optional[int] = None  # fixed size shorthand
+    min_size: int = 1
+    max_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.size is not None:
+            self.min_size = self.max_size = self.size
+        if self.max_size is None:
+            self.max_size = max(self.min_size, 4)
+        if self.min_size < 1 or self.max_size < self.min_size:
+            raise ValueError(
+                f"invalid actor pool bounds [{self.min_size}, {self.max_size}]"
+            )

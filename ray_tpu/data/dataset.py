@@ -62,8 +62,36 @@ class Dataset:
         *,
         batch_size: Optional[int] = None,
         batch_format: str = "numpy",
+        compute=None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[Dict] = None,
         **_kwargs,
     ) -> "Dataset":
+        if compute is not None or isinstance(fn, type):
+            from ray_tpu.data.context import ActorPoolStrategy
+            from ray_tpu.data.plan import ActorMapOp
+
+            if compute == "actors" or compute is None:
+                compute = ActorPoolStrategy()
+            if not isinstance(compute, ActorPoolStrategy):
+                raise TypeError(
+                    "compute= must be 'actors' or an ActorPoolStrategy"
+                )
+            if not isinstance(fn, type):
+                raise TypeError(
+                    "actor compute needs a class UDF (constructed once "
+                    "per pool actor, called per batch)"
+                )
+            return self._with_op(ActorMapOp(
+                cls=fn,
+                args=tuple(fn_constructor_args),
+                kwargs=dict(fn_constructor_kwargs or {}),
+                batch_size=batch_size,
+                batch_format=batch_format,
+                strategy=compute,
+                name=f"ActorMap({fn.__name__})",
+            ))
+
         def op(blk: B.Block) -> List[B.Block]:
             out: List[B.Block] = []
             n = B.num_rows(blk)

@@ -20,7 +20,15 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu as rt
 from ray_tpu.data import block as B
-from ray_tpu.data.plan import AllToAllOp, LimitOp, LogicalPlan, MapOp, ReadOp
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.plan import (
+    ActorMapOp,
+    AllToAllOp,
+    LimitOp,
+    LogicalPlan,
+    MapOp,
+    ReadOp,
+)
 
 # (block_ref, meta_ref-or-value)
 RefPair = Tuple[Any, Any]
@@ -47,16 +55,45 @@ def _run_alltoall_task(fn: Callable[[List[B.Block]], List[B.Block]], *blocks):
     return pairs
 
 
+class _BatchMapWorker:
+    """Pool actor for ActorMapOp: constructs the UDF once, maps blocks
+    batch-by-batch (reference: `actor_pool_map_operator.py` worker)."""
+
+    def __init__(self, cls, args, kwargs, batch_size, batch_format):
+        self._udf = cls(*args, **kwargs)
+        self._batch_size = batch_size
+        self._fmt = batch_format
+
+    def map_block(self, blk: B.Block):
+        from ray_tpu.data.dataset import _coerce_batch
+
+        out: List[B.Block] = []
+        n = B.num_rows(blk)
+        size = self._batch_size or n or 1
+        for s in range(0, max(n, 1), size):
+            piece = B.slice_block(blk, s, min(s + size, n))
+            res = self._udf(B.format_batch(piece, self._fmt))
+            out.append(_coerce_batch(res))
+        merged = B.concat(out) if len(out) != 1 else out[0]
+        return merged, {
+            "num_rows": B.num_rows(merged),
+            "size_bytes": B.size_bytes(merged),
+        }
+
+
 def _slice_task(blk: B.Block, end: int):
     out = B.slice_block(blk, 0, end)
     return out, {"num_rows": B.num_rows(out), "size_bytes": B.size_bytes(out)}
 
 
 class StreamingExecutor:
-    def __init__(self, plan: LogicalPlan, *, window: int = 8,
+    def __init__(self, plan: LogicalPlan, *, window: Optional[int] = None,
                  num_cpus: float = 1.0):
+        ctx = DataContext.get_current()
         self.plan = plan.optimized()
-        self.window = window
+        self.window = window if window is not None else ctx.window
+        self.max_stage_bytes = ctx.max_stage_inflight_bytes
+        self._actor_depth = ctx.actor_pool_pipeline_depth
         self._remote_opts = {"num_cpus": num_cpus, "num_returns": 2}
         self.stats: Dict[str, Any] = {"stages": self.plan.describe(), "tasks": 0}
 
@@ -72,16 +109,107 @@ class StreamingExecutor:
         while inflight:
             yield inflight.popleft()
 
+    def _input_size(self, meta) -> int:
+        """Estimated bytes of an input block, WITHOUT stalling the
+        pipeline: metadata is consulted only when already materialized
+        (a dict, or a completed task's ready ref) — else 0 (unknown,
+        count-based pressure still applies)."""
+        if isinstance(meta, dict):
+            return int(meta.get("size_bytes", 0))
+        try:
+            done, _ = rt.wait([meta], timeout=0)
+            if done:
+                return int(rt.get(meta).get("size_bytes", 0))
+        except Exception:
+            pass
+        return 0
+
     def _map_stream(self, stream: Iterator[RefPair], op: MapOp) -> Iterator[RefPair]:
+        """Task-based map with count- AND byte-based backpressure
+        (reference: ConcurrencyCapBackpressurePolicy + the resource
+        manager's per-operator memory budgets)."""
         map_remote = rt.remote(_run_map_task).options(**self._remote_opts)
-        inflight: deque = deque()
-        for block_ref, _meta in stream:
-            while len(inflight) >= self.window:
-                yield inflight.popleft()
-            inflight.append(tuple(map_remote.remote(op.fn, block_ref)))
+        inflight: deque = deque()  # (pair, est_bytes)
+        inflight_bytes = 0
+        for block_ref, meta in stream:
+            sz = self._input_size(meta)
+            while len(inflight) >= self.window or (
+                inflight and inflight_bytes + sz > self.max_stage_bytes
+            ):
+                pair, psz = inflight.popleft()
+                inflight_bytes -= psz
+                yield pair
+            inflight.append(
+                (tuple(map_remote.remote(op.fn, block_ref)), sz)
+            )
+            inflight_bytes += sz
             self.stats["tasks"] += 1
         while inflight:
-            yield inflight.popleft()
+            yield inflight.popleft()[0]
+
+    def _actor_map_stream(self, stream: Iterator[RefPair],
+                          op: ActorMapOp) -> Iterator[RefPair]:
+        """Actor-pool map (reference: `actor_pool_map_operator.py` +
+        pool autoscaler): blocks route to the least-loaded actor with
+        `actor_pool_pipeline_depth` pipelining; the pool grows toward
+        strategy.max_size while saturated and is torn down when the
+        stream ends."""
+        strat = op.strategy
+        Worker = rt.remote(num_cpus=self._remote_opts["num_cpus"])(
+            _BatchMapWorker
+        )
+
+        def spawn():
+            return Worker.remote(op.cls, op.args, op.kwargs,
+                                 op.batch_size, op.batch_format)
+
+        actors = [spawn() for _ in range(strat.min_size)]
+        load = [0] * len(actors)
+        outstanding: Dict[Any, int] = {}  # meta_ref -> actor index
+        inflight: deque = deque()  # pairs in submission order
+
+        def reap(block: bool):
+            if not outstanding:
+                return
+            done, _ = rt.wait(
+                list(outstanding),
+                num_returns=1 if block else len(outstanding),
+                timeout=None if block else 0,
+            )
+            for m in done:
+                load[outstanding.pop(m)] -= 1
+
+        try:
+            for block_ref, _meta in stream:
+                reap(block=False)
+                while True:
+                    i = min(range(len(actors)), key=load.__getitem__)
+                    if load[i] < self._actor_depth:
+                        break
+                    if len(actors) < strat.max_size:
+                        actors.append(spawn())
+                        load.append(0)
+                        i = len(actors) - 1
+                        break
+                    # saturated at max_size: hand completed work
+                    # downstream, then wait for a slot
+                    if inflight:
+                        yield inflight.popleft()
+                    reap(block=True)
+                method = actors[i].map_block.options(num_returns=2)
+                b, m = method.remote(block_ref)
+                load[i] += 1
+                outstanding[m] = i
+                inflight.append((b, m))
+                self.stats["tasks"] += 1
+            while inflight:
+                yield inflight.popleft()
+        finally:
+            for a in actors:
+                try:
+                    rt.kill(a)
+                except Exception:
+                    pass
 
     def _alltoall_stream(self, stream: Iterator[RefPair],
                          op: AllToAllOp) -> Iterator[RefPair]:
@@ -124,6 +252,8 @@ class StreamingExecutor:
         for op in ops[1:]:
             if isinstance(op, MapOp):
                 stream = self._map_stream(stream, op)
+            elif isinstance(op, ActorMapOp):
+                stream = self._actor_map_stream(stream, op)
             elif isinstance(op, AllToAllOp):
                 stream = self._alltoall_stream(stream, op)
             elif isinstance(op, LimitOp):

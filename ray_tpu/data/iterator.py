@@ -72,39 +72,105 @@ class _SplitCoordinator:
     never truncate a slow shard's in-progress epoch.
     """
 
-    def __init__(self, dataset, n: int):
-        import asyncio
+    def __init__(self, dataset, n: int, equal: bool = False):
+        import threading
+        from collections import deque as _dq
 
         self._dataset = dataset
         self._n = n
+        self._equal = equal
         self._epoch = -1
         self._gen = None
         self._done = True
-        self._cond = asyncio.Condition()
+        # SYNC methods + threading primitives: methods run in executor
+        # threads (max_concurrency sizes the pool), where blocking
+        # rt.get/rt.put are safe — an async coordinator would run on the
+        # runtime's io loop and deadlock on them
+        self._cond = threading.Condition()
+        self._lock = threading.Lock()  # serializes generator pulls
+        self._queues = [_dq() for _ in range(n)]  # equal-mode shards
+        self._carry = None  # remainder rows carried between blocks
 
-    async def start_epoch(self, shard: int, epoch: int) -> bool:
-        async with self._cond:
+    def start_epoch(self, shard: int, epoch: int) -> bool:
+        with self._cond:
             if epoch <= self._epoch:
                 return True
             # wait for exhaustion (only reachable if a caller skips
             # ahead without draining; normal iterators never wait here)
-            await self._cond.wait_for(lambda: self._done)
+            self._cond.wait_for(
+                lambda: self._done and all(not q for q in self._queues)
+            )
             if epoch > self._epoch:
                 self._epoch = epoch
                 self._gen = self._dataset._pairs()
                 self._done = False
+                self._queues = [type(self._queues[0])() for _ in range(self._n)]
+                self._carry = None
         return True
 
-    async def next_block(self, shard: int, epoch: int):
-        if epoch != self._epoch or self._gen is None or self._done:
+    def next_block(self, shard: int, epoch: int):
+        if epoch != self._epoch or self._gen is None:
             return None
-        try:
-            return next(self._gen)
-        except StopIteration:
-            async with self._cond:
-                self._done = True
-                self._cond.notify_all()
-            return None
+        if not self._equal:
+            with self._lock:
+                # re-check under the lock: a shard parked here across
+                # an epoch rollover must not pull from the NEW epoch's
+                # generator for its stale epoch-N call
+                if epoch != self._epoch or self._done:
+                    return None
+                try:
+                    return next(self._gen)
+                except StopIteration:
+                    self._mark_done()
+                    return None
+        # equal=True: every shard receives exactly the same row count
+        # (reference: the output splitter's equal mode).  Each upstream
+        # block (plus carried remainder) splits into n equal sub-blocks
+        # pushed one per shard queue; remainder rows carry into the next
+        # block and only the final < n rows are dropped at exhaustion.
+        import ray_tpu as rt
+
+        with self._lock:
+            if epoch != self._epoch:  # rolled over while parked at lock
+                return None
+            while not self._queues[shard]:
+                if self._done:
+                    return None
+                try:
+                    block_ref, _meta = next(self._gen)
+                except StopIteration:
+                    self._mark_done()
+                    return None
+                blk = rt.get(block_ref)
+                if self._carry is not None:
+                    blk = B.concat([self._carry, blk])
+                    self._carry = None
+                rows = B.num_rows(blk)
+                per = rows // self._n
+                if per == 0:
+                    self._carry = blk
+                    continue
+                for i in range(self._n):
+                    piece = B.slice_block(blk, i * per, (i + 1) * per)
+                    meta = {
+                        "num_rows": per,
+                        "size_bytes": B.size_bytes(piece),
+                    }
+                    self._queues[i].append((rt.put(piece), meta))
+                rem = rows - per * self._n
+                if rem:
+                    self._carry = B.slice_block(blk, rows - rem, rows)
+            out = self._queues[shard].popleft()
+            if self._done and not self._queues[shard]:
+                # epoch-restart waiters key on done AND drained queues
+                with self._cond:
+                    self._cond.notify_all()
+            return out
+
+    def _mark_done(self):
+        with self._cond:
+            self._done = True
+            self._cond.notify_all()
 
 
 class DataIterator:
@@ -166,12 +232,7 @@ class DataIterator:
 def make_streaming_split(dataset, n: int, *, equal: bool = False) -> List[DataIterator]:
     import ray_tpu as rt
 
-    if equal:
-        raise NotImplementedError(
-            "streaming_split(equal=True) is not implemented yet; use "
-            "equal=False (first-come-first-served shards)"
-        )
     coord = rt.remote(_SplitCoordinator).options(
         num_cpus=0, max_concurrency=max(2, n + 1)
-    ).remote(dataset, n)
+    ).remote(dataset, n, equal)
     return [DataIterator(coord, i, n) for i in range(n)]

@@ -34,6 +34,21 @@ class MapOp:
 
 
 @dataclass
+class ActorMapOp:
+    """Per-block transform executed on a pool of UDF-holding actors
+    (reference: `actor_pool_map_operator.py`).  Never fused: the UDF
+    instance carries state that must live in the actor."""
+
+    cls: Any
+    args: tuple
+    kwargs: Dict[str, Any]
+    batch_size: Optional[int]
+    batch_format: str
+    strategy: Any  # ActorPoolStrategy
+    name: str = "ActorMap"
+
+
+@dataclass
 class AllToAllOp:
     """Barrier: List[Block] -> List[Block] (repartition, shuffle, sort,
     groupby reduce)."""

