@@ -156,11 +156,7 @@ def down(cfg: Dict[str, Any], *, transport=None, _print=print) -> List[str]:
 
 
 def status(cfg: Dict[str, Any], *, transport=None) -> List[Dict[str, Any]]:
-    provider = _provider_for(cfg, transport)
-    return [
-        {"id": pid, "resources": provider.node_resources(pid)}
-        for pid in provider.non_terminated_nodes()
-    ]
+    return _provider_for(cfg, transport).list_cluster_nodes()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -175,9 +171,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = load_cluster_config(args.config)
     transport = _DryRunTransport() if args.dry_run else None
     fn = {"up": up, "down": down, "status": status}[args.command]
-    out = fn(cfg, transport=transport) if args.command != "status" else status(
-        cfg, transport=transport
-    )
+    out = fn(cfg, transport=transport)
     if args.dry_run:
         for method, url, _body in transport.calls:
             print(f"DRY-RUN {method} {url}")

@@ -152,17 +152,31 @@ class GcpTpuNodeProvider(NodeProvider):
         return out
 
     def non_terminated_nodes(self) -> List[str]:
-        return [
-            n["name"].rsplit("/", 1)[-1]
-            for n in self._list()
-            if n.get("state") in _LIVE_STATES
-        ]
+        return [n["id"] for n in self.list_cluster_nodes()]
+
+    def list_cluster_nodes(self) -> List[Dict[str, Any]]:
+        """Live cluster members from ONE list call: id, type label, and
+        per-host resources (avoids the 1+N listing pattern a per-node
+        `node_resources` loop would produce)."""
+        out = []
+        for n in self._list():
+            if n.get("state") not in _LIVE_STATES:
+                continue
+            at = n.get("acceleratorType", self.accelerator_type)
+            out.append({
+                "id": n["name"].rsplit("/", 1)[-1],
+                "node_type": n.get("labels", {}).get("rt-node-type",
+                                                     "worker"),
+                "resources": {
+                    "TPU": float(chips_for_accelerator_type(at))
+                },
+            })
+        return out
 
     def node_resources(self, provider_id: str) -> Dict[str, float]:
-        for n in self._list():
-            if n["name"].rsplit("/", 1)[-1] == provider_id:
-                at = n.get("acceleratorType", self.accelerator_type)
-                return {"TPU": float(chips_for_accelerator_type(at))}
+        for n in self.list_cluster_nodes():
+            if n["id"] == provider_id:
+                return dict(n["resources"])
         raise KeyError(provider_id)
 
 

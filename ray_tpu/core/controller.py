@@ -513,9 +513,29 @@ class Controller:
                 "used": payload.get("used", {}),
                 "busy": payload.get("busy", False),
                 "queued": payload.get("queued", 0),
+                "workers": payload.get("workers"),
+                "host": payload.get("host"),
                 "ts": _t.time(),
             }
         return {"ok": True}
+
+    async def handle_get_worker_snapshot(self, payload, conn):
+        """Cluster-wide worker inventory from the per-node reporter
+        cache: one call instead of an RPC per node (reference: the
+        dashboard state aggregator fed by per-node reporter agents)."""
+        import time as _t
+
+        now = _t.time()
+        out = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            load = getattr(n, "load", None) or {}
+            workers = load.get("workers")
+            if workers is None or now - load.get("ts", 0) > 10.0:
+                return None  # stale/missing: caller falls back to fan-out
+            out.extend(workers)
+        return out
 
     async def handle_get_autoscaler_state(self, payload, conn):
         import time as _t

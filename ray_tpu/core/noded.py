@@ -496,10 +496,28 @@ class NodeDaemon:
                     w.in_flight or w.actor_id is not None
                     for w in self.workers.values()
                 )
+                # per-node reporter (reference: `dashboard/agent.py:25` +
+                # reporter_agent.py): worker inventory + host stats ride
+                # the load report, so the state API's list_workers reads
+                # ONE controller snapshot instead of fanning out an RPC
+                # per node per call
+                from ray_tpu.core.memory_monitor import _system_memory
+
+                mem_used, mem_total = _system_memory()
+                try:
+                    load1 = os.getloadavg()[0]
+                except OSError:
+                    load1 = 0.0
                 self.controller_conn.send(
                     "report_node_load",
                     {"node_id": self.node_id, "used": used, "busy": busy,
-                     "queued": len(self.task_queue)},
+                     "queued": len(self.task_queue),
+                     "workers": self._worker_inventory(),
+                     "host": {
+                         "load1": load1,
+                         "mem_used": mem_used,
+                         "mem_total": mem_total,
+                     }},
                 )
             except Exception:
                 pass
@@ -850,10 +868,7 @@ class NodeDaemon:
         c = await self._node_conn(node_id)
         return await c.call(method, payload.get("payload"), timeout=10)
 
-    async def handle_list_workers(self, payload, conn):
-        """Worker inventory for the state API and fault-injection
-        harnesses (reference: worker listing via the dashboard state
-        aggregator + `_private/test_utils.py` killer actors)."""
+    def _worker_inventory(self):
         return [
             {
                 "worker_id": w.worker_id,
@@ -865,6 +880,38 @@ class NodeDaemon:
             }
             for w in self.workers.values()
         ]
+
+    async def handle_list_workers(self, payload, conn):
+        """Worker inventory for the state API and fault-injection
+        harnesses (reference: worker listing via the dashboard state
+        aggregator + `_private/test_utils.py` killer actors)."""
+        return self._worker_inventory()
+
+    async def handle_profile_worker(self, payload, conn):
+        """On-demand stack profile of one local worker (reference:
+        `modules/reporter/profile_manager.py:78` py-spy dumps; here a
+        pure-Python all-thread stack dump served by the worker runtime,
+        with py-spy used instead when installed)."""
+        w = self.workers.get(payload["worker_id"])
+        if w is None:
+            return {"error": "no such worker"}
+        import shutil
+
+        if payload.get("native") and shutil.which("py-spy"):
+            proc = await asyncio.create_subprocess_exec(
+                "py-spy", "dump", "--pid", str(w.pid),
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT,
+            )
+            out, _ = await proc.communicate()
+            return {"stacks": out.decode(errors="replace"), "pid": w.pid}
+        if w.conn is None or w.conn.closed:
+            return {"error": "worker not connected"}
+        try:
+            stacks = await w.conn.call("dump_stacks", None, timeout=10)
+        except Exception as e:
+            return {"error": str(e)}
+        return {"stacks": stacks, "pid": w.pid}
 
     async def handle_stream_cancel(self, payload, conn):
         """Abandoned-stream stop signal for a daemon-dispatched task.

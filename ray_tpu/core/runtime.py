@@ -1804,6 +1804,23 @@ class Runtime:
     async def _h_ping(self, payload, conn):
         return "pong"
 
+    async def _h_dump_stacks(self, payload, conn):
+        """All-thread stack dump for the on-demand profiler (reference:
+        py-spy dump via `profile_manager.py:78`; this is the in-process
+        fallback that needs no native tooling)."""
+        import sys as _sys
+        import traceback as _tb
+
+        frames = _sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for tid, frame in frames.items():
+            parts.append(
+                f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                + "".join(_tb.format_stack(frame))
+            )
+        return "\n".join(parts)
+
     async def _h_set_accel_env(self, payload, conn):
         """Daemon push at lease-grant time: accelerator isolation env
         (TPU_VISIBLE_CHIPS et al — `core/accelerators.py`).  Must land

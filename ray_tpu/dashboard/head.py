@@ -111,6 +111,31 @@ class DashboardHead:
                 if raw:
                     jobs.append(json.loads(raw))
             return httpd.json_response(jobs)
+        if path == "/api/workers":
+            snap = await self._ctl("get_worker_snapshot")
+            return httpd.json_response(snap or [])
+        if path == "/api/profile":
+            # on-demand worker stack profile (reference: py-spy via
+            # `modules/reporter/profile_manager.py:78`)
+            node_id = req.query_params.get("node_id")
+            worker_id = req.query_params.get("worker_id")
+            if not node_id or not worker_id:
+                return httpd.json_response(
+                    {"error": "node_id and worker_id query params required"},
+                    status=400,
+                )
+            from ray_tpu.core.runtime import get_runtime
+
+            reply = await get_runtime().noded.call(
+                "route_node",
+                {"node_id": node_id, "method": "profile_worker",
+                 "payload": {
+                     "worker_id": worker_id,
+                     "native": req.query_params.get("native") == "1",
+                 }},
+                timeout=20,
+            )
+            return httpd.json_response(reply)
         if path == "/api/tasks":
             limit = int(req.query_params.get("limit", "100"))
             events = await self._ctl("list_task_events", {"limit": limit})

@@ -85,11 +85,18 @@ class _SplitCoordinator:
         # SYNC methods + threading primitives: methods run in executor
         # threads (max_concurrency sizes the pool), where blocking
         # rt.get/rt.put are safe — an async coordinator would run on the
-        # runtime's io loop and deadlock on them
-        self._cond = threading.Condition()
-        self._lock = threading.Lock()  # serializes generator pulls
+        # runtime's io loop and deadlock on them.  ONE reentrant mutex
+        # backs both the lock and the condition: epoch rollover mutates
+        # several fields, and readers must never observe a half-applied
+        # transition (nor can two lock orders deadlock).
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
         self._queues = [_dq() for _ in range(n)]  # equal-mode shards
         self._carry = None  # remainder rows carried between blocks
+        #: equal-mode backpressure: a shard pulling new blocks waits
+        #: while any sibling's queue is this deep (the reference output
+        #: splitter blocks when a consumer lags)
+        self._max_queued = 16
 
     def start_epoch(self, shard: int, epoch: int) -> bool:
         with self._cond:
@@ -136,6 +143,23 @@ class _SplitCoordinator:
             while not self._queues[shard]:
                 if self._done:
                     return None
+                # soft backpressure: while a lagging sibling's queue is
+                # deep, this shard pauses driving the upstream generator
+                # — but boundedly, so a shard whose consumer drains the
+                # split sequentially (no concurrent siblings) still
+                # progresses instead of deadlocking
+                waited = 0.0
+                while (
+                    any(len(q) >= self._max_queued for q in self._queues)
+                    and waited < 5.0
+                    and not self._done
+                ):
+                    self._cond.wait(timeout=0.5)
+                    waited += 0.5
+                    if epoch != self._epoch:
+                        return None
+                if self._done:
+                    continue  # loop re-checks queue/done
                 try:
                     block_ref, _meta = next(self._gen)
                 except StopIteration:
@@ -161,10 +185,9 @@ class _SplitCoordinator:
                 if rem:
                     self._carry = B.slice_block(blk, rows - rem, rows)
             out = self._queues[shard].popleft()
-            if self._done and not self._queues[shard]:
-                # epoch-restart waiters key on done AND drained queues
-                with self._cond:
-                    self._cond.notify_all()
+            # wake backpressured pullers and epoch-restart waiters (the
+            # condition shares this lock, so this is race-free here)
+            self._cond.notify_all()
             return out
 
     def _mark_done(self):

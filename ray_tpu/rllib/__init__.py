@@ -7,7 +7,7 @@ host-collective gradient allreduce (the CPU-fleet path).  PPO is the
 first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
-from ray_tpu.rllib.algorithms import APPO, BC, DQN, PPO, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, DQNConfig, PPOConfig
+from ray_tpu.rllib.algorithms import APPO, BC, DQN, IMPALA, PPO, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, DQNConfig, IMPALAConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
@@ -26,6 +26,10 @@ __all__ = [
     "CartPoleVectorEnv",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "EnvRunner",
     "EnvRunnerGroup",
     "Learner",

@@ -66,6 +66,86 @@ class EnvRunnerGroup:
             raise RuntimeError("all env runners failed")
         return out
 
+    # -- async sampling (the IMPALA shape) -----------------------------
+    def start_async_sampling(self, module_def, *, inflight_per_runner: int = 2,
+                             explore=None):
+        """Keep every runner busy with up to `inflight_per_runner`
+        outstanding sample() calls (reference: IMPALA's async request
+        manager, `impala.py` AsyncRequestsManager)."""
+        self._async_module = module_def
+        self._async_explore = explore
+        self._async_inflight = inflight_per_runner
+        self._pending: Dict[Any, int] = {}
+        self._inflight_count = [0] * self._num_runners
+        for i in range(self._num_runners):
+            for _ in range(inflight_per_runner):
+                self._submit_async(i)
+
+    def _submit_async(self, idx: int):
+        ref = self._runners[idx].sample.remote(
+            self._async_module, self._async_explore
+        )
+        self._pending[ref] = idx
+        self._inflight_count[idx] += 1
+
+    def get_ready_samples(self, max_batches: int = 4,
+                          timeout: Optional[float] = 120.0
+                          ) -> List[Dict[str, np.ndarray]]:
+        """Collect completed rollouts (blocking for at least one) and
+        immediately re-dispatch their runners — the learner never waits
+        for the slowest runner (the async architecture IMPALA exists
+        for).  Dead runners are replaced in place."""
+        assert self._pending, "call start_async_sampling first"
+        out: List[Dict[str, np.ndarray]] = []
+        # block for ONE rollout, then sweep whatever else is already
+        # done — never a barrier on the slowest runner (that barrier is
+        # exactly what IMPALA's async architecture removes)
+        ready, rest = rt.wait(
+            list(self._pending), num_returns=1, timeout=timeout
+        )
+        if rest and max_batches > 1:
+            more, _ = rt.wait(
+                rest,
+                num_returns=min(max_batches - 1, len(rest)),
+                timeout=0,
+            )
+            ready = list(ready) + list(more)
+        for ref in ready:
+            idx = self._pending.pop(ref, None)
+            if idx is None:
+                # its runner was replaced earlier in this loop (its
+                # other in-flight refs were dropped with it)
+                continue
+            self._inflight_count[idx] -= 1
+            try:
+                out.append(rt.get(ref))
+            except Exception:
+                self._replace_runner(idx)
+            self._submit_async(idx)
+        return out
+
+    def _replace_runner(self, idx: int):
+        # drop the dead runner's other pending refs so they don't
+        # resubmit onto the replacement twice
+        for ref, i in list(self._pending.items()):
+            if i == idx:
+                del self._pending[ref]
+        self._inflight_count[idx] = 0
+        self._runners[idx] = self._make_runner(idx)
+        rt.get(self._runners[idx].set_weights.remote(
+            self._weights, self._weights_version))
+        while self._inflight_count[idx] < self._async_inflight - 1:
+            self._submit_async(idx)
+
+    def sync_weights_async(self, params_np: Any):
+        """Non-blocking weight broadcast: runners adopt the new weights
+        for their NEXT rollout; in-flight rollouts stay stale (V-trace
+        corrects them)."""
+        self._weights = params_np
+        self._weights_version += 1
+        for r in self._runners:
+            r.set_weights.remote(params_np, self._weights_version)
+
     def pop_metrics(self) -> List[Dict[str, float]]:
         metrics: List[Dict[str, float]] = []
         refs = [r.pop_metrics.remote() for r in self._runners]

@@ -46,6 +46,15 @@ def list_workers() -> List[Dict[str, Any]]:
     """Pool workers across alive nodes (reference: `ray list workers`):
     id, pid, kind, hosted actor, idleness, node."""
     rt = get_runtime()
+    # fast path: the per-node reporter pushes worker inventories to the
+    # controller every second — one RPC, no per-node fan-out (reference:
+    # reporter agents feeding the state aggregator)
+    try:
+        snap = rt.controller_call("get_worker_snapshot", timeout=10)
+        if snap is not None:
+            return snap
+    except Exception:
+        pass
     out: List[Dict[str, Any]] = []
     for n in rt.controller_call("get_nodes") or []:
         if not n.get("alive"):

@@ -146,3 +146,45 @@ def test_logs_endpoint(dash):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_worker_snapshot_and_profile(dash):
+    """Per-node reporter cache + on-demand stack profiling (reference:
+    dashboard agent reporter + profile_manager.py:78)."""
+    # reporter pushes every ~1s; wait for the snapshot to warm
+    deadline = time.time() + 10
+    workers = []
+    while time.time() < deadline:
+        status, body = _get(dash + "/api/workers")
+        workers = json.loads(body)
+        if workers:
+            break
+        time.sleep(0.5)
+    assert workers, "reporter snapshot never arrived"
+    w = workers[0]
+    assert {"worker_id", "pid", "node_id", "kind"} <= set(w)
+
+    status, body = _get(
+        dash + f"/api/profile?node_id={w['node_id']}"
+        f"&worker_id={w['worker_id']}"
+    )
+    prof = json.loads(body)
+    assert status == 200 and "stacks" in prof, prof
+    assert "thread" in prof["stacks"]
+    assert prof["pid"] == w["pid"]
+
+
+def test_state_list_workers_uses_snapshot(dash):
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.util import state
+
+    deadline = time.time() + 10
+    snap = None
+    while time.time() < deadline:
+        snap = get_runtime().controller_call("get_worker_snapshot")
+        if snap:
+            break
+        time.sleep(0.5)
+    assert snap, "controller never cached a worker snapshot"
+    listed = state.list_workers()
+    assert len(listed) >= len([w for w in snap if w["kind"] == "worker"])
