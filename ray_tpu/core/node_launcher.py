@@ -29,7 +29,9 @@ def launch_noded(
     labels: Optional[Dict[str, str]] = None,
     num_workers: int = 0,
     env_extra: Optional[Dict[str, str]] = None,
-    timeout: float = 60.0,
+    # generous: a loaded single-core CI box can take >60s to fork+import
+    # a daemon while a full test suite runs
+    timeout: float = 150.0,
 ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
     """Returns (process, ready-file contents)."""
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)

@@ -59,6 +59,10 @@ class WorkerState:
     proc: Optional[subprocess.Popen] = None
     busy_since: Optional[float] = None  # OOM victim ordering (LIFO)
     oom_killed_at: Optional[float] = None  # SIGKILL sent; awaiting reap
+    # runtime-env dedication: once a worker applies an env it serves
+    # ONLY that env hash (reference: worker-pool runtime-env matching);
+    # clean tasks never run on a tainted worker
+    env_hash: Optional[str] = None
 
     @property
     def idle(self):
@@ -383,7 +387,8 @@ class NodeDaemon:
 
     def _find_worker_for(self, spec: TaskSpec) -> Optional[WorkerState]:
         demand = spec.resources.as_dict()
-        # 1) pipeline onto a worker already leased with identical demand
+        # 1) pipeline onto a worker already leased with identical
+        # demand AND runtime env
         for w in self.workers.values():
             if (
                 w.kind == "worker"
@@ -391,22 +396,26 @@ class NodeDaemon:
                 and w.leased_to is None
                 and w.lease is not None
                 and w.lease == demand
+                and w.env_hash == spec.env_hash
                 and len(w.in_flight) < _PIPELINE_DEPTH
             ):
                 return w
-        # 2) idle worker + available resources (chip-pinning aware)
+        # 2) idle worker + available resources (chip/env-pinning aware)
         if _fits(demand, self.available):
             tpu_n = self._tpu_chips_needed(demand)
-            w = self._pick_idle_worker(tpu_n, require_no_lease=True)
+            w = self._pick_idle_worker(
+                tpu_n, require_no_lease=True, env_hash=spec.env_hash
+            )
             if w is None:
-                if tpu_n:
-                    # every idle worker may be pinned to the wrong chip
-                    # count; retire one so the queued task can't starve
-                    self._reclaim_idle_pinned(tpu_n)
+                # idle workers may be pinned to the wrong chip count or
+                # env; retire one so the queued task can't starve
+                self._reclaim_idle_pinned(tpu_n, spec.env_hash)
                 return None
             if tpu_n and not self._assign_chips(w, tpu_n):
-                self._reclaim_idle_pinned(tpu_n)
+                self._reclaim_idle_pinned(tpu_n, spec.env_hash)
                 return None
+            if spec.env_hash is not None:
+                w.env_hash = spec.env_hash
             return w
         return None
 
@@ -721,41 +730,67 @@ class NodeDaemon:
         return True
 
     def _pick_idle_worker(
-        self, tpu_n: int, require_no_lease: bool = False
+        self, tpu_n: int, require_no_lease: bool = False,
+        env_hash: Optional[str] = None,
     ) -> Optional[WorkerState]:
-        """Idle-worker choice, chip-pinning aware: an n-chip demand
-        prefers a worker already pinned to n chips (its runtime is
-        initialized against them), then an unpinned one; CPU demands
-        prefer unpinned workers so pinned ones stay free for TPU work."""
+        """Idle-worker choice, chip- and env-pinning aware: an n-chip
+        demand prefers a worker already pinned to n chips (its runtime
+        is initialized against them), then an unpinned one.  Env
+        matching is STRICT: a tainted worker serves only its own env
+        hash, a clean demand only clean workers — a demand with an env
+        may also take a clean worker (which becomes dedicated)."""
         pinned_match = unpinned = any_idle = None
         for w in self.workers.values():
             if not (w.kind == "worker" and w.idle and w.conn and w.socket_path):
                 continue
             if require_no_lease and w.lease is not None:
                 continue
-            any_idle = any_idle or w
+            if w.env_hash is not None and w.env_hash != env_hash:
+                continue  # tainted with a different env: never reuse
+            # env_ready: this worker already applied the demanded env
+            # (a clean worker serving an env demand is acceptable but a
+            # same-env worker is better); for clean demands both are
+            # equal (only clean workers reach here)
+            env_ready = w.env_hash == env_hash
             held = (
                 self._chip_pool.pinned(w.worker_id)
                 if self._chip_pool is not None
                 else None
             )
-            if held is None:
+            if held is None and env_ready:
                 unpinned = unpinned or w
-            elif tpu_n and len(held) == tpu_n:
+            elif tpu_n and held is not None and len(held) == tpu_n:
                 pinned_match = pinned_match or w
+            else:
+                # chip-pinned worker for a CPU demand, or a clean
+                # worker for an env demand: usable fallback
+                any_idle = any_idle or w
         if tpu_n:
-            return pinned_match or unpinned
+            return pinned_match or unpinned or any_idle
         return unpinned or any_idle
 
-    def _reclaim_idle_pinned(self, tpu_n: int) -> None:
-        """Chip fragmentation: every free chip is pinned to an idle
-        worker of the wrong shape.  Retire one such worker (its death
-        releases the chips and respawns a fresh process)."""
-        if self._chip_pool is None or self._chip_pool.free_count >= tpu_n:
-            return
+    def _reclaim_idle_pinned(self, tpu_n: int,
+                             env_hash: Optional[str] = None) -> None:
+        """Pinning fragmentation: the demand can't be served because
+        idle workers are pinned to the wrong chip shape or dedicated to
+        a different runtime env.  Retire one such worker (its death
+        releases chips, frees a pool slot, and respawns clean)."""
+        chips_short = (
+            tpu_n and self._chip_pool is not None
+            and self._chip_pool.free_count < tpu_n
+        )
         for w in self.workers.values():
-            held = self._chip_pool.pinned(w.worker_id)
-            if w.kind == "worker" and w.idle and held and len(held) != tpu_n:
+            if not (w.kind == "worker" and w.idle):
+                continue
+            held = (
+                self._chip_pool.pinned(w.worker_id)
+                if self._chip_pool is not None else None
+            )
+            chip_mismatch = chips_short and held and len(held) != tpu_n
+            env_mismatch = (
+                w.env_hash is not None and w.env_hash != env_hash
+            )
+            if chip_mismatch or env_mismatch:
                 try:
                     os.kill(w.pid, signal.SIGKILL)
                 except Exception:
@@ -776,7 +811,8 @@ class NodeDaemon:
         if not _fits(demand, self.available):
             return None
         tpu_n = self._tpu_chips_needed(demand)
-        w = self._pick_idle_worker(tpu_n)
+        env_hash = payload.get("env_hash")
+        w = self._pick_idle_worker(tpu_n, env_hash=env_hash)
         if w is not None:
             # reserve BEFORE any await: a concurrent lease request must
             # see these resources as taken or the node oversubscribes
@@ -795,12 +831,15 @@ class NodeDaemon:
                     self.available[k] = self.available.get(k, 0.0) + v
                 w = None
         if w is not None:
+            if env_hash is not None:
+                # dedicate only on a SUCCESSFUL grant: a worker must
+                # never be marked with an env it never applied
+                w.env_hash = env_hash
             w.lease = dict(demand)
             w.leased_to = holder
             w.busy_since = time.time()
             return (w.worker_id, w.socket_path)
-        if tpu_n:
-            self._reclaim_idle_pinned(tpu_n)
+        self._reclaim_idle_pinned(tpu_n, env_hash)
         if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
             self._spawn_worker()
         return None
@@ -912,6 +951,40 @@ class NodeDaemon:
         except Exception as e:
             return {"error": str(e)}
         return {"stacks": stacks, "pid": w.pid}
+
+    async def handle_force_cancel_task(self, payload, conn):
+        """Force-cancel: SIGKILL the worker running the task (reference:
+        CancelTask force_kill).  The task's owner sees worker_died ->
+        WorkerCrashedError.  Daemon-routed tasks may run anywhere:
+        search locally, then forward one hop cluster-wide."""
+        tid = payload["task_id"]
+        for w in list(self.workers.values()):
+            if tid in w.in_flight:
+                try:
+                    os.kill(w.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                return {"killed": True}
+        if payload.get("forwarded"):
+            return {"killed": False}
+        try:
+            nodes = await self.controller_conn.call("get_nodes", None)
+        except Exception:
+            return {"killed": False}
+        for n in nodes or []:
+            if not n.get("alive") or n["node_id"] == self.node_id:
+                continue
+            try:
+                c = await self._node_conn(n["node_id"])
+                reply = await c.call(
+                    "force_cancel_task",
+                    {"task_id": tid, "forwarded": True}, timeout=10,
+                )
+                if reply and reply.get("killed"):
+                    return {"killed": True}
+            except Exception:
+                pass
+        return {"killed": False}
 
     async def handle_stream_cancel(self, payload, conn):
         """Abandoned-stream stop signal for a daemon-dispatched task.
@@ -1310,10 +1383,15 @@ class NodeDaemon:
         for k, v in demand.items():
             self.available[k] = self.available.get(k, 0.0) - v
         tpu_n = self._tpu_chips_needed(demand)
+        from ray_tpu.core.runtime_env import runtime_env_hash as _reh
+
+        actor_env_hash = _reh(aspec.runtime_env)
         target = None
         deadline = time.monotonic() + 60
         while target is None:
-            target = self._pick_idle_worker(tpu_n, require_no_lease=True)
+            target = self._pick_idle_worker(
+                tpu_n, require_no_lease=True, env_hash=actor_env_hash
+            )
             if target is not None and tpu_n and not self._assign_chips(
                 target, tpu_n
             ):
@@ -1327,6 +1405,10 @@ class NodeDaemon:
                 if self._pending_spawns == 0:
                     self._spawn_worker()
                 await asyncio.sleep(0.02)
+        if actor_env_hash is not None:
+            # even if __init__ fails and the worker returns to the
+            # pool, its process already applied this env: tainted
+            target.env_hash = actor_env_hash
         target.actor_id = aspec.actor_id.binary()
         target.lease = demand
         try:

@@ -164,7 +164,8 @@ class _LeasePool:
     (reference: one lease request pipeline per SchedulingKey,
     `normal_task_submitter.h`)."""
 
-    __slots__ = ("sig", "demand", "leases", "queue", "requesting")
+    __slots__ = ("sig", "demand", "leases", "queue", "requesting",
+                 "env_hash")
 
     def __init__(self, sig, demand):
         self.sig = sig
@@ -172,6 +173,7 @@ class _LeasePool:
         self.leases: Dict[str, _Lease] = {}
         self.queue: deque = deque()
         self.requesting = False
+        self.env_hash: Optional[str] = None  # runtime-env dedication
 
 
 class Runtime:
@@ -238,6 +240,10 @@ class Runtime:
         self._lease_timers: set = set()  # pending keep-alive returns
         # container object id -> borrows/pins it holds on inner refs
         self._contained_in: Dict[bytes, list] = {}
+        # executing normal tasks: task_id -> thread ident (cancellation)
+        self._task_threads: Dict[bytes, int] = {}
+        # runtime-env dedication (worker mode): hash applied, if any
+        self._applied_env_hash: Optional[str] = None
         self._shutdown = False
         from ray_tpu.core.task_events import TaskEventBuffer
 
@@ -358,21 +364,28 @@ class Runtime:
     # cancellation wrapper `_raylet.pyx:2055`)
     # ------------------------------------------------------------------
     def cancel(self, ref: ObjectRef, force: bool = False):
-        """Cancel the task that creates `ref`.  Queued tasks are
-        dropped and their returns fail with TaskCancelledError; tasks
-        already pushed to a worker are cancelled only if they have not
-        started executing (a running Python task cannot be safely
-        interrupted — same limitation as the reference without force)."""
-        if force:
-            raise NotImplementedError(
-                "force=True (kill the executing worker) is not implemented; "
-                "non-force cancellation covers queued/not-started tasks"
-            )
+        """Cancel the task that creates `ref` (reference: CancelTask +
+        the Cython cancellation wrapper, `_raylet.pyx:2055`).
+
+        Non-force: queued tasks are dropped; pushed-but-unstarted tasks
+        are skipped by the executor; RUNNING normal tasks get
+        TaskCancelledError raised asynchronously in their executing
+        thread (lands at the next Python bytecode boundary — C-blocking
+        calls finish first, same caveat as the reference's
+        KeyboardInterrupt delivery).  force=True SIGKILLs the executing
+        worker: the ref then fails with WorkerCrashedError, matching
+        reference semantics; actor tasks reject force (killing the
+        worker is `rt.kill(actor)`)."""
         task_id = ref.id.task_id().binary()
         with self._state_lock:
             pt = self.pending_tasks.get(task_id)
             if pt is None:
                 return False  # finished or never ours
+            if force and pt.spec.actor_id is not None:
+                raise ValueError(
+                    "force=True is not allowed for actor tasks; use "
+                    "rt.kill(actor) to terminate the actor process"
+                )
             pt.retries_left = 0  # a cancelled task never retries
             spec = pt.spec
             # 1. still in a local lease-pool queue: drop it here
@@ -392,20 +405,45 @@ class Runtime:
         # asynchronously (best-effort, like the reference): the caller
         # must not block while an actor connection establishes
         asyncio.run_coroutine_threadsafe(
-            self._cancel_remote(task_id, spec), self.loop
+            self._cancel_remote(task_id, spec, force), self.loop
         )
         return True
 
-    async def _cancel_remote(self, task_id: bytes, spec: TaskSpec):
+    async def _cancel_remote(self, task_id: bytes, spec: TaskSpec,
+                             force: bool = False):
         with self._state_lock:
             conns = []
+            lease_worker = None
             for pool, lease in self._conn_lease.values():
                 if task_id in lease.assigned:
                     conns.append(lease.conn)
+                    lease_worker = lease.worker_id
             if spec.actor_id is not None:
                 c = self._actor_conns.get(spec.actor_id.binary())
                 if c is not None:
                     conns.append(c)
+        if force:
+            # reference force-cancel: kill the executing worker; the
+            # pending task fails with worker_died -> WorkerCrashedError
+            try:
+                if lease_worker is not None:
+                    await self.noded.call(
+                        "kill_worker", {"worker_id": lease_worker},
+                        timeout=10,
+                    )
+                    return
+                # routed through a daemon (spillback/strategy): the
+                # daemons find and kill the hosting worker
+                reply = await self.noded.call(
+                    "force_cancel_task", {"task_id": task_id},
+                    timeout=10,
+                )
+                if reply and reply.get("killed"):
+                    return
+                # nobody is RUNNING it: it may still sit in a daemon
+                # queue — fall through to the drop path below
+            except Exception:
+                return
         if spec.actor_id is not None and not conns:
             # connection still being established: wait briefly so the
             # cancel can land on the executor before the task starts
@@ -441,12 +479,36 @@ class Runtime:
         ))
 
     async def _h_cancel_task(self, payload, conn):
-        """Executor side: drop the task if it has not started."""
+        """Executor side: drop the task if it has not started; if it IS
+        running (normal tasks only), raise TaskCancelledError in its
+        executing thread (reference: the Cython wrapper delivering
+        KeyboardInterrupt into the running task, `_raylet.pyx:2055`).
+        The exception lands at the next bytecode boundary."""
         task_id = payload["task_id"]
         started = getattr(self, "_started_tasks", None)
         if started is None:
             started = self._started_tasks = set()
         if task_id in started:
+            # check-and-raise under _state_lock: _call registers/pops
+            # its thread ident under the same lock, so the ident cannot
+            # be recycled onto a DIFFERENT task between our lookup and
+            # the raise (the pending exception lands while the victim
+            # thread is still inside its own _call frame)
+            import ctypes
+
+            with self._state_lock:
+                tid = self._task_threads.get(task_id)
+                if tid is not None:
+                    n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(tid),
+                        ctypes.py_object(exc.TaskCancelledError),
+                    )
+                    if n == 1:
+                        return {"cancelled": True, "interrupted": True}
+                    if n > 1:  # raced a thread swap: undo, never poison
+                        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                            ctypes.c_ulong(tid), None
+                        )
             return {"cancelled": False}  # already executing
         cancelled = self._cancelled_tasks = getattr(
             self, "_cancelled_tasks", set()
@@ -548,11 +610,15 @@ class Runtime:
     # normal task submission — thread-side fast path
     # ------------------------------------------------------------------
     def submit_task(self, fn, args, kwargs, **options) -> List[ObjectRef]:
-        if options.get("runtime_env"):
-            raise NotImplementedError(
-                "runtime_env is supported for actors only (they own their "
-                "worker process); pooled task workers are shared"
-            )
+        renv = options.get("runtime_env")
+        env_hash = None
+        if renv:
+            # tasks with a runtime env run on DEDICATED workers keyed
+            # by env hash (reference: worker-pool runtime-env matching)
+            from ray_tpu.core.runtime_env import runtime_env_hash
+
+            renv = self._run(self._prepare_runtime_env(dict(renv)))
+            env_hash = runtime_env_hash(renv)
         fid, blob = self._export_function(fn)
         task_id = TaskID.for_job(self.job_id)
         num_returns = options.get("num_returns", 1)
@@ -572,6 +638,8 @@ class Runtime:
             retry_exceptions=options.get("retry_exceptions", False),
             strategy=_strategy_from_options(options),
             name=options.get("name", getattr(fn, "__name__", "task")),
+            runtime_env=renv,
+            env_hash=env_hash,
         )
         from ray_tpu.util import tracing as _tracing
 
@@ -697,10 +765,11 @@ class Runtime:
 
     def _pool_for(self, spec: TaskSpec) -> _LeasePool:
         demand = spec.resources.as_dict()
-        sig = tuple(sorted(demand.items()))
+        sig = (tuple(sorted(demand.items())), spec.env_hash)
         pool = self._pools.get(sig)
         if pool is None:
             pool = self._pools[sig] = _LeasePool(sig, demand)
+            pool.env_hash = spec.env_hash
         return pool
 
     def _push_or_queue(self, spec: TaskSpec):
@@ -759,7 +828,10 @@ class Runtime:
                         return
                 try:
                     reply = await self.noded.call(
-                        "request_lease", {"resources": pool.demand}, timeout=60
+                        "request_lease",
+                        {"resources": pool.demand,
+                         "env_hash": pool.env_hash},
+                        timeout=60,
                     )
                 except Exception:
                     await asyncio.sleep(0.1)
@@ -832,49 +904,55 @@ class Runtime:
     def create_actor(self, cls, args, kwargs, **options):
         return self._run(self._create_actor(cls, args, kwargs, options))
 
+    async def _prepare_runtime_env(self, renv):
+        """Driver-side prep shared by actors AND tasks: package local
+        py_modules, ship once via KV; the spec carries only (name, key)
+        pairs (reference: runtime_env packaging uploads to the GCS,
+        `runtime_env/packaging.py`)."""
+        if not (renv and renv.get("py_modules")):
+            return renv
+        from ray_tpu.core.runtime_env import (
+            _module_root,
+            module_stat_sig,
+            package_py_modules,
+        )
+
+        uploaded = getattr(self, "_pymod_uploaded", None)
+        if uploaded is None:
+            uploaded = self._pymod_uploaded = set()
+        pkg_cache = getattr(self, "_pymod_pkg_cache", None)
+        if pkg_cache is None:
+            pkg_cache = self._pymod_pkg_cache = {}
+        entries = []
+        for mod in renv["py_modules"]:
+            # repeat creations (actor fleets) skip BOTH the re-zip
+            # and the re-upload: a stat-walk signature detects
+            # unchanged trees far cheaper than deflate
+            root = _module_root(mod)
+            sig = module_stat_sig(root)
+            cached = pkg_cache.get(root)
+            if cached is not None and cached[0] == sig:
+                entries.append((cached[1], cached[2]))
+                continue
+            [(name, key, pkg_blob)] = package_py_modules([root])
+            if key not in uploaded and not await self.controller.call(
+                "kv_exists", {"key": key}
+            ):
+                await self.controller.call(
+                    "kv_put", {"key": key, "value": pkg_blob}
+                )
+            uploaded.add(key)
+            pkg_cache[root] = (sig, name, key)
+            entries.append((name, key))
+        renv = dict(renv)
+        renv["py_modules"] = entries
+        return renv
+
     async def _create_actor(self, cls, args, kwargs, options):
         renv = options.get("runtime_env")
         if renv and renv.get("py_modules"):
-            # package locally, ship once via KV; the spec carries only
-            # (name, key) pairs (reference: runtime_env packaging
-            # uploads to the GCS, `runtime_env/packaging.py`)
-            from ray_tpu.core.runtime_env import (
-                _module_root,
-                module_stat_sig,
-                package_py_modules,
-            )
-
-            uploaded = getattr(self, "_pymod_uploaded", None)
-            if uploaded is None:
-                uploaded = self._pymod_uploaded = set()
-            pkg_cache = getattr(self, "_pymod_pkg_cache", None)
-            if pkg_cache is None:
-                pkg_cache = self._pymod_pkg_cache = {}
-            entries = []
-            for mod in renv["py_modules"]:
-                # repeat creations (actor fleets) skip BOTH the re-zip
-                # and the re-upload: a stat-walk signature detects
-                # unchanged trees far cheaper than deflate
-                root = _module_root(mod)
-                sig = module_stat_sig(root)
-                cached = pkg_cache.get(root)
-                if cached is not None and cached[0] == sig:
-                    entries.append((cached[1], cached[2]))
-                    continue
-                [(name, key, pkg_blob)] = package_py_modules([root])
-                if key not in uploaded and not await self.controller.call(
-                    "kv_exists", {"key": key}
-                ):
-                    await self.controller.call(
-                        "kv_put", {"key": key, "value": pkg_blob}
-                    )
-                uploaded.add(key)
-                pkg_cache[root] = (sig, name, key)
-                entries.append((name, key))
-            renv = dict(renv)
-            renv["py_modules"] = entries
             options = dict(options)
-            options["runtime_env"] = renv
+            options["runtime_env"] = await self._prepare_runtime_env(renv)
         blob = ser.dumps_oob(cls)
         cid = function_id_of(blob)
         actor_id = ActorID.of(self.job_id)
@@ -1855,39 +1933,12 @@ class Runtime:
 
     async def _h_create_actor_instance(self, aspec: ActorCreationSpec, conn):
         if aspec.runtime_env:
-            renv = aspec.runtime_env
-            os.environ.update(renv.get("env_vars", {}))
-            wd = renv.get("working_dir")
-            if wd:
-                os.makedirs(wd, exist_ok=True)
-                os.chdir(wd)
-                import sys as _sys
+            # plugin-ordered application (env_vars, working_dir,
+            # py_modules, pip, custom) BEFORE the class blob
+            # deserializes — the pickle may import shipped modules
+            from ray_tpu.core.runtime_env import apply_runtime_env
 
-                if wd not in _sys.path:
-                    _sys.path.insert(0, wd)
-            for _name, key in renv.get("py_modules", ()):
-                # extract BEFORE the class blob deserializes (the pickle
-                # may import this module); the KV fetch is skipped when
-                # the content-addressed cache dir already exists locally
-                from ray_tpu.core.runtime_env import (
-                    materialize_py_module,
-                    py_module_cache_dir,
-                )
-
-                dest = py_module_cache_dir(key)
-                if not os.path.isdir(dest):
-                    pkg_blob = await self.controller.call(
-                        "kv_get", {"key": key}
-                    )
-                    if pkg_blob is None:
-                        raise exc.RayTpuError(
-                            f"py_module package {key} missing from KV"
-                        )
-                    dest = materialize_py_module(key, pkg_blob)
-                import sys as _sys
-
-                if dest not in _sys.path:
-                    _sys.path.insert(0, dest)
+            await apply_runtime_env(aspec.runtime_env, self)
         cls = ser.loads(aspec.class_blob)
         self.actor_id = aspec.actor_id
         self._actor_aspec = aspec
@@ -1994,6 +2045,19 @@ class Runtime:
             node_id=self.node_id, worker_id=self.worker_id.hex(),
         )
         try:
+            if spec.runtime_env:
+                # applied once; the daemon dedicates this worker to the
+                # env hash so a mismatch means a scheduling bug
+                if self._applied_env_hash is None:
+                    from ray_tpu.core.runtime_env import apply_runtime_env
+
+                    await apply_runtime_env(spec.runtime_env, self)
+                    self._applied_env_hash = spec.env_hash
+                elif self._applied_env_hash != spec.env_hash:
+                    raise exc.RayTpuError(
+                        "worker already dedicated to a different "
+                        "runtime_env (scheduling bug)"
+                    )
             fn = await self._load_function(spec)
             args = [await self._materialize_arg(a) for a in spec.args]
             kwargs = {
@@ -2037,8 +2101,19 @@ class Runtime:
 
                 def _call():
                     self._task_local.task_id = spec.task_id
-                    with _tracing.execution_span(spec.name, trace_ctx):
-                        return fn(*args, **kwargs)
+                    # registered for mid-execution cancellation
+                    # (_h_cancel_task async-raises into this thread);
+                    # register/pop under _state_lock so a cancel can
+                    # never target a recycled pool thread running a
+                    # different task
+                    with self._state_lock:
+                        self._task_threads[tid] = threading.get_ident()
+                    try:
+                        with _tracing.execution_span(spec.name, trace_ctx):
+                            return fn(*args, **kwargs)
+                    finally:
+                        with self._state_lock:
+                            self._task_threads.pop(tid, None)
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
             if spec.is_streaming:
@@ -2065,10 +2140,15 @@ class Runtime:
                 )
         except Exception as e:  # noqa: BLE001 - user exception boundary
             tb = traceback.format_exc()
-            envelope = ser.serialize_to_bytes(
-                exc.TaskError(str(e), remote_traceback=tb, cause_type=type(e).__name__),
-                tag=ser.TAG_ERROR,
-            )
+            if isinstance(e, exc.TaskCancelledError):
+                # preserve the type: callers match on TaskCancelledError
+                # (the async-raised mid-execution interrupt lands here)
+                err: Exception = exc.TaskCancelledError(task_id=spec.task_id)
+            else:
+                err = exc.TaskError(
+                    str(e), remote_traceback=tb, cause_type=type(e).__name__
+                )
+            envelope = ser.serialize_to_bytes(err, tag=ser.TAG_ERROR)
             result = TaskResult(task_id=spec.task_id, status="error", error=envelope)
         self._started_tasks.discard(tid)
         try:

@@ -1,24 +1,36 @@
-"""Runtime-environment materialization helpers.
+"""Runtime-environment materialization: plugins, pip, py_modules.
 
-Reference: `python/ray/_private/runtime_env/` — per-actor environments
-shipped from the driver and materialized on the executing worker.
-Supported here: `env_vars`, `working_dir`, and `py_modules` (this
-module): local packages/files are zipped on the driver, stored once in
-the controller KV under their content hash (the reference uploads
-packages to the GCS the same way, `runtime_env/packaging.py`), and
-extracted into a content-addressed cache on the worker before the
-actor's class deserializes — so by-value pickles that import the
-module resolve even on hosts that never saw the driver's filesystem
-layout.
+Reference: `python/ray/_private/runtime_env/` — per-task/actor
+environments shipped from the driver and materialized on the executing
+worker, extensible through a plugin protocol
+(`runtime_env/plugin.py`).  Sections supported by built-in plugins:
+
+- ``env_vars``: plain environment variables,
+- ``working_dir``: chdir + sys.path root,
+- ``py_modules``: local packages zipped on the driver, stored once in
+  the controller KV under their content hash (reference:
+  `runtime_env/packaging.py`), extracted into a content-addressed
+  cache on the worker,
+- ``pip``: requirements installed into a content-addressed target
+  directory (``pip install --target``) prepended to sys.path —
+  the reference's pip plugin shape (`runtime_env/pip.py`) without
+  per-env virtualenvs.
+
+Custom sections: subclass :class:`RuntimeEnvPlugin` and call
+:func:`register_runtime_env_plugin` — `apply_runtime_env` runs plugins
+in priority order on the worker.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
+import subprocess
+import sys
 import zipfile
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _CACHE_ROOT = os.path.join(
     os.environ.get("RT_TMPDIR", "/tmp/ray_tpu"), "py_modules_cache"
@@ -104,6 +116,185 @@ def module_stat_sig(root: str) -> str:
         st = os.stat(root)
         h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
     return h.hexdigest()
+
+
+def runtime_env_hash(renv: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Stable identity of a runtime env: workers are dedicated per env
+    hash (reference: worker pools keyed by runtime-env hash,
+    `worker_pool.h` runtime_env_hash matching)."""
+    if not renv:
+        return None
+    try:
+        blob = json.dumps(renv, sort_keys=True, default=str)
+    except TypeError:
+        blob = repr(sorted(renv.items()))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ----------------------------------------------------------------------
+# plugin protocol (reference: `runtime_env/plugin.py` RuntimeEnvPlugin)
+# ----------------------------------------------------------------------
+class RuntimeEnvPlugin:
+    """One runtime-env section.  `name` is the dict key the plugin
+    owns; `setup` runs on the worker BEFORE user code deserializes,
+    lowest `priority` first."""
+
+    name: str = ""
+    priority: int = 10
+
+    async def setup(self, value: Any, runtime: Any) -> None:
+        raise NotImplementedError
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_runtime_env_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a non-empty name")
+    _PLUGINS[plugin.name] = plugin
+
+
+def unregister_runtime_env_plugin(name: str) -> None:
+    _PLUGINS.pop(name, None)
+
+
+class _EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    async def setup(self, value, runtime):
+        os.environ.update(value or {})
+
+
+class _WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 1
+
+    async def setup(self, value, runtime):
+        if not value:
+            return
+        os.makedirs(value, exist_ok=True)
+        os.chdir(value)
+        if value not in sys.path:
+            sys.path.insert(0, value)
+
+
+class _PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 2
+
+    async def setup(self, value, runtime):
+        for _name, key in value or ():
+            dest = py_module_cache_dir(key)
+            if not os.path.isdir(dest):
+                pkg_blob = await runtime.controller.call(
+                    "kv_get", {"key": key}
+                )
+                if pkg_blob is None:
+                    raise RuntimeError(
+                        f"py_module package {key} missing from KV"
+                    )
+                dest = materialize_py_module(key, pkg_blob)
+            if dest not in sys.path:
+                sys.path.insert(0, dest)
+
+
+def pip_cache_dir(packages: Sequence[str]) -> str:
+    h = hashlib.sha256(
+        ";".join(sorted(packages)).encode()
+    ).hexdigest()[:32]
+    return os.path.join(
+        os.environ.get("RT_TMPDIR", "/tmp/ray_tpu"), "pip_cache", h
+    )
+
+
+class _PipPlugin(RuntimeEnvPlugin):
+    """`{"pip": [reqs...]}` or `{"pip": {"packages": [...],
+    "pip_install_options": [...]}}` — installs into a content-addressed
+    `--target` dir prepended to sys.path (reference shape:
+    `runtime_env/pip.py`; shared site-packages instead of a venv per
+    env).  Idempotent across workers via a done-marker."""
+
+    name = "pip"
+    priority = 3
+
+    async def setup(self, value, runtime):
+        if not value:
+            return
+        if isinstance(value, dict):
+            packages = list(value.get("packages", []))
+            options = list(value.get("pip_install_options", []))
+        else:
+            packages = list(value)
+            options = []
+        if not packages:
+            return
+        target = pip_cache_dir(packages + options)
+        marker = os.path.join(target, ".rt_pip_done")
+        if not os.path.exists(marker):
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._install_locked, target, marker, packages,
+                options,
+            )
+        if target not in sys.path:
+            sys.path.insert(0, target)
+
+    @staticmethod
+    def _install_locked(target, marker, packages, options):
+        """Cross-process flock: workers dedicated to the same env on one
+        host must not race concurrent `pip install --target` into the
+        shared cache dir."""
+        import fcntl
+
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        with open(target + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(marker):
+                    return  # a peer installed while we waited
+                cmd = [
+                    sys.executable, "-m", "pip", "install",
+                    "--target", target, "--no-warn-script-location",
+                    *options, *packages,
+                ]
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=600
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"pip runtime_env install failed:\n{proc.stdout}\n"
+                        f"{proc.stderr}"
+                    )
+                with open(marker, "w") as f:
+                    f.write("ok")
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+for _p in (_EnvVarsPlugin(), _WorkingDirPlugin(), _PyModulesPlugin(),
+           _PipPlugin()):
+    register_runtime_env_plugin(_p)
+
+
+async def apply_runtime_env(renv: Dict[str, Any], runtime: Any) -> None:
+    """Worker-side: run every known plugin over its section, lowest
+    priority first.  Unknown sections without a registered plugin are
+    an error — silently ignoring them would hide typos the way the
+    reference explicitly refuses to."""
+    if not renv:
+        return
+    unknown = set(renv) - set(_PLUGINS)
+    if unknown:
+        raise RuntimeError(
+            f"runtime_env sections {sorted(unknown)} have no registered "
+            "plugin (register_runtime_env_plugin)"
+        )
+    for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
+        if plugin.name in renv:
+            await plugin.setup(renv[plugin.name], runtime)
 
 
 def materialize_py_module(key: str, blob: bytes) -> str:

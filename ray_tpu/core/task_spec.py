@@ -120,6 +120,11 @@ class TaskSpec:
     # opt-in tracing context {trace_id, span_id} (reference: trace
     # propagation in task metadata, `tracing_helper.py:165`)
     trace_ctx: Optional[Dict[str, str]] = None
+    # per-task runtime env (reference: task runtime_env via dedicated
+    # workers keyed by env hash, `worker_pool.h` runtime-env matching);
+    # env_hash precomputed at submit so daemons never re-hash
+    runtime_env: Optional[Dict[str, Any]] = None
+    env_hash: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == STREAMING:

@@ -401,3 +401,77 @@ def test_function_export_survives_id_reuse(cluster):
         del f
         gc.collect()  # maximize address reuse pressure
     assert results == list(range(20))
+
+
+# ----------------------------------------------------------------------
+# cancellation of RUNNING tasks (reference: CancelTask + the Cython
+# interrupt wrapper _raylet.pyx:2055; force kill path)
+# ----------------------------------------------------------------------
+@rt.remote
+def _busy_loop(path):
+    import os
+    import time
+
+    with open(path, "w") as f:
+        f.write("started")
+    t0 = time.time()
+    x = 0
+    while time.time() - t0 < 60:
+        x += 1  # pure-Python loop: async-raised exception lands fast
+    return x
+
+
+def _wait_for_file(path, timeout=30):
+    import os
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_cancel_running_task_interrupts(rt_start, tmp_path):
+    import time
+
+    from ray_tpu.exceptions import TaskCancelledError
+
+    marker = str(tmp_path / "started")
+    ref = _busy_loop.remote(marker)
+    assert _wait_for_file(marker)
+    t0 = time.time()
+    assert rt.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        rt.get(ref, timeout=30)
+    assert time.time() - t0 < 20, "interrupt did not land promptly"
+
+
+def test_cancel_force_kills_worker(rt_start, tmp_path):
+    from ray_tpu.exceptions import RayTpuError, WorkerCrashedError
+
+    marker = str(tmp_path / "started2")
+    ref = _busy_loop.remote(marker)
+    assert _wait_for_file(marker)
+    rt.cancel(ref, force=True)
+    with pytest.raises((WorkerCrashedError, RayTpuError)):
+        rt.get(ref, timeout=30)
+    # the pool replaced the worker: new tasks still run
+    assert rt.get(rt.remote(lambda: 5).remote(), timeout=60) == 5
+
+
+def test_cancel_force_rejected_for_actor_tasks(rt_start):
+    @rt.remote
+    class Sleeper:
+        def nap(self):
+            import time
+
+            time.sleep(30)
+            return 1
+
+    a = Sleeper.remote()
+    ref = a.nap.remote()
+    with pytest.raises(ValueError):
+        rt.cancel(ref, force=True)
+    rt.kill(a)

@@ -1,0 +1,180 @@
+"""Task-level runtime envs, the plugin protocol, and the pip plugin
+(reference: `_private/runtime_env/` — `plugin.py` protocol, `pip.py`,
+and worker-pool dedication by runtime-env hash)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core import runtime_env as re_mod
+
+
+# ----------------------------------------------------------------------
+# unit: hash + plugin registry
+# ----------------------------------------------------------------------
+def test_runtime_env_hash_stable():
+    a = re_mod.runtime_env_hash({"env_vars": {"A": "1"}, "pip": ["x"]})
+    b = re_mod.runtime_env_hash({"pip": ["x"], "env_vars": {"A": "1"}})
+    assert a == b and a is not None
+    assert re_mod.runtime_env_hash(None) is None
+    assert re_mod.runtime_env_hash({}) is None
+    assert re_mod.runtime_env_hash({"env_vars": {"A": "2"}}) != a
+
+
+def test_unknown_section_rejected(rt_start):
+    @rt.remote(runtime_env={"no_such_plugin": 1})
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        rt.get(f.remote(), timeout=60)
+
+
+def test_custom_plugin_protocol(tmp_path):
+    """The plugin protocol: a registered section materializes through
+    apply_runtime_env in priority order; unregistering removes it."""
+    import asyncio
+
+    marker_dir = str(tmp_path)
+    order = []
+
+    class MarkerPlugin(re_mod.RuntimeEnvPlugin):
+        name = "marker"
+        priority = 5
+
+        async def setup(self, value, runtime):
+            order.append("marker")
+            with open(os.path.join(value["dir"], "plugin_ran"), "w") as f:
+                f.write(value["text"])
+
+    re_mod.register_runtime_env_plugin(MarkerPlugin())
+    try:
+        asyncio.run(re_mod.apply_runtime_env(
+            {"marker": {"dir": marker_dir, "text": "hello"},
+             "env_vars": {"PLUGIN_ORDER_PROBE": "1"}},
+            None,
+        ))
+        assert open(os.path.join(marker_dir, "plugin_ran")).read() == "hello"
+        # env_vars (priority 0) ran before the custom plugin (5)
+        assert os.environ.pop("PLUGIN_ORDER_PROBE") == "1"
+        assert order == ["marker"]
+    finally:
+        re_mod.unregister_runtime_env_plugin("marker")
+    with pytest.raises(RuntimeError):
+        asyncio.run(re_mod.apply_runtime_env({"marker": {}}, None))
+
+
+# ----------------------------------------------------------------------
+# task-level envs end-to-end
+# ----------------------------------------------------------------------
+def test_task_env_vars(rt_start):
+    @rt.remote(runtime_env={"env_vars": {"TASK_ENV_PROBE": "42"}})
+    def read_env():
+        return os.environ.get("TASK_ENV_PROBE")
+
+    @rt.remote
+    def read_env_plain():
+        return os.environ.get("TASK_ENV_PROBE")
+
+    assert rt.get(read_env.remote(), timeout=120) == "42"
+    # clean tasks run on clean workers: the env must not leak
+    assert rt.get(read_env_plain.remote(), timeout=120) is None
+
+
+def test_task_env_worker_dedication(rt_start):
+    """Two different envs -> two dedicated workers; same env reuses."""
+
+    @rt.remote(runtime_env={"env_vars": {"WHICH": "a"}})
+    def pid_a():
+        return os.getpid(), os.environ["WHICH"]
+
+    @rt.remote(runtime_env={"env_vars": {"WHICH": "b"}})
+    def pid_b():
+        return os.getpid(), os.environ["WHICH"]
+
+    pa1, va1 = rt.get(pid_a.remote(), timeout=120)
+    pb1, vb1 = rt.get(pid_b.remote(), timeout=120)
+    pa2, va2 = rt.get(pid_a.remote(), timeout=120)
+    assert (va1, vb1, va2) == ("a", "b", "a")
+    assert pa1 != pb1  # different envs never share a worker
+    assert pa1 == pa2  # same env reuses its dedicated worker
+
+
+def test_task_py_modules(rt_start, tmp_path):
+    pkg = tmp_path / "taskpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("VALUE = 'from-task-pkg'\n")
+
+    @rt.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_pkg():
+        import taskpkg
+
+        return taskpkg.VALUE
+
+    assert rt.get(use_pkg.remote(), timeout=120) == "from-task-pkg"
+
+
+# ----------------------------------------------------------------------
+# pip plugin (offline: install a locally-built wheel via --no-index)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def local_wheel(tmp_path_factory):
+    """Build a tiny wheel offline so the pip plugin can install without
+    a network."""
+    src = tmp_path_factory.mktemp("wheelsrc")
+    pkg = src / "rtenvdemo"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 12345\n")
+    (src / "pyproject.toml").write_text(
+        '[build-system]\nrequires=["setuptools"]\n'
+        'build-backend="setuptools.build_meta"\n'
+        "[project]\nname='rtenvdemo'\nversion='0.1'\n"
+    )
+    wheel_dir = tmp_path_factory.mktemp("wheels")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps",
+         "--no-build-isolation", "--no-index", "-w", str(wheel_dir),
+         str(src)],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"cannot build wheel offline: {proc.stderr[-500:]}")
+    wheels = list(wheel_dir.glob("rtenvdemo-*.whl"))
+    assert wheels
+    return str(wheels[0])
+
+
+def test_pip_runtime_env(rt_start, local_wheel):
+    @rt.remote(runtime_env={"pip": {
+        "packages": ["rtenvdemo"],
+        "pip_install_options": [
+            "--no-index", "--find-links", os.path.dirname(local_wheel),
+        ],
+    }})
+    def use_wheel():
+        import rtenvdemo
+
+        return rtenvdemo.MAGIC
+
+    assert rt.get(use_wheel.remote(), timeout=300) == 12345
+
+
+def test_pip_runtime_env_for_actor(rt_start, local_wheel):
+    @rt.remote(runtime_env={"pip": {
+        "packages": ["rtenvdemo"],
+        "pip_install_options": [
+            "--no-index", "--find-links", os.path.dirname(local_wheel),
+        ],
+    }})
+    class UsesWheel:
+        def magic(self):
+            import rtenvdemo
+
+            return rtenvdemo.MAGIC
+
+    a = UsesWheel.remote()
+    assert rt.get(a.magic.remote(), timeout=300) == 12345
+    rt.kill(a)
