@@ -580,11 +580,27 @@ class Controller:
         ]
 
     # ---- spillback target query (used by noded schedulers) ----------
+    def _node_utilization(self, n) -> float:
+        load = getattr(n, "load", None) or {}
+        used = load.get("used") or {}
+        total = sum(n.resources.values()) or 1.0
+        return min(1.0, sum(used.values()) / total)
+
     async def handle_find_node_for(self, payload, conn):
         """Cluster-level placement for spilled-back leases (reference:
-        `cluster_task_manager.cc:44` spillback).  With spread=True,
-        feasible nodes are taken round-robin (reference:
-        `spread_scheduling_policy.h:27`)."""
+        `cluster_task_manager.cc:44` spillback), using the HYBRID
+        pack-then-spread policy (`hybrid_scheduling_policy.h:50`):
+        while nodes sit below the utilization threshold, pack onto the
+        most-utilized such node (consolidates work, lets idle nodes
+        scale down); past the threshold, spread to the least-utilized.
+        Ties take a random pick among the top-k candidates so
+        concurrent placements don't herd onto one node.  With
+        spread=True, feasible nodes are taken round-robin
+        (`spread_scheduling_policy.h:27`)."""
+        import random
+
+        from ray_tpu.core.config import get_config
+
         demand = payload["resources"]
         exclude = set(payload.get("exclude", []))
         feasible = [
@@ -598,5 +614,27 @@ class Controller:
             feasible.sort(key=lambda n: n.node_id)
             self._spread_rr = getattr(self, "_spread_rr", 0) + 1
             return feasible[self._spread_rr % len(feasible)].node_id
-        return max(feasible, key=lambda n: sum(n.resources.values())).node_id
+        cfg = get_config()
+        threshold = cfg.scheduler_spread_threshold
+
+        def fits_free(n) -> bool:
+            load = getattr(n, "load", None) or {}
+            used = load.get("used") or {}
+            free = {k: v - used.get(k, 0.0) for k, v in n.resources.items()}
+            return _fits(demand, free)
+
+        # prefer nodes whose FREE capacity can run the task now; only
+        # when none exists fall back to total-feasible (work drains)
+        ready = [n for n in feasible if fits_free(n)] or feasible
+        below = [n for n in ready
+                 if self._node_utilization(n) < threshold]
+        if below:
+            # pack: most-utilized below-threshold first
+            below.sort(key=self._node_utilization, reverse=True)
+            k = max(1, int(len(below) * cfg.scheduler_top_k_fraction))
+            return random.choice(below[:k]).node_id
+        # all hot: spread to the least utilized
+        ready.sort(key=self._node_utilization)
+        k = max(1, int(len(ready) * cfg.scheduler_top_k_fraction))
+        return random.choice(ready[:k]).node_id
 

@@ -629,6 +629,36 @@ class NodeDaemon:
                                error=envelope),
                 )
                 return {"cancelled": True}
+        # dispatched already: forward to the worker running it (its
+        # runtime delivers the mid-execution interrupt), then try the
+        # other daemons once — daemon-routed tasks may run anywhere
+        for w in list(self.workers.values()):
+            if task_id in w.in_flight and w.conn and not w.conn.closed:
+                try:
+                    return await w.conn.call(
+                        "cancel_task", {"task_id": task_id}, timeout=10
+                    )
+                except Exception:
+                    return {"cancelled": False}
+        if not payload.get("forwarded"):
+            try:
+                nodes = await self.controller_conn.call("get_nodes", None)
+            except Exception:
+                nodes = None
+            for n in nodes or []:
+                if not n.get("alive") or n["node_id"] == self.node_id:
+                    continue
+                try:
+                    c = await self._node_conn(n["node_id"])
+                    reply = await c.call(
+                        "cancel_task",
+                        {"task_id": task_id, "forwarded": True},
+                        timeout=10,
+                    )
+                    if reply and reply.get("cancelled"):
+                        return reply
+                except Exception:
+                    pass
         return {"cancelled": False}
 
     async def handle_restore_object(self, payload, conn):
@@ -1396,7 +1426,7 @@ class NodeDaemon:
                 target, tpu_n
             ):
                 target = None
-                self._reclaim_idle_pinned(tpu_n)
+                self._reclaim_idle_pinned(tpu_n, actor_env_hash)
             if target is None:
                 if time.monotonic() > deadline:
                     for k, v in demand.items():

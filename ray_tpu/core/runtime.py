@@ -772,11 +772,45 @@ class Runtime:
             pool.env_hash = spec.env_hash
         return pool
 
+    # args at least this big make their node the preferred executor
+    # (reference: locality-aware lease policy, `lease_policy.h` — pull
+    # the task to the data, not the data to the task)
+    _LOCALITY_MIN_ARG_BYTES = 1024 * 1024
+
+    def _locality_node(self, spec: TaskSpec) -> Optional[str]:
+        """Node holding the largest shm-resident arg above the locality
+        threshold, if it isn't this node."""
+        best_node, best_size = None, self._LOCALITY_MIN_ARG_BYTES
+        for a in spec.args:
+            if not isinstance(a, ArgRef):
+                continue
+            st = self.objects.get(a.id_bytes)
+            if (
+                st is not None
+                and st.where == _SHM
+                and st.node_id
+                and st.node_id != self.node_id
+                and (st.size or 0) >= best_size
+            ):
+                best_node, best_size = st.node_id, st.size
+        return best_node
+
     def _push_or_queue(self, spec: TaskSpec):
         if spec.strategy.kind != "default":
             # placement-constrained tasks go through the node daemon,
             # which consults the controller for PG bundles / affinity /
             # spread targets (reference: lease policy + spillback)
+            try:
+                self.noded.send_threadsafe("submit_task", spec)
+            except rpc.ConnectionLost:
+                pass
+            return
+        locality = self._locality_node(spec)
+        if locality is not None:
+            # route to the data's node (soft: falls back if it's gone)
+            spec.strategy = SchedulingStrategy(
+                kind="node_affinity", node_id=locality, soft=True
+            )
             try:
                 self.noded.send_threadsafe("submit_task", spec)
             except rpc.ConnectionLost:

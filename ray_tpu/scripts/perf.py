@@ -200,6 +200,44 @@ def build_matrix(rt, args):
     ]
 
 
+class _BusbwMember:
+    def __init__(self, rank, world, size_mb):
+        from ray_tpu.parallel import collectives as col
+
+        self.g = col.init_collective_group(world, rank,
+                                           group_name="perf_busbw")
+        self.world = world
+        self.arr = np.random.default_rng(rank).standard_normal(
+            size_mb * 1024 * 1024 // 8
+        )
+
+    def run(self, iters):
+        import time as _t
+
+        self.g.barrier()
+        t0 = _t.perf_counter()
+        for _ in range(iters):
+            self.g.allreduce(self.arr)
+        dt = _t.perf_counter() - t0
+        # ring algorithm bus bandwidth convention (NCCL tests):
+        # busbw = 2*(n-1)/n * size / time
+        n = self.world
+        return (2 * (n - 1) / n) * self.arr.nbytes * iters / dt / 1e9
+
+
+def measure_allreduce_busbw(rt, world: int = 2, size_mb: int = 16,
+                            iters: int = 3) -> float:
+    """Host-tier ring-allreduce bus bandwidth in GB/s (the BASELINE
+    north-star metric the reference measures with nccl-tests against
+    `util.collective`)."""
+    Member = rt.remote(num_cpus=0)(_BusbwMember)
+    members = [Member.remote(i, world, size_mb) for i in range(world)]
+    vals = rt.get([m.run.remote(iters) for m in members], timeout=600)
+    for m in members:
+        rt.kill(m)
+    return float(min(vals))
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -207,6 +245,10 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p.add_argument("--rounds", type=int, default=3)
     p.add_argument("--round-sec", type=float, default=1.0)
     p.add_argument("--num-workers", type=int, default=4)
+    p.add_argument("--busbw", action="store_true",
+                   help="also measure host ring-allreduce bus bandwidth")
+    p.add_argument("--busbw-world", type=int, default=2)
+    p.add_argument("--busbw-mb", type=int, default=16)
     args = p.parse_args(argv)
 
     import ray_tpu as rt
@@ -228,6 +270,14 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
             finally:
                 cleanup()
             results[n] = {"ops_per_s": round(mean, 2), "sd": round(sd, 2)}
+        if args.busbw:
+            bw = measure_allreduce_busbw(
+                rt, world=args.busbw_world, size_mb=args.busbw_mb
+            )
+            print(f"allreduce busbw ({args.busbw_world} ranks, "
+                  f"{args.busbw_mb} MB): {bw:.2f} GB/s", flush=True)
+            results["allreduce_busbw_gbps"] = {"ops_per_s": round(bw, 3),
+                                               "sd": 0.0}
     finally:
         if owns:
             rt.shutdown()

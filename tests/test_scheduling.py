@@ -1,0 +1,161 @@
+"""Scheduler policy depth: hybrid pack-then-spread scoring and
+locality-aware task routing (reference:
+`hybrid_scheduling_policy.h:50`, `lease_policy.h`)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.controller import Controller
+
+
+class _FakeConn:
+    def send(self, *a, **k):
+        pass
+
+
+def _register(ctl, node_id, cpus, used):
+    asyncio.run(ctl.handle_register_node(
+        {"node_id": node_id, "addr": ("127.0.0.1", 1),
+         "resources": {"CPU": cpus}, "is_head": False},
+        _FakeConn(),
+    ))
+    asyncio.run(ctl.handle_report_node_load(
+        {"node_id": node_id, "used": {"CPU": used}, "busy": used > 0},
+        _FakeConn(),
+    ))
+
+
+def test_hybrid_packs_below_threshold_then_spreads():
+    ctl = Controller()
+    # A at 30% utilization, B idle: pack onto A (both below 0.5)
+    _register(ctl, "node_a", 10, 3.0)
+    _register(ctl, "node_b", 10, 0.0)
+    picks = {
+        asyncio.run(ctl.handle_find_node_for(
+            {"resources": {"CPU": 1}, "exclude": []}, _FakeConn()
+        ))
+        for _ in range(8)
+    }
+    assert picks == {"node_a"}
+
+    # both hot (>= threshold): spread to the LEAST utilized
+    _register(ctl, "node_a", 10, 9.0)
+    _register(ctl, "node_b", 10, 6.0)
+    picks = {
+        asyncio.run(ctl.handle_find_node_for(
+            {"resources": {"CPU": 1}, "exclude": []}, _FakeConn()
+        ))
+        for _ in range(8)
+    }
+    assert picks == {"node_b"}
+
+
+def test_hybrid_respects_feasibility_and_exclude():
+    ctl = Controller()
+    _register(ctl, "small", 2, 0.0)
+    _register(ctl, "big", 16, 0.0)
+    pick = asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 8}, "exclude": []}, _FakeConn()
+    ))
+    assert pick == "big"
+    assert asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 8}, "exclude": ["big"]}, _FakeConn()
+    )) is None
+
+
+@rt.remote
+def _make_big():
+    return np.ones(1_000_000, dtype=np.int64)  # 8MB: above threshold
+
+
+@rt.remote
+def _where_with_arg(arr):
+    assert len(arr) == 1_000_000
+    return os.environ.get("RT_NODE_SOCKET", "")
+
+
+@rt.remote
+def _where():
+    return os.environ.get("RT_NODE_SOCKET", "")
+
+
+def test_locality_aware_task_routing():
+    """A task whose big arg lives on another node executes THERE
+    instead of pulling 8MB across (reference: locality-aware lease
+    policy picks the raylet holding the args)."""
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 2})
+    c.connect()
+    try:
+        c.add_node(num_cpus=2, resources={"src": 1}, num_workers=2)
+        c.wait_for_nodes()
+        big_ref = _make_big.options(resources={"src": 1}).remote()
+        rt.wait([big_ref])
+        src_sock = rt.get(
+            _where.options(resources={"src": 1}).remote(), timeout=120
+        )
+        consumer_sock = rt.get(
+            _where_with_arg.remote(big_ref), timeout=120
+        )
+        assert consumer_sock == src_sock, (
+            "consumer did not follow its 8MB arg to the producing node"
+        )
+    finally:
+        c.shutdown()
+
+
+def test_hybrid_prefers_free_capacity():
+    ctl = Controller()
+    # A 40% used (pack candidate) but demand does NOT fit its free 6;
+    # B idle fits: B must win despite pack preferring utilized nodes
+    _register(ctl, "node_a", 10, 4.0)
+    _register(ctl, "node_b", 10, 0.0)
+    pick = asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 8}, "exclude": []}, _FakeConn()
+    ))
+    assert pick == "node_b"
+
+
+@rt.remote
+def _busy_on_src(path):
+    import time
+
+    with open(path, "w") as f:
+        f.write("x")
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < 60:
+        n += 1
+    return n
+
+
+def test_cancel_interrupts_daemon_routed_task(tmp_path):
+    """Locality/strategy-routed tasks run without a caller lease conn;
+    cancel must reach them THROUGH the daemons (queue scan -> running-
+    worker forward -> one-hop fan-out)."""
+    import time
+
+    from ray_tpu.exceptions import TaskCancelledError
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 2})
+    c.connect()
+    try:
+        c.add_node(num_cpus=2, resources={"src": 1}, num_workers=2)
+        c.wait_for_nodes()
+        marker = str(tmp_path / "started")
+        ref = _busy_on_src.options(resources={"src": 1}).remote(marker)
+        deadline = time.time() + 60
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(marker)
+        rt.cancel(ref)
+        with pytest.raises(TaskCancelledError):
+            rt.get(ref, timeout=30)
+    finally:
+        c.shutdown()

@@ -135,3 +135,56 @@ def test_collective_group_ops_and_p2p(cluster):
     assert res[0]["allreduce"] == [3.0] * 4  # 1 + 2
     assert res[1]["bcast"] == [0.0, 1.0, 2.0]
     assert res[1]["p2p"] == [42.0, 43.0]
+    for m in members:
+        rt.kill(m)
+    try:
+        rt.kill(rt.get_actor("__rt_collective__t_p2p"))
+    except ValueError:
+        pass
+
+
+def test_ring_allreduce_large_arrays(cluster):
+    """Arrays past the ring threshold take the bandwidth-optimal path:
+    chunk refs circulate rank-to-rank over the object plane instead of
+    every byte funneling through the rendezvous actor (reference: the
+    NCCL ring the collective group wraps, nccl_collective_group.py:175).
+    """
+    import numpy as np
+
+    N = 400_000  # 3.2 MB f64 > _RING_MIN_BYTES
+
+    @rt.remote
+    class Member:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collectives as col
+
+            self.g = col.init_collective_group(
+                world, rank, group_name="t_ring"
+            )
+            self.rank = rank
+
+        def run(self, op):
+            rng = np.random.default_rng(self.rank)
+            arr = rng.standard_normal(N)
+            out = self.g.allreduce(arr, op=op)
+            return float(out[0]), float(out[-1]), out.shape
+
+    world = 3
+    # num_cpus=0: earlier module tests legitimately hold pool actors;
+    # this test needs scheduling slots, not CPU accounting
+    members = [Member.options(num_cpus=0).remote(r, world)
+               for r in range(world)]
+    # expected: sum of the three seeded arrays
+    arrs = [np.random.default_rng(r).standard_normal(N) for r in range(world)]
+    expected = np.sum(arrs, axis=0)
+    results = rt.get([m.run.remote("sum") for m in members], timeout=300)
+    for first, last, shape in results:
+        assert shape == (N,)
+        assert abs(first - expected[0]) < 1e-9
+        assert abs(last - expected[-1]) < 1e-9
+    # mean path (pairwise sum + final divide)
+    results = rt.get([m.run.remote("mean") for m in members], timeout=300)
+    for first, _last, _shape in results:
+        assert abs(first - expected[0] / world) < 1e-9
+    for m in members:
+        rt.kill(m)
