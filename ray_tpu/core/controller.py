@@ -316,12 +316,12 @@ class Controller:
                 errors.append(f"{node.node_id[:8]}: infeasible {demand}")
                 continue
             try:
-                # must outlive the daemon's whole hosting window (60s
+                # must outlive the daemon's whole hosting window (240s
                 # idle-worker wait + 300s create_actor_instance — slow
                 # inits are real: first jax/TPU init in a fresh worker
                 # takes tens of seconds)
                 reply = await node.conn.call("host_actor", info.spec,
-                                             timeout=380)
+                                             timeout=560)
             except Exception as e:
                 logger.warning("host_actor on %s failed: %s",
                                node.node_id, e)
@@ -581,10 +581,18 @@ class Controller:
 
     # ---- spillback target query (used by noded schedulers) ----------
     def _node_utilization(self, n) -> float:
+        """Dominant-resource utilization: the max per-resource ratio.
+        Summing incommensurable units (CPU + TPU + byte-sized customs)
+        would let one large-magnitude resource mask saturation of the
+        others."""
         load = getattr(n, "load", None) or {}
         used = load.get("used") or {}
-        total = sum(n.resources.values()) or 1.0
-        return min(1.0, sum(used.values()) / total)
+        ratios = [
+            used.get(k, 0.0) / v
+            for k, v in n.resources.items()
+            if v > 0
+        ]
+        return min(1.0, max(ratios, default=0.0))
 
     async def handle_find_node_for(self, payload, conn):
         """Cluster-level placement for spilled-back leases (reference:

@@ -641,24 +641,12 @@ class NodeDaemon:
                 except Exception:
                     return {"cancelled": False}
         if not payload.get("forwarded"):
-            try:
-                nodes = await self.controller_conn.call("get_nodes", None)
-            except Exception:
-                nodes = None
-            for n in nodes or []:
-                if not n.get("alive") or n["node_id"] == self.node_id:
-                    continue
-                try:
-                    c = await self._node_conn(n["node_id"])
-                    reply = await c.call(
-                        "cancel_task",
-                        {"task_id": task_id, "forwarded": True},
-                        timeout=10,
-                    )
-                    if reply and reply.get("cancelled"):
-                        return reply
-                except Exception:
-                    pass
+            reply = await self._fanout_once(
+                "cancel_task", {"task_id": task_id},
+                done=lambda r: r and r.get("cancelled"),
+            )
+            if reply:
+                return reply
         return {"cancelled": False}
 
     async def handle_restore_object(self, payload, conn):
@@ -982,6 +970,33 @@ class NodeDaemon:
             return {"error": str(e)}
         return {"stacks": stacks, "pid": w.pid}
 
+    async def _fanout_once(self, method: str, payload: Dict[str, Any],
+                           done=None, timeout: float = 10.0,
+                           wait_reply: bool = True):
+        """One-hop broadcast of a daemon method to every other alive
+        daemon (with forwarded=True so peers don't re-broadcast).
+        With wait_reply, stops early when `done(reply)` is truthy and
+        returns that reply; otherwise fire-and-forget to all."""
+        try:
+            nodes = await self.controller_conn.call("get_nodes", None)
+        except Exception:
+            return None
+        payload = {**payload, "forwarded": True}
+        for n in nodes or []:
+            if not n.get("alive") or n["node_id"] == self.node_id:
+                continue
+            try:
+                c = await self._node_conn(n["node_id"])
+                if not wait_reply:
+                    c.send(method, payload)
+                    continue
+                reply = await c.call(method, payload, timeout=timeout)
+                if done is not None and done(reply):
+                    return reply
+            except Exception:
+                pass
+        return None
+
     async def handle_force_cancel_task(self, payload, conn):
         """Force-cancel: SIGKILL the worker running the task (reference:
         CancelTask force_kill).  The task's owner sees worker_died ->
@@ -997,24 +1012,11 @@ class NodeDaemon:
                 return {"killed": True}
         if payload.get("forwarded"):
             return {"killed": False}
-        try:
-            nodes = await self.controller_conn.call("get_nodes", None)
-        except Exception:
-            return {"killed": False}
-        for n in nodes or []:
-            if not n.get("alive") or n["node_id"] == self.node_id:
-                continue
-            try:
-                c = await self._node_conn(n["node_id"])
-                reply = await c.call(
-                    "force_cancel_task",
-                    {"task_id": tid, "forwarded": True}, timeout=10,
-                )
-                if reply and reply.get("killed"):
-                    return {"killed": True}
-            except Exception:
-                pass
-        return {"killed": False}
+        reply = await self._fanout_once(
+            "force_cancel_task", {"task_id": tid},
+            done=lambda r: r and r.get("killed"),
+        )
+        return reply or {"killed": False}
 
     async def handle_stream_cancel(self, payload, conn):
         """Abandoned-stream stop signal for a daemon-dispatched task.
@@ -1031,18 +1033,9 @@ class NodeDaemon:
                 return
         if payload.get("forwarded"):
             return  # one hop only: every daemon has now checked locally
-        try:
-            nodes = await self.controller_conn.call("get_nodes", None)
-        except Exception:
-            return
-        for n in nodes or []:
-            if not n.get("alive") or n["node_id"] == self.node_id:
-                continue
-            try:
-                c = await self._node_conn(n["node_id"])
-                c.send("stream_cancel", {"task_id": tid, "forwarded": True})
-            except Exception:
-                pass
+        await self._fanout_once(
+            "stream_cancel", {"task_id": tid}, wait_reply=False
+        )
 
     async def _route_to_owner(self, owner: Tuple[str, str], method: str, payload):
         node_id, worker_id = owner
@@ -1417,7 +1410,11 @@ class NodeDaemon:
 
         actor_env_hash = _reh(aspec.runtime_env)
         target = None
-        deadline = time.monotonic() + 60
+        # generous: a fresh worker's first boot imports jax + the TPU
+        # plugin (~10s/worker on hardware, multiplied under CPU
+        # contention); 60s raced that boot and spuriously failed actor
+        # creation on loaded hosts
+        deadline = time.monotonic() + 240
         while target is None:
             target = self._pick_idle_worker(
                 tpu_n, require_no_lease=True, env_hash=actor_env_hash

@@ -781,7 +781,7 @@ class Runtime:
         """Node holding the largest shm-resident arg above the locality
         threshold, if it isn't this node."""
         best_node, best_size = None, self._LOCALITY_MIN_ARG_BYTES
-        for a in spec.args:
+        for a in [*spec.args, *spec.kwargs.values()]:
             if not isinstance(a, ArgRef):
                 continue
             st = self.objects.get(a.id_bytes)

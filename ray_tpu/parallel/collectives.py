@@ -240,9 +240,12 @@ class CollectiveGroup:
         self.barrier()  # every rank received: safe to drop `held`
         del held
         if op == "mean":
-            # float result like the small-array path (integer means
-            # must not truncate across the size threshold)
-            return (out / n).reshape(shape)
+            out = out / n
+            # float inputs keep their dtype (as the small path does);
+            # integer means stay float so they never truncate
+            if np.issubdtype(dtype, np.floating):
+                out = out.astype(dtype, copy=False)
+            return out.reshape(shape)
         return out.astype(dtype, copy=False).reshape(shape)
 
     def allgather(self, array) -> List:

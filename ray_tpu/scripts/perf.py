@@ -235,6 +235,10 @@ def measure_allreduce_busbw(rt, world: int = 2, size_mb: int = 16,
     vals = rt.get([m.run.remote(iters) for m in members], timeout=600)
     for m in members:
         rt.kill(m)
+    try:  # the named rendezvous must not survive into a rerun
+        rt.kill(rt.get_actor("__rt_collective__perf_busbw"))
+    except Exception:
+        pass
     return float(min(vals))
 
 

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Sanitizer pass over the shm store (reference practice: C++ components
+# run under TSAN/ASAN in CI, SURVEY §5.2).  Builds the real store code
+# single-TU with the multi-threaded stress harness and runs it under
+# ThreadSanitizer and AddressSanitizer+UBSan.
+set -euo pipefail
+cd "$(dirname "$0")"
+out="${TMPDIR:-/tmp}/rts_sanitizers"
+mkdir -p "$out"
+echo "== TSAN =="
+g++ -O1 -g -fsanitize=thread -pthread shmstore_stress.cc -o "$out/stress_tsan"
+"$out/stress_tsan"
+echo "== ASAN+UBSAN =="
+g++ -O1 -g -fsanitize=address,undefined -pthread shmstore_stress.cc -o "$out/stress_asan"
+"$out/stress_asan"
+echo "sanitizers clean"

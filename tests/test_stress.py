@@ -1,0 +1,111 @@
+"""Scale/stress tests, sized for the CI box (reference envelope:
+BASELINE.md rows — 1M+ queued tasks, serve sustained load; scaled down
+by the core count but exercising the same code paths: deep task
+queues, lease pipelining under churn, pow-2 routing under concurrent
+load with bounded per-replica concurrency)."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@rt.remote
+def _noop(i):
+    return i
+
+
+def test_deep_task_queue_drains(rt_start):
+    """Thousands of tasks submitted far faster than they can run: the
+    queue + pipelined leases must drain them all, exactly once (scaled
+    stand-in for the reference's 1M-queued-tasks row)."""
+    n = 4000
+    t0 = time.time()
+    refs = [_noop.remote(i) for i in range(n)]
+    out = rt.get(refs, timeout=600)
+    dt = time.time() - t0
+    assert out == list(range(n))
+    assert dt < 300, f"drained {n} tasks in {dt:.0f}s"
+
+
+def test_queue_survives_worker_churn(rt_start):
+    """Deep queue + a worker killed mid-drain: retries must keep the
+    results exact (reference: stress_tests with chaos killers)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    n = 800
+    refs = [_noop.remote(i) for i in range(n)]
+    time.sleep(0.2)
+    # SIGKILL one pool worker mid-drain
+    workers = get_runtime().noded_call("list_workers", timeout=30)
+    victims = [w for w in workers if w["kind"] == "worker"]
+    if victims:
+        get_runtime().noded_call(
+            "kill_worker", {"worker_id": victims[0]["worker_id"]},
+            timeout=30,
+        )
+    out = rt.get(refs, timeout=600)
+    assert out == list(range(n))
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    rt.init(num_workers=4, num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    rt.shutdown()
+
+
+def test_serve_sustained_concurrent_load(serve_cluster):
+    """Pow-2 router + max_ongoing backpressure under sustained
+    concurrent HTTP load: every request lands, work spreads across
+    replicas (reference: serve/tests router/proxy load tests)."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Worker:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, request=None):
+            time.sleep(0.01)
+            return {"pid": self.pid}
+
+    serve.run(Worker.bind(), name="load", route_prefix="/load")
+    host, port = serve.http_address()
+    url = f"http://{host}:{port}/load"
+
+    results = []
+    errors = []
+    lock = threading.Lock()
+
+    def client(k):
+        import json as _json
+
+        for _ in range(20):
+            try:
+                with urllib.request.urlopen(url, timeout=60) as r:
+                    body = _json.loads(r.read())
+                with lock:
+                    results.append(body["pid"])
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(str(e))
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(10)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    dt = time.time() - t0
+    assert not errors, errors[:3]
+    assert len(results) == 200
+    assert len(set(results)) == 2, "load never spread across replicas"
+    assert dt < 200, f"200 requests took {dt:.0f}s"
+    serve.delete("load")
