@@ -18,6 +18,9 @@ from ray_tpu.util.multiprocessing import Pool, TimeoutError
 def cluster():
     rt.init(num_workers=4, num_cpus=8, ignore_reinit_error=True)
     yield
+    # later modules (e.g. test_object_transfer) start their OWN
+    # clusters and must not inherit this session
+    rt.shutdown()
 
 
 def _sq(x):

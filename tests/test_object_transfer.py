@@ -31,6 +31,8 @@ def _rss(pid: int) -> int:
 
 @pytest.fixture()
 def cluster(monkeypatch):
+    if rt.is_initialized():  # defensively drop a leaked prior session
+        rt.shutdown()
     monkeypatch.setenv("RT_OBJECT_TRANSFER_CHUNK_BYTES", str(4 * MB))
     c = Cluster(initialize_head=True,
                 head_node_args={"num_cpus": 2, "num_workers": 2})
