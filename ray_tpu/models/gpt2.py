@@ -264,11 +264,11 @@ def loss_fn(cfg: GPT2Config, params: Dict, tokens: jax.Array,
     targets = tokens[:, 1:]
     x = backbone(cfg, params, inputs, mesh)
     logits = lm_head(cfg, params, x, out_dtype=cfg.logits_dtype)
-    # reductions in f32 regardless of the logits' storage dtype
-    m = jnp.max(logits, axis=-1).astype(jnp.float32)
-    lse = m + jnp.log(jnp.sum(
-        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1
-    ))
+    # reductions in f32 regardless of the logits' storage dtype (XLA
+    # fuses the upcast into the reduce: no f32 materialization)
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
     tgt = jnp.take_along_axis(
         logits, targets[..., None], axis=-1
     )[..., 0].astype(jnp.float32)

@@ -2,6 +2,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
@@ -9,6 +10,7 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
     MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
@@ -17,6 +19,8 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "IMPALA",
@@ -25,4 +29,6 @@ __all__ = [
     "MultiAgentPPOConfig",
     "PPO",
     "PPOConfig",
+    "SAC",
+    "SACConfig",
 ]

@@ -1,0 +1,238 @@
+"""SAC for discrete action spaces (new API stack).
+
+Reference: `rllib/algorithms/sac/` (`sac.py`, `sac_learner.py` —
+continuous there; this is the standard discrete-SAC variant: expected
+Q under the full softmax policy replaces the reparameterized sample).
+Components: twin Q networks with a polyak-free periodic target sync
+(as the reference's discrete path does), softmax actor, and
+automatically-tuned entropy temperature (log_alpha is a learned
+parameter in the same pytree, so the single compiled learner update
+covers actor + critics + alpha).
+
+TD targets are computed OUTSIDE the learner with jitted target-network
+forwards (the DQN pattern here): the compiled update depends only on
+(obs, actions, td_target), keeping Learner/LearnerGroup unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer, _transitions
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule
+
+
+class SACModule(MLPModule):
+    """pi tower = policy logits; twin critics q1/q2 (one Q per action);
+    log_alpha rides the pytree so one optimizer updates everything."""
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)  # independent keys
+        return {
+            "pi": self.init_tower(k_pi, self.num_actions),
+            "q1": self.init_tower(k_q1, self.num_actions),
+            "q2": self.init_tower(k_q2, self.num_actions),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def forward_train(self, params, obs):
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core.rl_module import tower_jax
+
+        return tower_jax(params["pi"], obs), jnp.zeros(obs.shape[0])
+
+    def q_values(self, params, obs):
+        from ray_tpu.rllib.core.rl_module import tower_jax
+
+        return tower_jax(params["q1"], obs), tower_jax(params["q2"], obs)
+
+    def forward_numpy(self, params_np, obs: np.ndarray):
+        from ray_tpu.rllib.core.rl_module import tower_numpy
+
+        return (tower_numpy(params_np["pi"], obs),
+                np.zeros(obs.shape[0], np.float32))
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-3
+        self.buffer_size: int = 50_000
+        self.learn_batch_size: int = 128
+        self.num_updates_per_iter: int = 32
+        self.target_update_freq: int = 1
+        #: None -> auto: 0.5 * log(num_actions) (discrete-SAC default)
+        self.target_entropy: float = None  # type: ignore[assignment]
+        self.num_env_runners = 1
+        self.rollout_fragment_length = 32
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+def make_sac_loss(target_entropy: float):
+    """Joint actor + twin-critic + temperature loss (discrete SAC)."""
+
+    def sac_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs = batch["obs"]
+        actions = batch["actions"].astype(jnp.int32)
+        logits, _ = module.forward_train(params, obs)
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        probs = jnp.exp(logp_all)
+        alpha = jnp.exp(params["log_alpha"])
+
+        q1, q2 = module.q_values(params, obs)
+        q1_a = jnp.take_along_axis(q1, actions[:, None], axis=-1)[:, 0]
+        q2_a = jnp.take_along_axis(q2, actions[:, None], axis=-1)[:, 0]
+        y = batch["td_target"]
+        critic_loss = jnp.mean((q1_a - y) ** 2) + jnp.mean((q2_a - y) ** 2)
+
+        # actor: minimize E_pi[alpha*logpi - minQ] (critics detached)
+        min_q = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+        actor_loss = jnp.mean(jnp.sum(
+            probs * (jax.lax.stop_gradient(alpha) * logp_all - min_q),
+            axis=-1,
+        ))
+
+        # temperature: entropy toward the target (policy detached)
+        entropy = -jnp.sum(
+            jax.lax.stop_gradient(probs * logp_all), axis=-1
+        )
+        alpha_loss = jnp.mean(
+            params["log_alpha"] * (entropy - target_entropy)
+        )
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha": alpha,
+            "entropy": jnp.mean(entropy),
+        }
+
+    return sac_loss
+
+
+class SAC(Algorithm):
+    def setup_components(self):
+        import jax
+
+        from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+        cfg = self.config
+        self.env_runner_group = EnvRunnerGroup(
+            cfg.env, cfg.num_env_runners, cfg.num_envs_per_env_runner,
+            cfg.rollout_fragment_length, seed=cfg.seed,
+            env_kwargs=cfg.env_kwargs,
+        )
+        spec = self.env_runner_group.env_spec()
+        self.module = SACModule(
+            spec["observation_size"], spec["num_actions"],
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        if cfg.target_entropy is None:
+            cfg.target_entropy = 0.5 * float(np.log(spec["num_actions"]))
+        self.learner_group = LearnerGroup(
+            self.module, make_sac_loss(cfg.target_entropy),
+            num_learners=cfg.num_learners, lr=cfg.lr,
+            grad_clip=cfg.grad_clip, seed=cfg.seed, mesh=cfg.mesh,
+        )
+        self.buffer = ReplayBuffer(cfg.buffer_size, spec["observation_size"])
+        self.target_params = self.learner_group.get_weights_numpy()
+        self._rng = np.random.default_rng(cfg.seed)
+
+        def _target_terms(target_p, online_p, next_obs):
+            import jax.numpy as jnp
+
+            logits, _ = self.module.forward_train(online_p, next_obs)
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            probs = jnp.exp(logp_all)
+            tq1, tq2 = self.module.q_values(target_p, next_obs)
+            min_q = jnp.minimum(tq1, tq2)
+            alpha = jnp.exp(online_p["log_alpha"])
+            return jnp.sum(probs * (min_q - alpha * logp_all), axis=-1)
+
+        self._target_terms = jax.jit(_target_terms)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+
+    def _td_targets(self, replay, online) -> np.ndarray:
+        cfg = self.config
+        v_next = np.asarray(self._target_terms(
+            self.target_params, online, replay["next_obs"]
+        ))
+        nonterminal = 1.0 - replay["terminated"].astype(np.float32)
+        return (replay["rewards"] + cfg.gamma * v_next * nonterminal).astype(
+            np.float32
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        samples = self.env_runner_group.sample(self.module)
+        steps = 0
+        for s in samples:
+            obs, actions, rewards, next_obs, done = _transitions(s)
+            self.buffer.add_batch(obs, actions, rewards, next_obs, done)
+            steps += len(actions)
+
+        metrics_acc: List[Dict[str, float]] = []
+        if len(self.buffer) >= cfg.learn_batch_size:
+            online = self.learner_group.get_weights_numpy()
+            for _ in range(cfg.num_updates_per_iter):
+                replay = self.buffer.sample(cfg.learn_batch_size, self._rng)
+                batch = {
+                    "obs": replay["obs"],
+                    "actions": replay["actions"],
+                    "td_target": self._td_targets(replay, online),
+                }
+                metrics_acc.append(self.learner_group.update_minibatch(batch))
+        if (self.iteration + 1) % cfg.target_update_freq == 0:
+            self.target_params = self.learner_group.get_weights_numpy()
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+        result: Dict[str, Any] = {
+            k: float(np.mean([m[k] for m in metrics_acc]))
+            for k in (metrics_acc[0] if metrics_acc else {})
+        }
+        result["num_env_steps_sampled"] = steps
+        result["replay_buffer_size"] = len(self.buffer)
+        self._track_episode_metrics(
+            self.env_runner_group.pop_metrics(), result
+        )
+        return result
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "learner": self.learner_group.get_state(),
+            "target_params": self.target_params,
+            "recent_returns": list(self._recent_returns),
+            "iteration": self.iteration,
+        }
+
+    def set_state(self, state: Dict[str, Any]):
+        self.learner_group.set_state(state["learner"])
+        self.target_params = state["target_params"]
+        self._recent_returns = list(state.get("recent_returns", []))
+        self.iteration = state.get("iteration", self.iteration)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_numpy()
+        )
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()

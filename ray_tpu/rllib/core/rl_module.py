@@ -45,48 +45,60 @@ class MLPModule(RLModule):
         dims = [self.observation_size, *self.hidden, out_dim]
         return list(zip(dims[:-1], dims[1:]))
 
-    def init_params(self, rng) -> Dict[str, Any]:
+    def init_tower(self, rng, out_dim: int) -> List[Dict[str, Any]]:
+        """One MLP tower's layers (shared by every module family so a
+        layout change happens exactly once)."""
         import jax
         import jax.numpy as jnp
 
-        params: Dict[str, Any] = {}
-        for tower, out_dim in (("pi", self.num_actions), ("vf", 1)):
-            layers = []
-            for i, (m, n) in enumerate(self._tower_dims(out_dim)):
-                rng, k = jax.random.split(rng)
-                scale = float(np.sqrt(2.0 / m)) if i < len(self.hidden) else 0.01
-                layers.append({
-                    "w": jax.random.normal(k, (m, n), jnp.float32) * scale,
-                    "b": jnp.zeros((n,), jnp.float32),
-                })
-            params[tower] = layers
-        return params
+        layers = []
+        for i, (m, n) in enumerate(self._tower_dims(out_dim)):
+            rng, k = jax.random.split(rng)
+            scale = float(np.sqrt(2.0 / m)) if i < len(self.hidden) else 0.01
+            layers.append({
+                "w": jax.random.normal(k, (m, n), jnp.float32) * scale,
+                "b": jnp.zeros((n,), jnp.float32),
+            })
+        return layers
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+
+        k_pi, k_vf = jax.random.split(rng)
+        return {
+            "pi": self.init_tower(k_pi, self.num_actions),
+            "vf": self.init_tower(k_vf, 1),
+        }
 
     def forward_train(self, params, obs):
-        import jax.numpy as jnp
-
-        def tower(layers, x):
-            for i, lyr in enumerate(layers):
-                x = x @ lyr["w"] + lyr["b"]
-                if i < len(layers) - 1:
-                    x = jnp.tanh(x)
-            return x
-
-        logits = tower(params["pi"], obs)
-        value = tower(params["vf"], obs)[..., 0]
+        logits = tower_jax(params["pi"], obs)
+        value = tower_jax(params["vf"], obs)[..., 0]
         return logits, value
 
     def forward_numpy(self, params_np, obs: np.ndarray):
-        def tower(layers, x):
-            for i, lyr in enumerate(layers):
-                x = x @ lyr["w"] + lyr["b"]
-                if i < len(layers) - 1:
-                    x = np.tanh(x)
-            return x
-
-        logits = tower(params_np["pi"], obs)
-        value = tower(params_np["vf"], obs)[..., 0]
+        logits = tower_numpy(params_np["pi"], obs)
+        value = tower_numpy(params_np["vf"], obs)[..., 0]
         return logits, value
+
+
+def tower_jax(layers, x):
+    """The MLP tower forward — ONE definition for jax (and mirrored in
+    tower_numpy); matmul+tanh layout changes happen here only."""
+    import jax.numpy as jnp
+
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def tower_numpy(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = np.tanh(x)
+    return x
 
 
 def params_to_numpy(params) -> Any:
