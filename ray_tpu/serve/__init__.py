@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     deployment,
     get_app_handle,
     get_deployment_handle,
+    grpc_address,
     http_address,
     ingress,
     run,
@@ -24,7 +25,7 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, GRPCOptions, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.request import Request, Response
 
@@ -35,6 +36,7 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "GRPCOptions",
     "HTTPOptions",
     "Request",
     "Response",
@@ -45,6 +47,7 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "grpc_address",
     "http_address",
     "ingress",
     "run",

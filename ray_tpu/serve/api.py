@@ -15,7 +15,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu as rt
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, GRPCOptions, HTTPOptions
 from ray_tpu.serve.controller import (
     CONTROLLER_NAME,
     CONTROLLER_NAMESPACE,
@@ -143,17 +143,13 @@ def ingress(_app=None, **_kwargs):
 # controller / proxy lifecycle
 # ----------------------------------------------------------------------
 def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
-          grpc_options: Optional[Dict[str, Any]] = None):
+          grpc_options: Optional[Union[GRPCOptions, Dict[str, Any]]] = None):
     """Start the serve control plane (reference: `serve/api.py` serve.start).
 
-    grpc_options mirrors the reference's gRPCProxy surface
-    (`proxy.py:545`); it is gated on grpcio, which this deployment
-    image does not ship — pass None (default) to serve over HTTP."""
-    if grpc_options is not None:
-        raise NotImplementedError(
-            "the gRPC proxy is not wired in this build (grpcio is not "
-            "part of the supported image); serve over HTTP (http_options)"
-        )
+    grpc_options (a `GRPCOptions` or `{"host", "port"}` dict) starts
+    the generic gRPC ingress alongside HTTP (reference: `gRPCProxy`,
+    `proxy.py:545`; see `serve/grpc_proxy.py` for the routing
+    contract)."""
     with _state_lock:
         # stale module state survives a full runtime shutdown+restart in
         # the same process (the cached handles point into the DEAD
@@ -210,6 +206,43 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
             get_runtime().kv_put(
                 "serve:http_address", json.dumps([opts.host, port]).encode()
             )
+        if grpc_options is not None and "grpc_proxy" not in _state:
+            from ray_tpu.serve.config import GRPCOptions
+            from ray_tpu.serve.grpc_proxy import GRPCProxy
+
+            if isinstance(grpc_options, dict):
+                gopts = GRPCOptions(**grpc_options)
+            else:
+                gopts = grpc_options
+            from ray_tpu.core.runtime import get_runtime
+
+            try:  # another process may already run it (same pattern
+                # as the controller above); failed starts leave a
+                # named actor that must be reaped before retrying
+                gp = rt.get_actor("SERVE_GRPC_PROXY", CONTROLLER_NAMESPACE)
+                gport = rt.get(gp.address.remote())[1]
+            except ValueError:
+                gp = (
+                    rt.remote(GRPCProxy)
+                    .options(
+                        name="SERVE_GRPC_PROXY",
+                        namespace=CONTROLLER_NAMESPACE,
+                        max_concurrency=16,
+                        num_cpus=0,
+                    )
+                    .remote(gopts.host, gopts.port)
+                )
+                try:
+                    gport = rt.get(gp.start.remote())
+                except Exception:
+                    rt.kill(gp)
+                    raise
+            _state["grpc_proxy"] = gp
+            _state["grpc_address"] = (gopts.host, gport)
+            get_runtime().kv_put(
+                "serve:grpc_address",
+                json.dumps([gopts.host, gport]).encode(),
+            )
     return _state["controller"]
 
 
@@ -245,22 +278,30 @@ async def _get_controller_async():
     return c
 
 
-def http_address() -> Optional[tuple]:
-    addr = _state.get("http_address")
+def _discover_address(state_key: str, kv_key: str) -> Optional[tuple]:
+    """Cached ingress address; a proxy started by ANOTHER process (REST
+    deploy via the dashboard) is discovered through the controller KV."""
+    addr = _state.get(state_key)
     if addr is not None:
         return addr
-    # proxy may have been started by another process (REST deploy via
-    # the dashboard): discover through the controller KV
     from ray_tpu.core.runtime import get_runtime, is_initialized
 
     if not is_initialized():
         return None
-    raw = get_runtime().kv_get("serve:http_address")
+    raw = get_runtime().kv_get(kv_key)
     if raw:
         host, port = json.loads(raw)
-        _state["http_address"] = (host, int(port))
-        return _state["http_address"]
+        _state[state_key] = (host, int(port))
+        return _state[state_key]
     return None
+
+
+def http_address() -> Optional[tuple]:
+    return _discover_address("http_address", "serve:http_address")
+
+
+def grpc_address() -> Optional[tuple]:
+    return _discover_address("grpc_address", "serve:grpc_address")
 
 
 # ----------------------------------------------------------------------
@@ -378,7 +419,9 @@ def shutdown():
     with _state_lock:
         controller = _state.pop("controller", None)
         proxy = _state.pop("proxy", None)
+        grpc_proxy = _state.pop("grpc_proxy", None)
         _state.pop("http_address", None)
+        _state.pop("grpc_address", None)
     from ray_tpu.serve import handle as _handle_mod
 
     with _handle_mod._routers_lock:
@@ -396,22 +439,30 @@ def shutdown():
             proxy = rt.get_actor("SERVE_PROXY", CONTROLLER_NAMESPACE)
         except Exception:
             proxy = None
+    if grpc_proxy is None:
+        try:
+            grpc_proxy = rt.get_actor("SERVE_GRPC_PROXY",
+                                      CONTROLLER_NAMESPACE)
+        except Exception:
+            grpc_proxy = None
     try:
         from ray_tpu.core.runtime import get_runtime, is_initialized
 
         if is_initialized():
             get_runtime().kv_del("serve:http_address")
+            get_runtime().kv_del("serve:grpc_address")
     except Exception:
         pass
-    if proxy is not None:
-        try:
-            rt.get(proxy.stop.remote(), timeout=5)
-        except Exception:
-            pass
-        try:
-            rt.kill(proxy)
-        except Exception:
-            pass
+    for p in (proxy, grpc_proxy):
+        if p is not None:
+            try:
+                rt.get(p.stop.remote(), timeout=5)
+            except Exception:
+                pass
+            try:
+                rt.kill(p)
+            except Exception:
+                pass
     if controller is not None:
         try:
             rt.get(controller.shutdown.remote(), timeout=30)

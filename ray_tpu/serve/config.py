@@ -73,3 +73,13 @@ class HTTPOptions:
 
     host: str = "127.0.0.1"
     port: int = 8000
+
+
+@dataclass
+class GRPCOptions:
+    """Reference: `serve/config.py` gRPCOptions; here the generic
+    bytes-through proxy (`serve/grpc_proxy.py`), so no servicer
+    function list is needed."""
+
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral

@@ -1,0 +1,153 @@
+"""gRPC proxy actor: the serve data-plane's second ingress.
+
+Reference: `serve/_private/proxy.py` `gRPCProxy:545` — a gRPC server in
+the proxy actor routing RPCs to deployment handles the same way the
+HTTP proxy does.  Here a `grpc.aio` server with a GENERIC handler: no
+compiled protos are required — the method path selects the
+application, raw request bytes pass through to the app's ingress
+deployment, and whatever bytes it returns become the response:
+
+    /<application>/<method>    -> ingress handle.remote(Request(...))
+    /ray.serve.ServeAPIService/Healthz           -> b"ok"
+    /ray.serve.ServeAPIService/ListApplications  -> json app list
+
+Deployments see a `serve.Request` with method="GRPC",
+path="/<application>/<method>", and `body()` = the raw request bytes;
+they return `bytes` (or str / dict / Response — encoded like the HTTP
+proxy does).  Unary-unary only (the reference's streaming gRPC path is
+proto-specific and out of scope here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.request import Request, Response
+
+_HEALTH = "/ray.serve.ServeAPIService/Healthz"
+_LIST = "/ray.serve.ServeAPIService/ListApplications"
+
+
+def _encode(value) -> bytes:
+    if isinstance(value, Response):
+        value = value.content
+    if isinstance(value, (bytes, bytearray)):
+        return bytes(value)
+    if isinstance(value, str):
+        return value.encode()
+    return json.dumps(value).encode()
+
+
+class GRPCProxy:
+    """Async actor; the grpc.aio server lives on the actor's loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._num_requests = 0
+
+    async def start(self) -> int:
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                method = call_details.method
+
+                # a real async def: grpc.aio awaits handlers only when
+                # iscoroutinefunction(handler) is true
+                async def behavior(request, ctx, _m=method):
+                    return await proxy._handle(_m, request, ctx)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    behavior,
+                    request_deserializer=None,   # raw bytes through
+                    response_serializer=None,
+                )
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        requested = self._port
+        self._port = self._server.add_insecure_port(
+            f"{self._host}:{self._port}"
+        )
+        if self._port == 0:
+            # grpc reports bind failure as port 0, not an exception
+            raise OSError(
+                f"gRPC proxy could not bind {self._host}:{requested}"
+            )
+        await self._server.start()
+        return self._port
+
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def num_requests(self) -> int:
+        return self._num_requests
+
+    async def stop(self) -> bool:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+        return True
+
+    async def _handle(self, method: str, request: bytes, ctx) -> bytes:
+        import grpc
+
+        self._num_requests += 1
+        if method == _HEALTH:
+            return b"ok"
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.serve.api import _get_controller_async
+
+        controller = await _get_controller_async()
+        if method == _LIST:
+            ref = controller.list_applications.remote()
+            apps = await get_runtime()._get_one(ref)
+            return json.dumps(sorted(apps)).encode()
+        parts = method.strip("/").split("/", 1)
+        if len(parts) != 2:
+            await ctx.abort(grpc.StatusCode.UNIMPLEMENTED,
+                            f"malformed method {method!r}")
+        app = parts[0]
+        ref = controller.get_ingress.remote(app)
+        ingress = await get_runtime()._get_one(ref)
+        if ingress is None:
+            await ctx.abort(grpc.StatusCode.NOT_FOUND,
+                            f"no application named {app!r}")
+        handle = DeploymentHandle(ingress, app)
+        try:
+            value = await handle.remote(
+                Request("GRPC", method, {}, request or b"")
+            )
+        except Exception as e:  # noqa: BLE001 — boundary to gRPC status
+            await ctx.abort(grpc.StatusCode.INTERNAL, str(e))
+        if isinstance(value, Response) and not (
+            200 <= value.status_code < 300
+        ):
+            # like the HTTP proxy: a non-2xx Response is an ERROR reply
+            await ctx.abort(
+                _status_for(grpc, value.status_code),
+                _encode(value).decode(errors="replace"),
+            )
+        return _encode(value)
+
+
+def _status_for(grpc, http_status: int):
+    if http_status == 404:
+        return grpc.StatusCode.NOT_FOUND
+    if http_status in (401, 403):
+        return grpc.StatusCode.PERMISSION_DENIED
+    if http_status == 429:
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if 400 <= http_status < 500:
+        return grpc.StatusCode.INVALID_ARGUMENT
+    return grpc.StatusCode.INTERNAL

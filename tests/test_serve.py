@@ -411,3 +411,53 @@ def test_http_streaming_error_before_first_item_is_500(serve_instance):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(f"http://{host}:{port}/badstream", timeout=15)
     assert e.value.code == 500
+
+
+# ----------------------------------------------------------------------
+# gRPC ingress (reference: gRPCProxy, serve/_private/proxy.py:545)
+# ----------------------------------------------------------------------
+def test_grpc_proxy_roundtrip(serve_instance):
+    import grpc
+
+    from ray_tpu.serve.config import GRPCOptions
+
+    @serve.deployment
+    class EchoUpper:
+        def __call__(self, request):
+            # request.body() = the raw gRPC request bytes
+            return request.body().upper()
+
+    serve.start(grpc_options=GRPCOptions(port=0))
+    serve.run(EchoUpper.bind(), name="grpcecho", route_prefix="/grpcecho")
+    host, port = serve.grpc_address()
+
+    channel = grpc.insecure_channel(f"{host}:{port}")
+    call = channel.unary_unary(
+        "/grpcecho/__call__",
+        request_serializer=None,
+        response_deserializer=None,
+    )
+    assert call(b"hello grpc", timeout=60) == b"HELLO GRPC"
+
+    # reserved service surface
+    health = channel.unary_unary("/ray.serve.ServeAPIService/Healthz",
+                                 request_serializer=None,
+                                 response_deserializer=None)
+    assert health(b"", timeout=30) == b"ok"
+    apps = channel.unary_unary(
+        "/ray.serve.ServeAPIService/ListApplications",
+        request_serializer=None, response_deserializer=None,
+    )
+    assert "grpcecho" in json.loads(apps(b"", timeout=30))
+
+    # unknown application -> NOT_FOUND status
+    import pytest as _pytest
+
+    missing = channel.unary_unary("/nosuchapp/__call__",
+                                  request_serializer=None,
+                                  response_deserializer=None)
+    with _pytest.raises(grpc.RpcError) as exc_info:
+        missing(b"x", timeout=30)
+    assert exc_info.value.code() == grpc.StatusCode.NOT_FOUND
+    channel.close()
+    serve.delete("grpcecho")
