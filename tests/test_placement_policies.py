@@ -1,0 +1,86 @@
+"""Placement-group bundle policies over TPU slice topology.
+
+Reference: `bundle_scheduling_policy.h:31-106` (PACK/SPREAD/STRICT_*)
+plus this framework's TPU-first inversion: STRICT_PACK means "one ICI
+domain" — bundles land inside a single `tpu-slice` label set (SURVEY
+§7 architecture stance #1), which the reference can only approximate
+with the `TPU-{pod}-head` resource hack.
+"""
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture()
+def slice_cluster():
+    """Two 2-host 'slices' (4 chips per host) + the unlabeled head."""
+    if rt.is_initialized():
+        rt.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1, "num_workers": 1})
+    c.connect()
+    for slice_name in ("slice-a", "slice-b"):
+        for _ in range(2):
+            c.add_node(num_cpus=4, num_tpus=4, num_workers=2,
+                       labels={"tpu-slice": slice_name})
+    c.wait_for_nodes()
+    yield c
+    c.shutdown()
+
+
+def _pg_entry(pg):
+    for e in placement_group_table():
+        if e["pg_id"] == pg.id.hex():
+            return e
+    raise AssertionError("pg not in table")
+
+
+def _node_labels():
+    return {n["node_id"]: n.get("labels", {}) for n in rt.nodes()}
+
+
+def test_strict_pack_lands_in_one_slice(slice_cluster):
+    """4 two-chip bundles can't fit one 4-chip host but CAN fit one
+    2-host slice: STRICT_PACK must place them inside a single
+    tpu-slice label set, never straddling slices."""
+    pg = placement_group([{"TPU": 2, "CPU": 1}] * 4, strategy="STRICT_PACK")
+    assert pg.ready(timeout=120)
+    nodes = _pg_entry(pg)["bundle_nodes"]
+    assert len(set(nodes)) == 2  # spread over the slice's two hosts
+    labels = _node_labels()
+    slices = {labels[nid].get("tpu-slice") for nid in nodes}
+    assert len(slices) == 1 and slices.pop() in ("slice-a", "slice-b")
+    remove_placement_group(pg)
+
+
+def test_strict_pack_infeasible_when_no_slice_fits(slice_cluster):
+    """Demand exceeding any single slice must NOT be placed by
+    STRICT_PACK — while PACK spills across slices and succeeds."""
+    bundles = [{"TPU": 4, "CPU": 1}] * 3  # 12 chips > one slice's 8
+    pg = placement_group(bundles, strategy="STRICT_PACK")
+    # placement is decided synchronously at creation: PENDING now means
+    # infeasible (no wall-clock wait needed)
+    assert _pg_entry(pg)["state"] == "PENDING"
+    assert not pg.ready(timeout=0.2)
+    remove_placement_group(pg)
+
+    pg2 = placement_group(bundles, strategy="PACK")
+    assert pg2.ready(timeout=120)
+    nodes = _pg_entry(pg2)["bundle_nodes"]
+    labels = _node_labels()
+    assert len({labels[nid].get("tpu-slice") for nid in nodes}) == 2
+    remove_placement_group(pg2)
+
+
+def test_strict_spread_uses_distinct_nodes(slice_cluster):
+    pg = placement_group([{"CPU": 1}] * 4, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=120)
+    assert len(set(_pg_entry(pg)["bundle_nodes"])) == 4
+    remove_placement_group(pg)
