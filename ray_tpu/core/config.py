@@ -89,6 +89,11 @@ class Config:
     health_check_failure_threshold: int = 5
     #: max actor restarts when not specified per-actor
     actor_max_restarts: int = 0
+    #: controller durable-state backend URL: "" = session-local file;
+    #: "sqlite:///path/state.db" for the database tier, "memory://" to
+    #: disable durability entirely (no persist loop) (reference: in-memory vs Redis StoreClient
+    #: choice, `redis_store_client.h:106`)
+    controller_store_url: str = ""
 
     # ---- rpc ---------------------------------------------------------
     #: max message size on the control plane

@@ -55,12 +55,25 @@ class Controller:
 
     def __init__(self, persist_path: Optional[str] = None):
         self.cfg = get_config()
-        # file-backed persistence of the durable tables (reference: the
-        # Redis StoreClient enabling GCS fault tolerance,
-        # `redis_store_client.h:106`): KV (function store, job records,
-        # library state) and job registry survive a head restart and
-        # rehydrate at boot (reference: GcsInitData, `gcs_init_data.h`)
-        self._persist_path = persist_path
+        # pluggable persistence of the durable tables (reference: the
+        # StoreClient seam enabling GCS fault tolerance,
+        # `store_client.h` / `redis_store_client.h:106`): KV (function
+        # store, job records, library state) and job registry survive a
+        # head restart and rehydrate at boot (reference: GcsInitData,
+        # `gcs_init_data.h`).  `persist_path` may be a bare file path
+        # or a store URL (sqlite:///..., memory://) — core/storage.py.
+        from ray_tpu.core.storage import store_client_for
+
+        try:
+            self._store = store_client_for(persist_path)
+        except Exception as e:  # noqa: BLE001 — persistence must never
+            # block boot: a bad URL/unavailable volume costs durability,
+            # not the cluster
+            logger.warning(
+                "controller store %r unavailable (%s); running without "
+                "durability", persist_path, e,
+            )
+            self._store = None
         self._dirty = False
         self.nodes: Dict[str, NodeInfo] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
@@ -77,20 +90,13 @@ class Controller:
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
 
     def load_persisted(self):
-        if not self._persist_path:
-            return
-        import base64
-        import json
-        import os
-
-        if not os.path.exists(self._persist_path):
+        if self._store is None:
             return
         try:
-            with open(self._persist_path) as f:
-                snap = json.load(f)
-            self.kv = {
-                k: base64.b64decode(v) for k, v in snap.get("kv", {}).items()
-            }
+            snap = self._store.load()
+            if snap is None:
+                return
+            self.kv = dict(snap.get("kv", {}))
             self.jobs = snap.get("jobs", {})
             for job in self.jobs.values():
                 # every driver of the previous incarnation is gone
@@ -99,10 +105,12 @@ class Controller:
                 if job.get("status") == "RUNNING":
                     job["status"] = "DEAD"
             logger.info(
-                "controller rehydrated %d kv keys, %d jobs from %s",
-                len(self.kv), len(self.jobs), self._persist_path,
+                "controller rehydrated %d kv keys, %d jobs via %s",
+                len(self.kv), len(self.jobs),
+                type(self._store).__name__,
             )
-        except (OSError, ValueError, KeyError) as e:
+        except Exception as e:  # noqa: BLE001 — rehydration is
+            # best-effort; a corrupt store must not block boot
             logger.warning("controller state rehydration failed: %s", e)
 
     def _mark_dirty(self):
@@ -112,26 +120,20 @@ class Controller:
         """Synchronous snapshot write; clears dirty only on success so
         failed writes retry on the next tick.  Called by the loop and at
         daemon shutdown (the last debounce window must not be lost)."""
-        import base64
-        import json
-        import os
-
-        if not self._persist_path:
+        if self._store is None:
             return False
         try:
-            kv_enc = {}
+            kv = {}
             for k, v in self.kv.items():
                 if not isinstance(v, (bytes, bytearray)):
                     import cloudpickle
 
                     v = cloudpickle.dumps(v)  # kv contract is bytes, but
                     # the store must never be the thing that breaks
-                kv_enc[k] = base64.b64encode(bytes(v)).decode()
-            snap = {"kv": kv_enc, "jobs": self.jobs, "ts": time.time()}
-            tmp = self._persist_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(snap, f, default=str)
-            os.replace(tmp, self._persist_path)
+                kv[k] = bytes(v)
+            self._store.save(
+                {"kv": kv, "jobs": self.jobs, "ts": time.time()}
+            )
             self._dirty = False
             return True
         except Exception as e:  # noqa: BLE001 — persistence must never
@@ -148,7 +150,7 @@ class Controller:
                 self.flush_snapshot()
 
     def start_health_checks(self):
-        if self._persist_path:
+        if self._store is not None:
             # hold the reference: the loop keeps only weak refs to tasks
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())

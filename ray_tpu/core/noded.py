@@ -158,8 +158,12 @@ class NodeDaemon:
             from ray_tpu.core.controller import Controller
             from ray_tpu.core.placement import PlacementGroupManager
 
+            # operators pick the durability tier via the store URL
+            # (sqlite:///..., memory://, a file path); default = a
+            # session-local file (reference: in-memory vs Redis
+            # StoreClient choice at GCS boot)
             self.controller = Controller(
-                persist_path=os.path.join(
+                persist_path=self.cfg.controller_store_url or os.path.join(
                     self.session_dir, "controller_state.json"
                 )
             )

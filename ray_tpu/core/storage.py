@@ -1,0 +1,164 @@
+"""Pluggable controller-state storage (the GCS StoreClient seam).
+
+Reference: `src/ray/gcs/store_client/` — `StoreClient` with in-memory,
+file-system, and Redis backends behind one interface
+(`store_client.h`, `redis_store_client.h:106`), which is what makes
+GCS fault tolerance a deployment choice rather than a code path.
+
+Here the durable unit is the controller SNAPSHOT (kv + jobs): backends
+implement atomic save/load of one snapshot dict
+
+    {"kv": {str: bytes}, "jobs": {str: dict}, "ts": float}
+
+- ``FileStoreClient``: json + base64, atomic rename (the default —
+  survives head-process restart on one machine),
+- ``SqliteStoreClient``: a real database file (WAL-free single-row
+  blob), the durable tier playing the reference's Redis role for
+  shared/network volumes,
+- ``MemoryStoreClient``: an in-process snapshot holder for TESTING
+  the seam (the reference's in-memory default).
+
+`store_client_for(url)` picks by scheme: bare paths and ``file://``
+map to file, ``sqlite://`` to sqlite; ``memory://`` resolves to None —
+"no durability" means the controller skips the persist loop entirely
+rather than serializing snapshots nobody can ever load.  Custom
+backends register via `register_store_scheme`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import pickle
+import sqlite3
+import time
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+Snapshot = Dict[str, Any]
+
+
+class StoreClient:
+    def load(self) -> Optional[Snapshot]:
+        """Latest snapshot, or None when nothing was stored."""
+        raise NotImplementedError
+
+    def save(self, snapshot: Snapshot) -> None:
+        """Durably replace the stored snapshot; raise on failure."""
+        raise NotImplementedError
+
+
+class MemoryStoreClient(StoreClient):
+    def __init__(self):
+        self._snap: Optional[Snapshot] = None
+
+    def load(self) -> Optional[Snapshot]:
+        return self._snap
+
+    def save(self, snapshot: Snapshot) -> None:
+        self._snap = dict(snapshot)
+
+
+class FileStoreClient(StoreClient):
+    """json+base64 with atomic rename (the original controller
+    persistence format — existing snapshot files keep loading)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[Snapshot]:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path) as f:
+            raw = json.load(f)
+        return {
+            "kv": {
+                k: base64.b64decode(v)
+                for k, v in raw.get("kv", {}).items()
+            },
+            "jobs": raw.get("jobs", {}),
+            "ts": raw.get("ts", 0.0),
+        }
+
+    def save(self, snapshot: Snapshot) -> None:
+        enc = {
+            "kv": {
+                k: base64.b64encode(bytes(v)).decode()
+                for k, v in snapshot.get("kv", {}).items()
+            },
+            "jobs": snapshot.get("jobs", {}),
+            "ts": snapshot.get("ts", time.time()),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(enc, f, default=str)
+        os.replace(tmp, self.path)
+
+
+class SqliteStoreClient(StoreClient):
+    """Single-row pickled snapshot in a sqlite file: transactional
+    durability from the database, concurrent-reader safe.  A fresh
+    connection per op keeps it thread-agnostic (saves come from the
+    flush tick AND the shutdown path)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with self._conn() as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS snapshot ("
+                "id INTEGER PRIMARY KEY CHECK (id = 1), data BLOB)"
+            )
+
+    def _conn(self):
+        return sqlite3.connect(self.path, timeout=10)
+
+    def load(self) -> Optional[Snapshot]:
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT data FROM snapshot WHERE id = 1"
+            ).fetchone()
+        return pickle.loads(row[0]) if row else None
+
+    def save(self, snapshot: Snapshot) -> None:
+        blob = pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._conn() as c:
+            c.execute(
+                "INSERT INTO snapshot (id, data) VALUES (1, ?) "
+                "ON CONFLICT (id) DO UPDATE SET data = excluded.data",
+                (blob,),
+            )
+
+
+_SCHEMES: Dict[str, Callable[[str], Optional[StoreClient]]] = {
+    "file": FileStoreClient,
+    "sqlite": SqliteStoreClient,
+    "memory": lambda _path: None,  # explicit no-durability choice
+}
+
+
+def register_store_scheme(scheme: str,
+                          factory: Callable[[str], StoreClient]) -> None:
+    _SCHEMES[scheme] = factory
+
+
+def store_client_for(url: Optional[str]) -> Optional[StoreClient]:
+    """None/empty -> no persistence; bare path -> file; else by
+    scheme ('sqlite:///var/rt/state.db', 'memory://', ...)."""
+    if not url:
+        return None
+    if "://" not in url:
+        return FileStoreClient(url)
+    scheme, _, rest = url.partition("://")
+    factory = _SCHEMES.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"unknown controller store scheme {scheme!r}; "
+            f"registered: {sorted(_SCHEMES)}"
+        )
+    return factory(rest)
