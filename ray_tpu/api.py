@@ -273,10 +273,12 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 options: Optional[Dict[str, Any]] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._options = options or {}
 
     def remote(self, *args, **kwargs):
         n = self._num_returns
@@ -285,7 +287,8 @@ class ActorMethod:
         ):
             n = "streaming"
         out = get_runtime().submit_actor_task(
-            self._handle, self._name, list(args), kwargs, num_returns=n,
+            self._handle, self._name, list(args), kwargs,
+            **{**self._options, "num_returns": n},
         )
         if n == "streaming":
             return out  # ObjectRefGenerator
@@ -294,12 +297,21 @@ class ActorMethod:
     def bind(self, *args, **kwargs):
         """Build a compiled-graph node instead of executing (reference:
         `dag/dag_node.py:29` DAGNode.bind)."""
+        if self._options or self._num_returns != 1:
+            raise ValueError(
+                "per-call .options(...) are not supported on .bind(): "
+                "compiled-graph nodes execute through channels, not the "
+                "task path the options configure"
+            )
         from ray_tpu.dag.dag_node import ClassMethodNode
 
         return ClassMethodNode(self._handle, self._name, args, kwargs)
 
-    def options(self, num_returns: int = 1, **_opts):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1, **opts):
+        """Per-call overrides (reference: actor method `.options()`);
+        `max_retries` additionally opts the call's returns into lineage
+        reconstruction (same gate as max_task_retries on the actor)."""
+        return ActorMethod(self._handle, self._name, num_returns, opts)
 
 
 class ActorHandle:

@@ -1062,10 +1062,13 @@ class Runtime:
             for oid in spec.return_ids():
                 self.objects[oid.binary()] = _ObjectState(ready=asyncio.Event())
                 # actor-task returns reconstruct by re-executing the
-                # method on the (live) actor — same lineage machinery
-                # as normal tasks (reference: actor task resubmission,
-                # `task_manager.h` lineage for actor children)
-                self.lineage[oid.binary()] = spec
+                # method on the (live) actor — but ONLY when the call
+                # opted into retries: re-running a non-idempotent method
+                # behind the user's back can double-apply side effects
+                # (reference: actor outputs are reconstructable only
+                # with max_task_retries > 0, `task_manager.h` lineage)
+                if spec.max_retries > 0:
+                    self.lineage[oid.binary()] = spec
                 self._add_local_ref(oid.binary())
                 refs.append(ObjectRef(oid, self.address, _register=True))
             if num_returns == STREAMING:
@@ -2142,12 +2145,35 @@ class Runtime:
                     # different task
                     with self._state_lock:
                         self._task_threads[tid] = threading.get_ident()
+                    committed = False
+                    value = None
                     try:
-                        with _tracing.execution_span(spec.name, trace_ctx):
-                            return fn(*args, **kwargs)
-                    finally:
+                        try:
+                            with _tracing.execution_span(spec.name, trace_ctx):
+                                value = fn(*args, **kwargs)
+                                committed = True
+                            return value
+                        finally:
+                            # after this pop no NEW cancel can be
+                            # delivered (raise and pop share the lock)
+                            with self._state_lock:
+                                self._task_threads.pop(tid, None)
+                    except exc.TaskCancelledError:
+                        # async-raised cancels land at an arbitrary later
+                        # bytecode boundary: one delivered anywhere after
+                        # fn() completed (span exit, the pop above) must
+                        # not turn the finished task into a cancellation.
+                        # A residual window remains between fn returning
+                        # and `committed = True` — the raise cannot be
+                        # made atomic with the call's last bytecode.
                         with self._state_lock:
+                            # the cancel may have aborted the finally
+                            # BETWEEN lock acquire and pop: re-pop so no
+                            # stale tid->ident mapping survives
                             self._task_threads.pop(tid, None)
+                        if committed:
+                            return value
+                        raise
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
             if spec.is_streaming:

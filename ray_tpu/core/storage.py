@@ -28,6 +28,7 @@ backends register via `register_store_scheme`.
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import logging
 import os
@@ -109,17 +110,24 @@ class SqliteStoreClient(StoreClient):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with self._conn() as c:
+        with self._tx() as c:
             c.execute(
                 "CREATE TABLE IF NOT EXISTS snapshot ("
                 "id INTEGER PRIMARY KEY CHECK (id = 1), data BLOB)"
             )
 
-    def _conn(self):
-        return sqlite3.connect(self.path, timeout=10)
+    @contextlib.contextmanager
+    def _tx(self):
+        # one fresh connection per op (thread-agnostic); 'with conn'
+        # only wraps the transaction — the handle must be closed
+        # explicitly or every op leaks a file descriptor
+        with contextlib.closing(
+            sqlite3.connect(self.path, timeout=10)
+        ) as conn, conn:
+            yield conn
 
     def load(self) -> Optional[Snapshot]:
-        with self._conn() as c:
+        with self._tx() as c:
             row = c.execute(
                 "SELECT data FROM snapshot WHERE id = 1"
             ).fetchone()
@@ -127,7 +135,7 @@ class SqliteStoreClient(StoreClient):
 
     def save(self, snapshot: Snapshot) -> None:
         blob = pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)
-        with self._conn() as c:
+        with self._tx() as c:
             c.execute(
                 "INSERT INTO snapshot (id, data) VALUES (1, ?) "
                 "ON CONFLICT (id) DO UPDATE SET data = excluded.data",

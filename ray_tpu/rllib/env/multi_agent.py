@@ -105,6 +105,14 @@ class MultiAgentEnvRunner:
         self._env = make_multi_agent_env(env, **(env_kwargs or {}))
         self._T = rollout_length
         self._map = dict(policy_mapping)  # agent_id -> module_id
+        # Per-module lane index for each agent: rows of agents sharing a
+        # module interleave per env step, so GAE must recurse per lane.
+        self._lane: Dict[str, int] = {}
+        lanes_per_mod: Dict[str, int] = {}
+        for agent in sorted(self._map):
+            mid = self._map[agent]
+            self._lane[agent] = lanes_per_mod.get(mid, 0)
+            lanes_per_mod[mid] = self._lane[agent] + 1
         self._rng = np.random.default_rng(seed + 1)
         self._obs, _ = self._env.reset(seed=seed)
         self._params: Dict[str, Any] = {}
@@ -132,7 +140,7 @@ class MultiAgentEnvRunner:
         assert self._params, "set_weights before sample"
         traj: Dict[str, Dict[str, list]] = {
             m: {"obs": [], "actions": [], "logp": [], "values": [],
-                "rewards": [], "dones": []}
+                "rewards": [], "dones": [], "agent_lane": []}
             for m in set(self._map.values())
         }
         obs = self._obs
@@ -165,6 +173,7 @@ class MultiAgentEnvRunner:
                 t["dones"].append(
                     done or bool(term.get(agent)) or bool(trunc.get(agent))
                 )
+                t["agent_lane"].append(self._lane[agent])
             self._ep_return += float(np.mean(list(rewards.values())))
             if done:
                 self._completed.append({
@@ -185,6 +194,7 @@ class MultiAgentEnvRunner:
                 "values": np.asarray(t["values"], np.float32),
                 "rewards": np.asarray(t["rewards"], np.float32),
                 "dones": np.asarray(t["dones"], np.bool_),
+                "agent_lane": np.asarray(t["agent_lane"], np.int32),
             }
         return out
 
@@ -198,20 +208,30 @@ class MultiAgentEnvRunner:
 
 def multi_agent_gae(batch: Dict[str, np.ndarray], gamma: float,
                     lambda_: float) -> Tuple[np.ndarray, np.ndarray]:
-    """GAE over a flat per-module lane: `dones` cut the recursion (the
-    tail of an unfinished trajectory bootstraps with V=0 — acceptable
-    bias for short-episode benchmarks; reference episodes carry their
-    own bootstrap values)."""
+    """GAE over a per-module batch whose rows interleave agents per env
+    step.  `agent_lane` (when present) segments rows into per-agent
+    lanes so the recursion only chains an agent's own transitions;
+    within a lane, `dones` cut episodes.  The tail of an unfinished
+    trajectory bootstraps with V=0 — acceptable bias for short-episode
+    benchmarks; reference episodes carry their own bootstrap values.
+    Advantages are returned in the original row order."""
     rewards, values = batch["rewards"], batch["values"]
     dones = batch["dones"].astype(np.float32)
+    lanes = batch.get("agent_lane")
     n = len(rewards)
     adv = np.zeros(n, np.float32)
-    gae = 0.0
-    next_value = 0.0
-    for t in range(n - 1, -1, -1):
-        nonterminal = 1.0 - dones[t]
-        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
-        gae = delta + gamma * lambda_ * nonterminal * gae
-        adv[t] = gae
-        next_value = values[t]
+    if lanes is None:
+        lane_rows = [range(n - 1, -1, -1)]
+    else:
+        lane_rows = [np.nonzero(lanes == lane)[0][::-1]
+                     for lane in np.unique(lanes)]
+    for rows in lane_rows:
+        gae = 0.0
+        next_value = 0.0
+        for t in rows:
+            nonterminal = 1.0 - dones[t]
+            delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+            gae = delta + gamma * lambda_ * nonterminal * gae
+            adv[t] = gae
+            next_value = values[t]
     return adv, adv + values

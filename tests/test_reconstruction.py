@@ -92,10 +92,35 @@ def test_put_objects_are_not_reconstructable(rt_start):
 
 
 def test_actor_result_reconstruction(rt_start):
-    Holder = rt.remote(_Holder)
+    # reconstruction of actor outputs is opt-in via max_task_retries
+    # (re-running a method can double-apply side effects)
+    Holder = rt.remote(_Holder).options(max_task_retries=1)
     h = Holder.remote()
     ref = h.make.remote(9)
     assert int(rt.get(ref)[0]) == 9
     _delete_local_copy(ref)
     again = rt.get(ref)
     assert int(again[0]) == 9
+
+
+def test_actor_result_reconstruction_per_call_opt_in(rt_start):
+    # .options(max_retries=1) on the METHOD call opts its returns into
+    # lineage even when the actor itself has max_task_retries=0
+    Holder = rt.remote(_Holder)
+    h = Holder.remote()
+    ref = h.make.options(max_retries=1).remote(6)
+    assert int(rt.get(ref)[0]) == 6
+    _delete_local_copy(ref)
+    assert int(rt.get(ref)[0]) == 6
+
+
+def test_actor_result_not_reconstructable_without_retries(rt_start):
+    # default max_task_retries=0: a lost actor return must surface
+    # ObjectLostError, never silently re-execute the method
+    Holder = rt.remote(_Holder)
+    h = Holder.remote()
+    ref = h.make.remote(4)
+    assert int(rt.get(ref)[0]) == 4
+    _delete_local_copy(ref)
+    with pytest.raises(exc.ObjectLostError):
+        rt.get(ref)

@@ -404,6 +404,55 @@ def test_multi_agent_runner_demultiplexes():
         assert batch["dones"].sum() >= 1  # episodes of length 10
 
 
+def _single_lane_gae(rewards, values, dones, gamma, lam):
+    n = len(rewards)
+    adv = np.zeros(n, np.float32)
+    gae, next_value = 0.0, 0.0
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - float(dones[t])
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        gae = delta + gamma * lam * nonterminal * gae
+        adv[t] = gae
+        next_value = values[t]
+    return adv
+
+
+def test_multi_agent_gae_segments_per_agent_lane():
+    """Rows of agents sharing a module interleave per env step; the GAE
+    recursion must chain only an agent's OWN transitions (a flat pass
+    would bootstrap agent 0 from agent 1's value and apply gamma^2 per
+    timestep)."""
+    from ray_tpu.rllib.env.multi_agent import multi_agent_gae
+
+    rng = np.random.default_rng(0)
+    T, gamma, lam = 12, 0.9, 0.8
+    lanes = {}
+    for lane in (0, 1):
+        dones = np.zeros(T, np.bool_)
+        dones[5] = dones[T - 1] = True
+        lanes[lane] = {
+            "rewards": rng.normal(size=T).astype(np.float32),
+            "values": rng.normal(size=T).astype(np.float32),
+            "dones": dones,
+        }
+    # interleave rows per step: a0_t, a1_t, a0_t+1, a1_t+1, ...
+    batch = {
+        k: np.stack([lanes[lane][k][t] for t in range(T)
+                     for lane in (0, 1)])
+        for k in ("rewards", "values", "dones")
+    }
+    batch["agent_lane"] = np.array([lane for _ in range(T)
+                                    for lane in (0, 1)], np.int32)
+    adv, tgt = multi_agent_gae(batch, gamma, lam)
+    for lane in (0, 1):
+        expect = _single_lane_gae(
+            lanes[lane]["rewards"], lanes[lane]["values"],
+            lanes[lane]["dones"], gamma, lam,
+        )
+        np.testing.assert_allclose(adv[lane::2], expect, rtol=1e-5)
+    np.testing.assert_allclose(tgt, adv + batch["values"], rtol=1e-6)
+
+
 def test_multi_agent_ppo_learns_coordination(cluster):
     from ray_tpu.rllib import MultiAgentPPOConfig
 
