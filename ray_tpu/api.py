@@ -307,11 +307,16 @@ class ActorMethod:
 
         return ClassMethodNode(self._handle, self._name, args, kwargs)
 
-    def options(self, num_returns: int = 1, **opts):
+    def options(self, num_returns: Optional[int] = None, **opts):
         """Per-call overrides (reference: actor method `.options()`);
         `max_retries` additionally opts the call's returns into lineage
-        reconstruction (same gate as max_task_retries on the actor)."""
-        return ActorMethod(self._handle, self._name, num_returns, opts)
+        reconstruction (same gate as max_task_retries on the actor).
+        Chained calls merge, like RemoteFunction/ActorClass options."""
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            {**self._options, **opts},
+        )
 
 
 class ActorHandle:

@@ -13,6 +13,7 @@ from ray_tpu.parallel.moe import MoEConfig, init_moe, moe_forward
 from ray_tpu.parallel.pipeline import pipeline_apply, stage_sharding
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
+    optimizer_shardings,
     shard_params,
     sharding_from_logical,
     spec_from_logical,
@@ -30,6 +31,7 @@ __all__ = [
     "local_batch_size",
     "mesh_from_devices",
     "moe_forward",
+    "optimizer_shardings",
     "pipeline_apply",
     "replicated",
     "shard_params",

@@ -69,3 +69,37 @@ def infer_logical_like(params: Any, fallback=()) -> Any:
     """Fully-replicated logical tree matching `params` (for opt state
     scalars and anything without an annotation)."""
     return jax.tree.map(lambda _: tuple(fallback), params)
+
+
+def optimizer_shardings(mesh: Mesh, opt, params: Any,
+                        param_shardings: Any) -> Any:
+    """Shardings for `opt.init(params)` state: a state leaf whose tree
+    path ends with a parameter's path (optax state like Adam's mu/nu
+    embeds the param tree) inherits that parameter's sharding; scalars
+    and anything unrecognized replicate.  This is the ZeRO rule that
+    keeps optimizer state sharded alongside fsdp params (SURVEY §2.5) —
+    and it pins the state to the GLOBAL mesh device set, which matters
+    under multi-process runtimes: a bare `jit(opt.init)` constant-folds
+    the zeros and parks them uncommitted on the local default device.
+    """
+    state_shapes = jax.eval_shape(opt.init, params)
+    p_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    s_leaves = jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+    by_path = {
+        jax.tree_util.keystr(pp): (tuple(pl.shape), sl)
+        for (pp, pl), (_, sl) in zip(p_leaves, s_leaves)
+    }
+    replicated_ = NamedSharding(mesh, P())
+
+    def pick(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        # longest matching suffix wins: a short param path (e.g. "['w']")
+        # can also be a suffix of a deeper, differently-sharded one
+        best = None
+        for p_ks, (shape, sh) in by_path.items():
+            if ks.endswith(p_ks) and tuple(leaf.shape) == shape:
+                if best is None or len(p_ks) > len(best[0]):
+                    best = (p_ks, sh)
+        return best[1] if best is not None else replicated_
+
+    return jax.tree_util.tree_map_with_path(pick, state_shapes)

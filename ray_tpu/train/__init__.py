@@ -24,6 +24,7 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.sharded_checkpoint import load_sharded, save_sharded
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.trainer import (
     BaseTrainer,
@@ -58,5 +59,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "load_sharded",
     "report",
+    "save_sharded",
 ]
