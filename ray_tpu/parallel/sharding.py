@@ -86,20 +86,19 @@ def optimizer_shardings(mesh: Mesh, opt, params: Any,
     p_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
     s_leaves = jax.tree_util.tree_flatten_with_path(param_shardings)[0]
     by_path = {
-        jax.tree_util.keystr(pp): (tuple(pl.shape), sl)
+        tuple(pp): (tuple(pl.shape), sl)
         for (pp, pl), (_, sl) in zip(p_leaves, s_leaves)
     }
     replicated_ = NamedSharding(mesh, P())
 
     def pick(path, leaf):
-        ks = jax.tree_util.keystr(path)
-        # longest matching suffix wins: a short param path (e.g. "['w']")
-        # can also be a suffix of a deeper, differently-sharded one
-        best = None
-        for p_ks, (shape, sh) in by_path.items():
-            if ks.endswith(p_ks) and tuple(leaf.shape) == shape:
-                if best is None or len(p_ks) > len(best[0]):
-                    best = (p_ks, sh)
-        return best[1] if best is not None else replicated_
+        # longest matching path suffix wins (a short param path like
+        # ('w',) can also be a suffix of a deeper, differently-sharded
+        # one); O(depth) dict probes per state leaf
+        for i in range(len(path)):
+            hit = by_path.get(tuple(path[i:]))
+            if hit is not None and tuple(leaf.shape) == hit[0]:
+                return hit[1]
+        return replicated_
 
     return jax.tree_util.tree_map_with_path(pick, state_shapes)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -60,7 +60,12 @@ def save_sharded(tree: Any, dir_: str) -> None:
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     pieces: Dict[str, np.ndarray] = {}
     index: List[Dict[str, Any]] = []
-    manifest: Dict[str, Any] = {"version": 1, "leaves": {}}
+    # num_processes lets the loader ignore stale rank files left in a
+    # reused directory by an earlier, larger-world save (no barrier to
+    # clean them here without racing concurrent writers)
+    manifest: Dict[str, Any] = {
+        "version": 1, "leaves": {}, "num_processes": jax.process_count(),
+    }
     aux: Dict[str, Any] = {}
     n = 0
     for path, leaf in leaves:
@@ -116,12 +121,16 @@ def _overlap(dst_sl: Tuple[slice, ...], start: List[int],
 
 
 class _PieceReader:
-    def __init__(self, dir_: str):
+    def __init__(self, dir_: str, num_processes: Optional[int] = None):
         self._dir = dir_
         self._npz: Dict[str, Any] = {}
         # leaf key -> [(rank_file, piece_key, start, shape)]
         self.by_leaf: Dict[str, List] = {}
         for fn in sorted(os.listdir(dir_)):
+            if num_processes is not None and fn.startswith("pieces_r"):
+                rank = int(fn[len("pieces_r"):].split(".")[0])
+                if rank >= num_processes:
+                    continue  # stale file from an earlier larger save
             if fn.startswith("pieces_r") and fn.endswith(".json"):
                 with open(os.path.join(dir_, fn)) as f:
                     for ent in json.load(f):
@@ -183,7 +192,7 @@ def load_sharded(dir_: str, target: Any) -> Any:
     if os.path.exists(os.path.join(dir_, _AUX)):
         with open(os.path.join(dir_, _AUX), "rb") as f:
             aux = pickle.load(f)
-    reader = _PieceReader(dir_)
+    reader = _PieceReader(dir_, manifest.get("num_processes"))
 
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
     out = []

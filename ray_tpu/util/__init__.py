@@ -2,6 +2,7 @@
 
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
+    multislice_placement_groups,
     PlacementGroup,
     placement_group,
     placement_group_table,
@@ -13,6 +14,7 @@ __all__ = [
     "ActorPool",
     "PlacementGroup",
     "Queue",
+    "multislice_placement_groups",
     "placement_group",
     "placement_group_table",
     "remove_placement_group",

@@ -80,6 +80,69 @@ def placement_group(
     return PlacementGroup(pg_id, bundles, strategy)
 
 
+def multislice_placement_groups(
+    n_slices: int,
+    bundles_per_slice: int,
+    resources_per_bundle: Dict[str, float],
+    head_resource: Optional[str] = None,
+    timeout: Optional[float] = 120.0,
+) -> List[PlacementGroup]:
+    """The runtime counterpart of ``MeshSpec(slices=N)``: one
+    STRICT_PACK placement group per ICI slice, so each slice's worker
+    gang lands wholly inside one `tpu-slice` label domain and the
+    compiler mesh and runtime placement agree (SURVEY §7: "compiler
+    mesh vs runtime PGs must agree").
+
+    `head_resource` (e.g. the per-slice ``TPU-v5e-16-head`` gang
+    resource that `accelerators.py` publishes on worker 0 of each
+    slice — reference analog `_private/accelerators/tpu.py:381`) is
+    charged once per group to pin distinct groups to DISTINCT slices;
+    without it two groups may pack into one large slice.
+
+    All-or-nothing: if any group fails to reserve before the shared
+    `timeout` deadline — or anything raises mid-way — every group is
+    removed before this returns/raises.  Reservation itself is
+    sequential (the same per-PG two-phase commit the reference's GCS
+    uses), so two callers racing for the same slices can each hold a
+    partial gang until the deadline; stagger concurrent multislice
+    jobs or front them with a queue.
+    """
+    if n_slices < 1 or bundles_per_slice < 1:
+        raise ValueError("n_slices and bundles_per_slice must be >= 1")
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    pgs: List[PlacementGroup] = []
+    try:
+        for _ in range(n_slices):
+            bundles = [
+                dict(resources_per_bundle) for _ in range(bundles_per_slice)
+            ]
+            if head_resource:
+                bundles[0][head_resource] = bundles[0].get(head_resource, 0) + 1
+            pgs.append(placement_group(bundles, strategy="STRICT_PACK"))
+        for pg in pgs:
+            remaining = (
+                None if deadline is None
+                else max(0.0, deadline - _time.monotonic())
+            )
+            if not pg.ready(timeout=remaining):
+                from ray_tpu import exceptions as exc
+
+                raise exc.RayTpuError(
+                    f"could not reserve {n_slices} x {bundles_per_slice} "
+                    f"slice-aligned bundles {resources_per_bundle}"
+                )
+    except BaseException:
+        for pg in pgs:
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+        raise
+    return pgs
+
+
 def remove_placement_group(pg: PlacementGroup) -> None:
     get_runtime().controller_call("remove_placement_group", {"pg_id": pg.id})
 

@@ -12,6 +12,7 @@ import pytest
 import ray_tpu as rt
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.util import (
+    multislice_placement_groups,
     placement_group,
     placement_group_table,
     remove_placement_group,
@@ -20,16 +21,19 @@ from ray_tpu.util import (
 
 @pytest.fixture()
 def slice_cluster():
-    """Two 2-host 'slices' (4 chips per host) + the unlabeled head."""
+    """Two 2-host 'slices' (4 chips per host) + the unlabeled head.
+    Host 0 of each slice carries the per-slice head gang resource the
+    TPU detector publishes (`accelerators.py`, ref tpu.py:381)."""
     if rt.is_initialized():
         rt.shutdown()
     c = Cluster(initialize_head=True,
                 head_node_args={"num_cpus": 1, "num_workers": 1})
     c.connect()
     for slice_name in ("slice-a", "slice-b"):
-        for _ in range(2):
+        for host in range(2):
             c.add_node(num_cpus=4, num_tpus=4, num_workers=2,
-                       labels={"tpu-slice": slice_name})
+                       labels={"tpu-slice": slice_name},
+                       resources={"TPU-v5e-8-head": 1.0} if host == 0 else None)
     c.wait_for_nodes()
     yield c
     c.shutdown()
@@ -84,3 +88,39 @@ def test_strict_spread_uses_distinct_nodes(slice_cluster):
     assert pg.ready(timeout=120)
     assert len(set(_pg_entry(pg)["bundle_nodes"])) == 4
     remove_placement_group(pg)
+
+
+def test_multislice_pgs_land_on_distinct_slices(slice_cluster):
+    """The runtime counterpart of MeshSpec(slices=2): one STRICT_PACK
+    group per slice, head-resource pinned so the two groups occupy
+    DIFFERENT tpu-slice domains — runtime placement agrees with the
+    2-slice compiler mesh."""
+    pgs = multislice_placement_groups(
+        2, 2, {"TPU": 2, "CPU": 1}, head_resource="TPU-v5e-8-head",
+    )
+    labels = _node_labels()
+    seen = []
+    for pg in pgs:
+        nodes = _pg_entry(pg)["bundle_nodes"]
+        slices = {labels[nid].get("tpu-slice") for nid in nodes}
+        assert len(slices) == 1  # each gang inside ONE ICI domain
+        seen.append(slices.pop())
+    assert set(seen) == {"slice-a", "slice-b"}  # distinct slices
+    for pg in pgs:
+        remove_placement_group(pg)
+
+
+def test_multislice_pgs_all_or_nothing(slice_cluster):
+    """Infeasible demand (3 slices on a 2-slice cluster) must fail as a
+    unit and leave nothing reserved."""
+    with pytest.raises(rt.exceptions.RayTpuError):
+        multislice_placement_groups(
+            3, 2, {"TPU": 2, "CPU": 1},
+            head_resource="TPU-v5e-8-head", timeout=1.0,
+        )
+    # nothing left behind: the full capacity is still reservable
+    pgs = multislice_placement_groups(
+        2, 2, {"TPU": 2, "CPU": 1}, head_resource="TPU-v5e-8-head",
+    )
+    for pg in pgs:
+        remove_placement_group(pg)

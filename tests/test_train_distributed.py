@@ -236,6 +236,32 @@ def test_sharded_checkpoint_reshards_across_mesh_shapes(tmp_path):
     assert out["w"].sharding.spec == P(("dp", "fsdp"), None)
 
 
+def test_sharded_checkpoint_ignores_stale_rank_files(tmp_path):
+    """A reused directory may hold piece files from an earlier save by
+    MORE processes; the manifest's process count must fence them out."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train.sharded_checkpoint import load_sharded, save_sharded
+
+    mesh = MeshSpec(dp=8).build(jax.devices()[:8])
+    sh = NamedSharding(mesh, P("dp"))
+    d = str(tmp_path / "ck")
+    save_sharded({"w": jax.device_put(jnp.arange(8.0), sh)}, d)
+    # forge a stale rank-1 piece carrying WRONG data for the same leaf
+    with open(os.path.join(d, "pieces_r00001.json"), "w") as f:
+        json.dump([{"key": "p0", "leaf": "['w']", "start": [0],
+                    "shape": [8]}], f)
+    np.savez(os.path.join(d, "pieces_r00001.npz"),
+             p0=np.full(8, 99.0, np.float32))
+    out = load_sharded(d, {"w": jax.device_put(jnp.zeros(8), sh)})
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
+
+
 def test_sharded_checkpoint_missing_leaf_and_shape_mismatch(tmp_path):
     import jax
     import jax.numpy as jnp
