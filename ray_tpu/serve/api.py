@@ -176,6 +176,10 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
                         namespace=CONTROLLER_NAMESPACE,
                         max_concurrency=16,
                         num_cpus=0,
+                        # effectively infinite: a crashed controller
+                        # restarts and rehydrates from its KV checkpoint
+                        # (reference: `controller.py:81-91` recovery)
+                        max_restarts=1_000_000_000,
                     )
                     .remote()
                 )
@@ -472,6 +476,18 @@ def shutdown():
             rt.kill(controller)
         except Exception:
             pass
+    # clear the FT snapshot only once the controller is dead: its own
+    # _checkpoint calls would recreate the key, and a timed-out teardown
+    # must not leave a snapshot that resurrects deleted apps on the next
+    # serve.start()
+    try:
+        from ray_tpu.core.runtime import get_runtime, is_initialized
+        from ray_tpu.serve.controller import STATE_KV_KEY
+
+        if is_initialized():
+            get_runtime().kv_del(STATE_KV_KEY)
+    except Exception:
+        pass
     from ray_tpu.serve import handle as _h
 
     with _h._routers_lock:

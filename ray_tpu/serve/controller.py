@@ -74,6 +74,9 @@ class _DeploymentState:
         }
 
 
+STATE_KV_KEY = "serve:controller:state"
+
+
 class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
@@ -83,11 +86,123 @@ class ServeController:
         # stream chunked instead of buffering)
         self._ingress_streaming: Dict[str, bool] = {}
         self._routes: Dict[str, str] = {}  # route prefix -> app name
+        # pushed handle metrics: (app, dep) -> router_id -> (ts, {rid: n})
+        self._handle_metrics: Dict[tuple, Dict[str, tuple]] = {}
         self._stop = threading.Event()
+        self._recover()
         self._thread = threading.Thread(
             target=self._control_loop, daemon=True, name="serve-controller"
         )
         self._thread.start()
+
+    # -- fault tolerance ----------------------------------------------
+    # Reference: the controller checkpoints every state change to the
+    # GCS KV and rehydrates on restart (`serve/_private/controller.py:
+    # 81-91` + `application_state.py` recovering from checkpoints).
+    # Replica actors are NAMED, survive a controller crash (no
+    # owner-kill in this runtime), and get re-adopted by name.
+    def _snapshot_bytes(self) -> bytes:
+        import cloudpickle
+
+        with self._lock:
+            apps = {}
+            for app_name, deployments in self._apps.items():
+                apps[app_name] = [
+                    {
+                        "name": ds.name,
+                        "callable_def": ds.callable_def,
+                        "init_args": tuple(ds.init_args),
+                        "init_kwargs": dict(ds.init_kwargs),
+                        "config": ds.config,
+                        "resources": dict(ds.resources),
+                        "target_replicas": ds.target_replicas,
+                        "version": ds.version,
+                        "next_replica_idx": ds.next_replica_idx,
+                        "replica_ids": list(ds.replicas),
+                    }
+                    for ds in deployments.values()
+                ]
+            state = {
+                "apps": apps,
+                "ingress": dict(self._ingress),
+                "ingress_streaming": dict(self._ingress_streaming),
+                "routes": dict(self._routes),
+            }
+        return cloudpickle.dumps(state)
+
+    def _checkpoint(self):
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            get_runtime().kv_put(STATE_KV_KEY, self._snapshot_bytes())
+        except Exception:
+            traceback.print_exc()
+
+    def _recover(self):
+        import pickle
+
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            blob = get_runtime().kv_get(STATE_KV_KEY)
+        except Exception:
+            return
+        if not blob:
+            return
+        try:
+            state = pickle.loads(blob)
+        except Exception:
+            traceback.print_exc()
+            return
+        import ray_tpu as rt
+
+        try:
+            with self._lock:
+                for app_name, dep_list in state.get("apps", {}).items():
+                    deployments: Dict[str, _DeploymentState] = {}
+                    for d in dep_list:
+                        ds = _DeploymentState(
+                            app_name, d["name"], d["callable_def"],
+                            d["init_args"], d["init_kwargs"], d["config"],
+                            d["resources"],
+                        )
+                        ds.target_replicas = d["target_replicas"]
+                        # keep the saved version: routers holding it keep
+                        # their cached replica set through the re-adoption
+                        # window (re-adopted replicas are STARTING, so a
+                        # bumped version would hand routers an EMPTY
+                        # table); the STARTING->RUNNING promotion bumps
+                        # the version and triggers the refetch
+                        ds.version = d["version"]
+                        ds.next_replica_idx = d["next_replica_idx"]
+                        for rid in d["replica_ids"]:
+                            try:
+                                handle = rt.get_actor(
+                                    f"SERVE_REPLICA::{rid}",
+                                    CONTROLLER_NAMESPACE,
+                                )
+                            except Exception:
+                                continue  # gone: reconcile replaces it
+                            ds.replicas[rid] = _ReplicaState(
+                                rid, handle, ds.config.max_ongoing_requests
+                            )
+                        deployments[d["name"]] = ds
+                    self._apps[app_name] = deployments
+                self._ingress = dict(state.get("ingress", {}))
+                self._ingress_streaming = dict(
+                    state.get("ingress_streaming", {})
+                )
+                self._routes = dict(state.get("routes", {}))
+        except Exception:
+            # a poisoned/old-schema snapshot must not crash-loop the
+            # controller through its (effectively infinite) restarts:
+            # start empty instead
+            traceback.print_exc()
+            with self._lock:
+                self._apps.clear()
+                self._ingress.clear()
+                self._ingress_streaming.clear()
+                self._routes.clear()
 
     # -- deploy API ---------------------------------------------------
     def deploy_application(self, app_config: Dict[str, Any]) -> bool:
@@ -120,6 +235,9 @@ class ServeController:
                 stale.extend(prev.replicas.values())
                 prev.replicas = {}
             self._apps[app_name] = deployments
+            for key in [k for k in self._handle_metrics
+                        if k[0] == app_name and k[1] not in deployments]:
+                del self._handle_metrics[key]
             self._ingress[app_name] = app_config.get(
                 "ingress", app_config["deployments"][-1]["name"]
             )
@@ -134,6 +252,7 @@ class ServeController:
         for r in stale:
             self._stop_replica(r, timeout_s=5.0)
         self._reconcile_once()
+        self._checkpoint()
         return True
 
     def delete_application(self, app_name: str) -> bool:
@@ -142,6 +261,8 @@ class ServeController:
             self._ingress.pop(app_name, None)
             self._ingress_streaming.pop(app_name, None)
             self._routes = {k: v for k, v in self._routes.items() if v != app_name}
+            for key in [k for k in self._handle_metrics if k[0] == app_name]:
+                del self._handle_metrics[key]
             victims: List[tuple] = []
             for ds in deployments.values():
                 ds.deleted = True  # reconcile snapshots may still hold ds
@@ -152,20 +273,32 @@ class ServeController:
                 ds.replicas = {}
         for r, timeout_s in victims:
             self._stop_replica(r, timeout_s=timeout_s)
+        self._checkpoint()
         return True
 
     def shutdown(self) -> bool:
         self._stop.set()
         for app in list(self._apps):
             self.delete_application(app)
+        self._checkpoint()
         return True
 
     # -- routing ------------------------------------------------------
-    def get_routing_table(self, app_name: str, deployment_name: str):
+    def get_routing_table(self, app_name: str, deployment_name: str,
+                          router_id: Optional[str] = None,
+                          handle_metrics: Optional[Dict[str, int]] = None):
+        """Routers poll this; they piggyback their per-replica in-flight
+        counts (reference: handles PUSH metrics to the controller,
+        `autoscaling_state.py` — one RPC serves both directions instead
+        of the controller fanning out per-replica metric polls)."""
         with self._lock:
             ds = self._apps.get(app_name, {}).get(deployment_name)
             if ds is None:
                 return {"version": -1, "replicas": {}}
+            if router_id is not None and handle_metrics is not None:
+                self._handle_metrics.setdefault(
+                    (app_name, deployment_name), {}
+                )[router_id] = (time.monotonic(), dict(handle_metrics))
             return ds.routing_table()
 
     def get_app_for_route(self, path: str) -> Optional[Dict[str, str]]:
@@ -285,6 +418,8 @@ class ServeController:
                 ds.version += 1
         for r in victims:
             self._stop_replica(r, timeout_s=ds.config.graceful_shutdown_timeout_s)
+        if changed:
+            self._checkpoint()
 
     def _start_replica(self, ds: _DeploymentState):
         rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_idx}"
@@ -294,6 +429,11 @@ class ServeController:
         handle = (
             rt.remote(Replica)
             .options(
+                # named so a restarted controller can re-adopt live
+                # replicas instead of orphaning them (reference:
+                # recovery from checkpoint re-binds replica actors)
+                name=f"SERVE_REPLICA::{rid}",
+                namespace=CONTROLLER_NAMESPACE,
                 # headroom over max_ongoing_requests so control-plane
                 # methods (health checks, metrics, drain) never starve
                 # behind a full complement of user requests — the data
@@ -348,18 +488,24 @@ class ServeController:
                 ]
             if not running:
                 continue
-            refs = [r.handle.get_metrics.remote() for r in running]
-            done, _ = rt.wait(refs, num_returns=len(refs), timeout=1.0)
-            if not done:
-                # no metrics observed (busy/unreachable replicas) is not
-                # evidence of zero load — hold the current target
-                continue
-            total_ongoing = 0.0
-            for ref in done:
-                try:
-                    total_ongoing += rt.get(ref)["ongoing"]
-                except Exception:
-                    pass
+            total_ongoing = self._pushed_ongoing(ds, ac)
+            if total_ongoing is None:
+                # no router has pushed metrics recently (e.g. handles in
+                # threads that went quiet): fall back to polling the
+                # replicas directly — O(replicas) RPCs, but only on this
+                # cold path rather than every tick
+                refs = [r.handle.get_metrics.remote() for r in running]
+                done, _ = rt.wait(refs, num_returns=len(refs), timeout=1.0)
+                if not done:
+                    # no metrics observed (busy/unreachable replicas) is
+                    # not evidence of zero load — hold the current target
+                    continue
+                total_ongoing = 0.0
+                for ref in done:
+                    try:
+                        total_ongoing += rt.get(ref)["ongoing"]
+                    except Exception:
+                        pass
             now = time.monotonic()
             # smooth over look_back_period_s (reference: the autoscaling
             # policy averages handle metrics over a look-back window) so
@@ -384,3 +530,32 @@ class ServeController:
                         ds.last_scale_change = now
                 else:
                     ds.last_scale_change = now
+
+    def _pushed_ongoing(self, ds: _DeploymentState, ac) -> Optional[float]:
+        """Sum of router-pushed in-flight counts for a deployment, or
+        None when every router's report is stale (reference: handle
+        metrics drive autoscaling, `autoscaling_state.py`)."""
+        now = time.monotonic()
+        with self._lock:
+            per_router = self._handle_metrics.get((ds.app_name, ds.name))
+            if not per_router:
+                return None
+            horizon = max(2.0, ac.look_back_period_s)
+            # prune dead routers here (router ids are unique per process;
+            # without pruning the map grows for the controller's life)
+            for router_id, (ts, _counts) in list(per_router.items()):
+                if now - ts > 10 * horizon:
+                    del per_router[router_id]
+            fresh = {
+                router_id: counts
+                for router_id, (ts, counts) in per_router.items()
+                if now - ts < horizon
+            }
+            if not fresh:
+                return None
+            live = set(ds.replicas)
+            return float(sum(
+                n for counts in fresh.values()
+                for rid, n in counts.items()
+                if rid in live
+            ))

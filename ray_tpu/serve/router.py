@@ -47,6 +47,10 @@ class Router:
         self._version = -1
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        import os as _os
+        import uuid as _uuid
+
+        self._router_id = f"{_os.getpid()}-{_uuid.uuid4().hex[:6]}"
 
     # -- routing table maintenance ------------------------------------
     def _install_table(self, table):
@@ -76,15 +80,37 @@ class Router:
             or time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S
         )
 
+    def _handle_metrics(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                rid: r.local_inflight for rid, r in self._replicas.items()
+            }
+
     def _refresh(self, force: bool = False):
         if not self._needs_refresh(force):
             return
         from ray_tpu.serve.api import _get_controller
 
-        controller = _get_controller()
-        table = rt.get(
-            controller.get_routing_table.remote(self._app, self._deployment)
-        )
+        try:
+            controller = _get_controller()
+            table = rt.get(
+                controller.get_routing_table.remote(
+                    self._app, self._deployment,
+                    router_id=self._router_id,
+                    handle_metrics=self._handle_metrics(),
+                ),
+                timeout=10,
+            )
+        except Exception:
+            # controller down (crash/restart window): keep serving from
+            # the cached table — live replicas are unaffected by a
+            # control-plane outage (reference behavior during controller
+            # recovery); retry on the next refresh period
+            if self._replicas:
+                with self._lock:
+                    self._last_refresh = time.monotonic()
+                return
+            raise
         self._install_table(table)
 
     async def _refresh_async(self, force: bool = False):
@@ -93,9 +119,22 @@ class Router:
         from ray_tpu.core.runtime import get_runtime
         from ray_tpu.serve.api import _get_controller_async
 
-        controller = await _get_controller_async()
-        ref = controller.get_routing_table.remote(self._app, self._deployment)
-        table = await get_runtime()._get_one(ref)
+        try:
+            controller = await _get_controller_async()
+            ref = controller.get_routing_table.remote(
+                self._app, self._deployment,
+                router_id=self._router_id,
+                handle_metrics=self._handle_metrics(),
+            )
+            # bounded like the sync path: calls to a RESTARTING actor
+            # queue until it comes back, which could be a long outage
+            table = await asyncio.wait_for(get_runtime()._get_one(ref), 10)
+        except Exception:
+            if self._replicas:  # see _refresh: stale table beats nothing
+                with self._lock:
+                    self._last_refresh = time.monotonic()
+                return
+            raise
         self._install_table(table)
 
     # -- replica choice ----------------------------------------------
