@@ -113,21 +113,38 @@ class _RefCount:
     # Binary pin: 1 while an owned ref sits inside some serialized
     # container (task return / put) that no consumer has registered yet;
     # released by the first borrow registration or local deserialization.
-    # Simplification of the reference's contained-refs protocol
-    # (`reference_count.h:64`): refs owned by OTHER processes that we
-    # forward are not protected (the in-flight window the full borrower
-    # protocol closes), and a pin on a never-consumed container is only
-    # released when the job exits.
     contained: int = 0
+    # In-flight protection for FOREIGN-owned refs this process forwards
+    # inside serialized messages (task args / returns): while transit>0
+    # the entry survives local drops, so our borrow stays registered at
+    # the owner until the receiver has registered ITS borrow — closing
+    # the forwarded-ref window of the reference's borrower protocol
+    # (`reference_count.h:64` + WaitForRefRemoved; here the receiver's
+    # registration is acknowledged before the carrying task's result).
+    transit: int = 0
+    # True while this process holds a registered borrow at the ref's
+    # owner (drives exactly-one add_borrow/remove_borrow per entry
+    # lifetime regardless of how local/transit counts interleave).
+    registered: bool = False
+    # owner address for borrowed entries, so EVERY deletion path
+    # (_maybe_free) can send the final remove_borrow
+    owner_addr: Optional[tuple] = None
+    # owner-side borrower identity ledger: address -> count (reference:
+    # the owner tracks WHICH workers borrow, `reference_count.h:64`)
+    borrower_addrs: Dict[tuple, int] = field(default_factory=dict)
 
     def total(self):
-        return self.local + self.submitted + self.borrowers + self.contained
+        return (self.local + self.submitted + self.borrowers
+                + self.contained + self.transit)
 
 
 @dataclass
 class _PendingTask:
     spec: TaskSpec
     retries_left: int
+    # (inner_id, owner) pairs: foreign refs serialized into this task's
+    # args, transit-pinned until the task's FINAL completion
+    transit: List[Tuple[bytes, tuple]] = field(default_factory=list)
 
 
 # Process-wide per-actor sequence numbers: every caller path (handles,
@@ -240,6 +257,12 @@ class Runtime:
         self._lease_timers: set = set()  # pending keep-alive returns
         # container object id -> borrows/pins it holds on inner refs
         self._contained_in: Dict[bytes, list] = {}
+        # executor side: task id -> transit pins on foreign refs that
+        # rode out in that task's returns (released by transit_release)
+        self._return_transit: Dict[bytes, list] = {}
+        # borrow-registration ACKs outstanding in this worker; awaited
+        # before any task result is sent (see on_ref_deserialized)
+        self._pending_borrow_acks: list = []
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -624,7 +647,8 @@ class Runtime:
         num_returns = options.get("num_returns", 1)
         if num_returns == "streaming":
             num_returns = STREAMING
-        resolved, kwargs = self._resolve_args_kwargs(args, kwargs)
+        transit: list = []
+        resolved, kwargs = self._resolve_args_kwargs(args, kwargs, transit)
         spec = TaskSpec(
             task_id=task_id,
             function_id=fid,
@@ -656,7 +680,7 @@ class Runtime:
                     event=asyncio.Event()
                 )
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
-                spec, spec.max_retries
+                spec, spec.max_retries, transit
             )
             for a in spec.args:
                 if isinstance(a, ArgRef):
@@ -691,7 +715,7 @@ class Runtime:
             self.controller.send_threadsafe("kv_put_oneway", {"key": key, "value": blob})
         return fid, blob
 
-    def _resolve_args_sync(self, args) -> Optional[List[Any]]:
+    def _resolve_args_sync(self, args, transit=None) -> Optional[List[Any]]:
         """Fast path: all ObjectRef args already ready.  Returns None if
         a pending ref forces the async path."""
         out = []
@@ -710,10 +734,10 @@ class Runtime:
                 else:
                     return None
             else:
-                out.append(self._inline_value_arg(a))
+                out.append(self._inline_value_arg(a, transit))
         return out
 
-    async def _resolve_args_async(self, args) -> List[Any]:
+    async def _resolve_args_async(self, args, transit=None) -> List[Any]:
         """Dependency resolution (reference: `dependency_resolver.h`)."""
         out = []
         for a in args:
@@ -730,24 +754,24 @@ class Runtime:
                 else:
                     out.append(ArgRef(a.binary(), a.owner))
             else:
-                out.append(self._inline_value_arg(a))
+                out.append(self._inline_value_arg(a, transit))
         return out
 
-    def _resolve_args_kwargs(self, args, kwargs):
+    def _resolve_args_kwargs(self, args, kwargs, transit=None):
         """Resolve positional args AND kwarg values together (top-level
         ObjectRefs in either position resolve before execution, like the
         reference).  Returns (resolved_args, resolved_kwargs)."""
         keys = list(kwargs)
         combined = list(args) + [kwargs[k] for k in keys]
-        resolved = self._resolve_args_sync(combined)
+        resolved = self._resolve_args_sync(combined, transit)
         if resolved is None:
-            resolved = self._run(self._resolve_args_async(combined))
+            resolved = self._run(self._resolve_args_async(combined, transit))
         return (
             resolved[: len(args)],
             dict(zip(keys, resolved[len(args):])),
         )
 
-    def _inline_value_arg(self, v) -> Tuple[str, bytes]:
+    def _inline_value_arg(self, v, transit=None) -> Tuple[str, bytes]:
         """Serialize a plain (non-ref) argument into an inline envelope
         at submission time.  The spec then carries only bytes + ids, so
         every relaying daemon can deserialize the FRAME even when the
@@ -759,9 +783,50 @@ class Runtime:
         chunks, total, captured = ser.serialize(v)
         if captured:
             self._pin_contained(captured)
+            if transit is not None:
+                self._pin_transit(captured, transit)
         buf = bytearray(total)
         ser.write_chunks(chunks, memoryview(buf))
         return ("__rt_inline__", bytes(buf))
+
+    def _pin_transit(self, captured_refs, transit: list):
+        """Transit-pin FOREIGN-owned refs being forwarded inside a
+        serialized message: our registered borrow at the owner must
+        outlive the message, or the owner could free the object while
+        it is in flight (the forwarded-ref window of the reference's
+        borrower protocol).  Pins release at the carrying task's final
+        completion (`_complete_task`)."""
+        with self._state_lock:
+            for r in captured_refs:
+                if r.owner is None or tuple(r.owner) == self.address:
+                    continue
+                rc = self.refs.setdefault(r.binary(), _RefCount())
+                rc.transit += 1
+                rc.owner_addr = rc.owner_addr or tuple(r.owner)
+                transit.append((r.binary(), tuple(r.owner)))
+
+    def _release_transit(self, entries):
+        """Drop transit pins; caller holds `_state_lock`."""
+        for inner_id, owner in entries:
+            rc = self.refs.get(inner_id)
+            if rc is None:
+                continue
+            rc.transit -= 1
+            rc.owner_addr = rc.owner_addr or tuple(owner)
+            self._maybe_free(inner_id)
+
+    def _send_remove_borrow(self, inner_id: bytes, owner):
+        if self.noded is None:
+            return
+        try:
+            self.noded.send_threadsafe("route", {
+                "target": tuple(owner),
+                "method": "remove_borrow",
+                "payload": {"id": inner_id, "borrower": self.address},
+                "want_reply": False,
+            })
+        except Exception:
+            pass
 
     def _pool_for(self, spec: TaskSpec) -> _LeasePool:
         demand = spec.resources.as_dict()
@@ -1003,13 +1068,14 @@ class Runtime:
             and (_inspect.isgeneratorfunction(getattr(cls, m, None))
                  or _inspect.isasyncgenfunction(getattr(cls, m, None)))
         )
+        init_transit: list = []
         spec = ActorCreationSpec(
             actor_id=actor_id,
             class_id=cid,
             class_blob=blob,
-            init_args=await self._resolve_args_async(args),
+            init_args=await self._resolve_args_async(args, init_transit),
             init_kwargs={
-                k: (await self._resolve_args_async([v]))[0]
+                k: (await self._resolve_args_async([v], init_transit))[0]
                 for k, v in kwargs.items()
             },
             owner=self.address,
@@ -1025,7 +1091,14 @@ class Runtime:
             lifetime=options.get("lifetime"),
             runtime_env=options.get("runtime_env"),
         )
-        reply = await self.controller.call("create_actor", spec)
+        try:
+            reply = await self.controller.call("create_actor", spec)
+        finally:
+            # forwarded foreign refs in init args stay transit-pinned
+            # until the create reply — by then the actor worker has
+            # deserialized them and registered its own borrows
+            with self._state_lock:
+                self._release_transit(init_transit)
         if not reply.get("ok"):
             raise exc.RayTpuError(reply.get("error", "actor creation failed"))
         self._actor_addr[actor_id.binary()] = tuple(reply["address"])
@@ -1037,7 +1110,8 @@ class Runtime:
         num_returns = options.get("num_returns", 1)
         if num_returns == "streaming":
             num_returns = STREAMING
-        resolved, kwargs = self._resolve_args_kwargs(args, kwargs)
+        transit: list = []
+        resolved, kwargs = self._resolve_args_kwargs(args, kwargs, transit)
         kwargs["__rt_method__"] = method_name
         spec = TaskSpec(
             task_id=task_id,
@@ -1076,7 +1150,7 @@ class Runtime:
                     event=asyncio.Event()
                 )
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
-                spec, spec.max_retries
+                spec, spec.max_retries, transit
             )
             for a in spec.args:
                 if isinstance(a, ArgRef):
@@ -1223,11 +1297,15 @@ class Runtime:
                 except Exception:
                     pass
 
-    def _complete_task(self, result: TaskResult):
+    def _complete_task(self, result: TaskResult) -> list:
+        """Returns the pending ACK futures of contained-borrow
+        registrations made while ingesting the result (awaited by
+        `_h_task_result` before confirming `transit_release`)."""
+        acks: list = []
         with self._state_lock:
             pt = self.pending_tasks.pop(result.task_id.binary(), None)
             if pt is None:
-                return
+                return acks
             if result.status == "ok":
                 self.task_events.record(
                     result.task_id.binary(), pt.spec.name, "FINISHED",
@@ -1257,7 +1335,7 @@ class Runtime:
                         st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
                         contained = ret[3] if len(ret) > 3 else None
                     if contained:
-                        self._register_contained(oid.binary(), contained)
+                        self._register_contained(oid.binary(), contained, acks)
                     st.ready.set()
                 for a in pt.spec.args:
                     if isinstance(a, ArgRef):
@@ -1265,7 +1343,9 @@ class Runtime:
                         if rc:
                             rc.submitted -= 1
                             self._maybe_free(a.id_bytes)
-                return
+                self._release_transit(pt.transit)
+                pt.transit = []
+                return acks
             # failure path
             retriable = result.status == "worker_died" or (
                 result.status == "error" and pt.spec.retry_exceptions
@@ -1315,6 +1395,8 @@ class Runtime:
                         if rc:
                             rc.submitted -= 1
                             self._maybe_free(a.id_bytes)
+                self._release_transit(pt.transit)
+                pt.transit = []
         if resubmit:
             delay = self.cfg.task_retry_delay_ms / 1000.0
             spec = pt.spec
@@ -1329,6 +1411,7 @@ class Runtime:
                 self.loop.call_later(delay, _resend)
             else:
                 _resend()
+        return acks
 
     # ------------------------------------------------------------------
     # get / wait internals (io thread)
@@ -1552,13 +1635,16 @@ class Runtime:
                 if r.owner is not None and tuple(r.owner) == self.address:
                     self.refs.setdefault(r.binary(), _RefCount()).contained = 1
 
-    def _register_contained(self, container_id: bytes, entries):
+    def _register_contained(self, container_id: bytes, entries, acks=None):
         """The container object `container_id` (a task return we own, or
         a local put) holds references to the listed inner objects.  We
         register a borrow per inner ref on its owner so the inner can't
         be freed while the container lives, and release those borrows
         when the container itself is freed (`_maybe_free`).  Caller
-        holds `_state_lock`."""
+        holds `_state_lock`.  With `acks` (a list), foreign
+        registrations become want_reply calls whose futures land there —
+        the executor's transit_release must not be sent until the inner
+        owners have these borrows on the books."""
         if not entries:
             return
         recorded = []
@@ -1574,13 +1660,19 @@ class Runtime:
                 rc.borrowers += 1
                 recorded.append(("selfborrow", inner_id, None))
             else:
+                msg = {
+                    "target": owner,
+                    "method": "add_borrow",
+                    "payload": {"id": inner_id, "borrower": self.address},
+                    "want_reply": acks is not None,
+                }
                 try:
-                    self.noded.send_threadsafe("route", {
-                        "target": owner,
-                        "method": "add_borrow",
-                        "payload": {"id": inner_id},
-                        "want_reply": False,
-                    })
+                    if acks is not None:
+                        acks.append(asyncio.run_coroutine_threadsafe(
+                            self.noded.call("route", msg), self.loop
+                        ))
+                    else:
+                        self.noded.send_threadsafe("route", msg)
                     recorded.append(("borrow", inner_id, owner))
                 except Exception:
                     pass
@@ -1600,15 +1692,7 @@ class Runtime:
                     rc.borrowers -= 1
                     self._maybe_free(inner_id)
             else:
-                try:
-                    self.noded.send_threadsafe("route", {
-                        "target": owner,
-                        "method": "remove_borrow",
-                        "payload": {"id": inner_id},
-                        "want_reply": False,
-                    })
-                except Exception:
-                    pass
+                self._send_remove_borrow(inner_id, owner)
 
     def _add_local_ref(self, id_bytes: bytes):
         rc = self.refs.setdefault(id_bytes, _RefCount())
@@ -1619,6 +1703,11 @@ class Runtime:
         if rc is None or rc.total() > 0:
             return
         del self.refs[id_bytes]
+        # the single deletion point also closes out a registered borrow:
+        # every count decrement funnels here, so a borrowed entry can
+        # never vanish without its remove_borrow reaching the owner
+        if rc.registered and rc.owner_addr:
+            self._send_remove_borrow(id_bytes, rc.owner_addr)
         st = self.objects.pop(id_bytes, None)
         self.lineage.pop(id_bytes, None)
         self._release_contained(id_bytes)
@@ -1669,6 +1758,7 @@ class Runtime:
         """A task we own finished on a worker (direct push reply) or was
         routed back via the daemons."""
         result: TaskResult = payload["result"] if isinstance(payload, dict) else payload
+        assigned = None
         with self._state_lock:
             entry = self._conn_lease.get(conn)
             if entry is not None:
@@ -1679,7 +1769,25 @@ class Runtime:
                 assigned = self._actor_assigned.get(conn)
                 if assigned is not None:
                     assigned.pop(result.task_id.binary(), None)
-        self._complete_task(result)
+        acks = self._complete_task(result)
+        if entry is not None or assigned is not None:
+            # executor conns only (not daemon relays): confirm that the
+            # contained borrows in this result are ON THE BOOKS at their
+            # owners (await the registration acks) before releasing the
+            # executor's transit pins; a failed registration keeps the
+            # pins (job-exit fallback) instead of risking a free
+            confirmed = True
+            for f in acks:
+                try:
+                    await asyncio.wait_for(asyncio.wrap_future(f), 10)
+                except Exception:
+                    confirmed = False
+            if confirmed:
+                try:
+                    conn.send("transit_release",
+                              {"task_id": result.task_id.binary()})
+                except Exception:
+                    pass
         if entry is not None:
             self._drain_pool(pool, lease)
             await self._maybe_return_lease(pool, lease)
@@ -1904,17 +2012,43 @@ class Runtime:
         return ("shm", st.node_id)
 
     async def _h_add_borrow(self, payload, conn):
+        """Owner side: a borrower registered (reference: the owner's
+        borrower set, `reference_count.h:64`).  The reply doubles as the
+        registration ACK workers await before sending a task result that
+        forwards the ref onward."""
         with self._state_lock:
             rc = self.refs.setdefault(payload["id"], _RefCount())
             rc.borrowers += 1
+            b = payload.get("borrower")
+            if b is not None:
+                b = tuple(b)
+                rc.borrower_addrs[b] = rc.borrower_addrs.get(b, 0) + 1
             rc.contained = 0  # pin transfers to the borrower
+        return {"ok": True}
 
     async def _h_remove_borrow(self, payload, conn):
         with self._state_lock:
             rc = self.refs.get(payload["id"])
             if rc:
                 rc.borrowers -= 1
+                b = payload.get("borrower")
+                if b is not None:
+                    b = tuple(b)
+                    n = rc.borrower_addrs.get(b, 0) - 1
+                    if n <= 0:
+                        rc.borrower_addrs.pop(b, None)
+                    else:
+                        rc.borrower_addrs[b] = n
                 self._maybe_free(payload["id"])
+
+    async def _h_transit_release(self, payload, conn):
+        """The owner of a task's returns has registered its contained
+        borrows with every inner owner: this executor's transit pins on
+        the forwarded refs can drop."""
+        entries = self._return_transit.pop(payload["task_id"], None)
+        if entries:
+            with self._state_lock:
+                self._release_transit(entries)
 
     async def _h_ping(self, payload, conn):
         return "pong"
@@ -2211,6 +2345,10 @@ class Runtime:
             envelope = ser.serialize_to_bytes(err, tag=ser.TAG_ERROR)
             result = TaskResult(task_id=spec.task_id, status="error", error=envelope)
         self._started_tasks.discard(tid)
+        # any borrows this task registered while deserializing its args
+        # must be ACKed by their owners before the result releases the
+        # caller's transit pins (the forwarded-ref ordering guarantee)
+        await self._await_borrow_acks()
         try:
             conn.send("task_result", {"result": result, "owner": spec.owner})
         except Exception:
@@ -2221,6 +2359,25 @@ class Runtime:
                 )
             except Exception:
                 pass
+
+    async def _await_borrow_acks(self, timeout: float = 10.0):
+        # SNAPSHOT, don't drain: with concurrent tasks in one worker
+        # (async actors, max_concurrency>1) a swap would let task A
+        # steal task B's outstanding ack, so B's result could outrun
+        # B's borrow registration.  Completed futures are pruned after.
+        with self._state_lock:
+            acks = list(self._pending_borrow_acks)
+        for f in acks:
+            try:
+                await asyncio.wait_for(asyncio.wrap_future(f), timeout)
+            except Exception:
+                # owner unreachable: proceed — the caller-side pin falls
+                # back to the (pre-existing) unprotected window
+                pass
+        with self._state_lock:
+            self._pending_borrow_acks = [
+                f for f in self._pending_borrow_acks if not f.done()
+            ]
 
     async def _stream_out(self, spec: TaskSpec, value, conn) -> int:
         """Drive a streaming-generator task's iteration: each yielded
@@ -2337,9 +2494,18 @@ class Runtime:
         executor's transient contained-pin and lets the pins release
         when the container is freed instead of at job exit (closing the
         leak the round-1 design documented; reference:
-        `reference_count.h:64` contained-refs edges)."""
+        `reference_count.h:64` contained-refs edges).  Foreign-owned
+        refs forwarded in the value additionally get transit pins (see
+        `_pin_transit`) keyed to the task, released when the result's
+        owner confirms it registered the contained borrows
+        (`transit_release`)."""
         chunks, total, captured = ser.serialize(v)
         self._pin_contained(captured)
+        ret_transit: list = []
+        self._pin_transit(captured, ret_transit)
+        if ret_transit:
+            tid = oid.task_id().binary()
+            self._return_transit.setdefault(tid, []).extend(ret_transit)
         contained = [
             (r.binary(), tuple(r.owner))
             for r in captured
@@ -2481,57 +2647,61 @@ def on_ref_deserialized(ref: ObjectRef):
         rc.local += 1
         if ref.owner is not None and tuple(ref.owner) == rt.address:
             rc.contained = 0  # owner consumed its own container: pin -> local
+        # `registered` (not a local==1 heuristic) drives exactly one
+        # add/remove pair per entry lifetime: transit pins can hold the
+        # entry across local 1->0->1 cycles, where re-counting would
+        # double-register at the owner
         is_new_borrow = (
-            rc.local == 1
+            not rc.registered
             and ref.binary() not in rt.objects
             and ref.owner is not None
             and tuple(ref.owner) != rt.address
         )
+        if is_new_borrow:
+            rc.registered = True
+            rc.owner_addr = tuple(ref.owner)
     if is_new_borrow and rt.noded is not None:
-        try:
-            rt.noded.send_threadsafe(
-                "route",
-                {
-                    "target": tuple(ref.owner),
-                    "method": "add_borrow",
-                    "payload": {"id": ref.binary()},
-                    "want_reply": False,
-                },
-            )
-        except Exception:
-            pass
+        payload = {
+            "target": tuple(ref.owner),
+            "method": "add_borrow",
+            "payload": {"id": ref.binary(), "borrower": rt.address},
+        }
+        if rt.mode == "worker":
+            # workers forward refs onward in their RESULTS: the owner
+            # must have this registration on the books before our task
+            # result lets the caller drop ITS protection, so ride a
+            # want_reply call whose ack the executor awaits before
+            # sending any task result
+            try:
+                fut = asyncio.run_coroutine_threadsafe(
+                    rt.noded.call("route", {**payload, "want_reply": True}),
+                    rt.loop,
+                )
+                rt._pending_borrow_acks.append(fut)
+            except Exception:
+                pass
+        else:
+            try:
+                rt.noded.send_threadsafe(
+                    "route", {**payload, "want_reply": False}
+                )
+            except Exception:
+                pass
 
 
 def on_ref_deleted(ref: ObjectRef):
     rt = _runtime
     if rt is None or rt._shutdown:
         return
-    notify_owner = False
     with rt._state_lock:
         rc = rt.refs.get(ref.binary())
         if rc is None:
             return
         rc.local -= 1
-        if rc.total() <= 0 and ref.binary() not in rt.objects:
-            del rt.refs[ref.binary()]
-            notify_owner = (
-                ref.owner is not None and tuple(ref.owner) != rt.address
-            )
-        else:
-            rt._maybe_free(ref.binary())
-    if notify_owner and rt.noded is not None:
-        try:
-            rt.noded.send_threadsafe(
-                "route",
-                {
-                    "target": tuple(ref.owner),
-                    "method": "remove_borrow",
-                    "payload": {"id": ref.binary()},
-                    "want_reply": False,
-                },
-            )
-        except Exception:
-            pass
+        if rc.owner_addr is None and ref.owner is not None:
+            rc.owner_addr = tuple(ref.owner)
+        # _maybe_free sends the final remove_borrow when the entry dies
+        rt._maybe_free(ref.binary())
 
 
 async def async_get(ref: ObjectRef):

@@ -296,9 +296,17 @@ class ServeController:
             if ds is None:
                 return {"version": -1, "replicas": {}}
             if router_id is not None and handle_metrics is not None:
-                self._handle_metrics.setdefault(
+                now = time.monotonic()
+                per_router = self._handle_metrics.setdefault(
                     (app_name, deployment_name), {}
-                )[router_id] = (time.monotonic(), dict(handle_metrics))
+                )
+                per_router[router_id] = (now, dict(handle_metrics))
+                # prune on the write path too: non-autoscaling
+                # deployments never reach _pushed_ongoing's sweep, and
+                # router ids are unique per client process
+                for rid_, (ts, _c) in list(per_router.items()):
+                    if now - ts > 60.0:
+                        del per_router[rid_]
             return ds.routing_table()
 
     def get_app_for_route(self, path: str) -> Optional[Dict[str, str]]:

@@ -128,3 +128,150 @@ def test_task_return_container_keeps_inner_alive(rt_start):
     assert int(rt.get(got)[0]) == 1
     del got, container
     assert _settle(lambda: not rtm.store.contains(inner_id))
+
+
+# ----------------------------------------------------------------------
+# Forwarded borrowed refs — the reference_count_test.cc scenarios
+# (borrower protocol: owner-tracked registration + in-flight transit
+# pins close the forwarded-ref window)
+# ----------------------------------------------------------------------
+class _Owner:
+    """Runs in its own worker process: objects it puts are OWNED there."""
+
+    def make(self):
+        return {"r": rt.put(np.ones(BIG // 8, dtype=np.int64))}
+
+    def contains(self, id_bytes) -> bool:
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().store.contains(id_bytes)
+
+    def refcount(self, id_bytes):
+        from ray_tpu.core.runtime import get_runtime
+
+        rc = get_runtime().refs.get(id_bytes)
+        if rc is None:
+            return None
+        return {
+            "borrowers": rc.borrowers,
+            "borrower_addrs": len(rc.borrower_addrs),
+        }
+
+
+@rt.remote
+def _consume(d):
+    return int(rt.get(d["r"])[0])
+
+
+@rt.remote
+def _forward(d):
+    # borrower forwarding onward: this worker borrows, then passes the
+    # same borrowed ref to ANOTHER task and drops its copy
+    ref = _consume.remote({"r": d["r"]})
+    return rt.get(ref)
+
+
+def _owner_and_borrowed(rt_start):
+    Owner = rt.remote(_Owner)
+    o = Owner.remote()
+    inner = rt.get(o.make.remote())["r"]
+    return o, inner
+
+
+def test_forwarded_ref_survives_immediate_caller_drop(rt_start):
+    """B borrows from owner O, forwards the ref inside a task arg to C,
+    and drops its own copy while the message is in flight — C must still
+    read the value (reference: borrower registration before release)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    o, inner = _owner_and_borrowed(rt_start)
+    inner_id = inner.binary()
+    fut = _consume.remote({"r": inner})
+    del inner
+    gc.collect()
+    # protocol invariant: the transit pin holds B's entry (and thus its
+    # registered borrow at O) open until the task completes
+    rc = get_runtime().refs.get(inner_id)
+    assert rc is not None and rc.transit >= 1 and rc.registered
+    assert rt.get(fut) == 1
+    del fut
+    # every holder gone -> the owner actually frees it (no leak)
+    assert _settle(
+        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
+    ), "owner leaked the object after all borrowers dropped"
+
+
+def test_borrower_forwards_to_third_process(rt_start):
+    """O -> B -> C -> D: a borrower's borrower forwards again; every
+    hop's read succeeds and the owner frees only at the end."""
+    o, inner = _owner_and_borrowed(rt_start)
+    inner_id = inner.binary()
+    fut = _forward.remote({"r": inner})
+    del inner
+    gc.collect()
+    assert rt.get(fut, timeout=60) == 1
+    del fut
+    assert _settle(
+        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
+    )
+
+
+def test_owner_keeps_object_while_any_borrower_lives(rt_start):
+    """The object outlives the consuming task as long as the original
+    borrower still holds its ref."""
+    o, inner = _owner_and_borrowed(rt_start)
+    inner_id = inner.binary()
+    assert rt.get(_consume.remote({"r": inner})) == 1
+    time.sleep(0.3)
+    gc.collect()
+    assert rt.get(o.contains.remote(inner_id))  # B still borrows
+    rc = rt.get(o.refcount.remote(inner_id))
+    assert rc is not None and rc["borrowers"] >= 1
+    del inner
+    assert _settle(
+        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
+    )
+
+
+def test_forwarded_ref_in_actor_task_args(rt_start):
+    """Same in-flight protection on the actor-call path."""
+
+    class Reader:
+        def read(self, d):
+            return int(rt.get(d["r"])[0])
+
+    o, inner = _owner_and_borrowed(rt_start)
+    inner_id = inner.binary()
+    reader = rt.remote(Reader).remote()
+    fut = reader.read.remote({"r": inner})
+    del inner
+    gc.collect()
+    assert rt.get(fut) == 1
+    del fut
+    assert _settle(
+        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
+    )
+
+
+def test_returned_borrowed_ref_transfers_to_result_owner(rt_start):
+    """A task RETURNS a container holding a ref it borrowed: the
+    result's owner registers contained borrows; the executor's transit
+    pin releases after the owner's confirmation; value stays readable."""
+
+    @rt.remote
+    def passthrough(d):
+        return {"again": d["r"]}
+
+    o, inner = _owner_and_borrowed(rt_start)
+    inner_id = inner.binary()
+    out = rt.get(passthrough.remote({"r": inner}))
+    del inner
+    gc.collect()
+    time.sleep(0.3)
+    # only the returned container's borrow protects it now
+    assert rt.get(o.contains.remote(inner_id))
+    assert int(rt.get(out["again"])[0]) == 1
+    del out
+    assert _settle(
+        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
+    )
