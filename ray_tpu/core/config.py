@@ -52,6 +52,11 @@ class Config:
     task_retry_delay_ms: int = 0
     #: default max retries for tasks (reference default 3)
     task_max_retries: int = 3
+    #: refuse pickled (non-schema) control frames: only the wire codec
+    #: (`core/wire.py`) is accepted on this process's connections
+    #: (RT_WIRE_REQUIRE_SCHEMA=1; reference analog: protobuf-only
+    #: services — `src/ray/protobuf/`)
+    wire_require_schema: bool = False
     #: workers prestarted per node at init; 0 = num_cpus
     num_workers_per_node: int = 0
     #: soft cap on lease pipelining per worker

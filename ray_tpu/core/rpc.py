@@ -9,10 +9,19 @@ metadata messages only (bulk data rides the shm store / chunked object
 transfer), so codec simplicity beats schema rigor here.
 
 Frame format: [8B LE length][struct envelope: msg_id u64, kind u8,
-method_len u16][method utf-8][payload cloudpickle] — the envelope rides
-OUTSIDE the pickle so an undeserializable payload fails one message,
-never the connection
+method_len u16, codec u8][method utf-8][payload] — the envelope rides
+OUTSIDE the payload so an undeserializable payload fails one message,
+never the connection.
 kind: 0 = request, 1 = reply, 2 = one-way.
+codec: 0 = schema'd wire codec (`core/wire.py` — NO pickle on decode),
+1 = cloudpickle escape hatch for values outside the wire model
+(refused when the peer runs with `wire_require_schema`).
+
+Version handshake (reference: protobuf'd services reject unknown
+protocol revisions): the first frame each side sends is a one-way
+`__hello__` carrying `wire.PROTOCOL_VERSION`; a peer whose first frame
+is missing or mismatched is told `__goodbye__` and disconnected before
+any payload is decoded.
 """
 
 from __future__ import annotations
@@ -25,15 +34,21 @@ import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ray_tpu.core import wire
 from ray_tpu.core.serialization import dumps_oob as _dumps_oob
 
 logger = logging.getLogger(__name__)
+
+wire.register_core_schemas()
 
 _LEN = struct.Struct("<Q")
 
 REQUEST = 0
 REPLY = 1
 ONEWAY = 2
+
+CODEC_WIRE = 0
+CODEC_PICKLE = 1
 
 _MAX_FRAME = 1 << 34
 
@@ -54,42 +69,58 @@ class RemoteError(RpcError):
         self.exc = exc
 
 
-# envelope rides OUTSIDE the pickled payload so a payload that fails to
+# envelope rides OUTSIDE the encoded payload so a payload that fails to
 # deserialize (e.g. references a module only the sender can import) is
 # an error on that one message, not a torn connection
-_ENV = struct.Struct("<QBH")  # msg_id, kind, len(method)
+_ENV = struct.Struct("<QBHB")  # msg_id, kind, len(method), codec
 
 
 async def read_frame(reader: asyncio.StreamReader):
-    """Returns (msg_id, kind, method, payload_bytes) — the payload is
-    NOT deserialized here; the recv loop does that per-message so a bad
-    payload cannot take down the framing."""
+    """Returns (msg_id, kind, method, codec, payload_bytes) — the
+    payload is NOT deserialized here; the recv loop does that
+    per-message so a bad payload cannot take down the framing."""
     hdr = await reader.readexactly(8)
     (length,) = _LEN.unpack(hdr)
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     data = await reader.readexactly(length)
-    msg_id, kind, mlen = _ENV.unpack_from(data)
+    msg_id, kind, mlen, codec = _ENV.unpack_from(data)
     method = data[_ENV.size:_ENV.size + mlen].decode()
-    return msg_id, kind, method, data[_ENV.size + mlen:]
+    return msg_id, kind, method, codec, data[_ENV.size + mlen:]
 
 
 def frame_bytes(msg_id: int, kind: int, method: str, payload) -> bytes:
-    # cloudpickle, not stdlib pickle: task args/replies may hold
-    # functions defined in the driver's __main__ (or lambdas/closures),
-    # which stdlib pickle serializes BY REFERENCE — the receiving
-    # worker's __main__ is worker_main, so the load side would fail (or
-    # silently bind the wrong symbol).  cloudpickle serializes such
-    # objects by value.  ~2.7us/frame overhead vs stdlib on small
-    # control messages (measured), bulk data rides the object plane.
-    blob = _dumps_oob(payload)
+    # schema'd wire codec first (versioned, no pickle on the decode
+    # side); values outside the wire model — user objects riding a
+    # control message — fall back to a cloudpickle frame, which strict
+    # peers refuse.  cloudpickle rather than stdlib pickle because such
+    # payloads may hold driver-__main__ functions serialized by value.
+    try:
+        blob = wire.encode(payload)
+        codec = CODEC_WIRE
+    except wire.WireError:
+        blob = _dumps_oob(payload)
+        codec = CODEC_PICKLE
     m = method.encode()
     return (
         _LEN.pack(_ENV.size + len(m) + len(blob))
-        + _ENV.pack(msg_id, kind, len(m))
+        + _ENV.pack(msg_id, kind, len(m), codec)
         + m
         + blob
     )
+
+
+def decode_payload(codec: int, blob, require_schema: bool):
+    if codec == CODEC_WIRE:
+        return wire.decode(blob)
+    if codec == CODEC_PICKLE:
+        if require_schema:
+            raise RpcError(
+                "peer sent a pickled (non-schema) control frame and this "
+                "endpoint runs with wire_require_schema"
+            )
+        return pickle.loads(blob)
+    raise RpcError(f"unknown payload codec {codec}")
 
 
 class Connection:
@@ -103,25 +134,73 @@ class Connection:
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
                  handler: Optional[Callable[[str, Any, "Connection"], Awaitable[Any]]] = None,
-                 name: str = "?"):
+                 name: str = "?", require_schema: Optional[bool] = None):
         self.reader = reader
         self.writer = writer
         self.handler = handler
         self.name = name
+        if require_schema is None:
+            # config-driven strictness (RT_WIRE_REQUIRE_SCHEMA=1):
+            # daemons refusing pickle frames entirely
+            try:
+                from ray_tpu.core.config import get_config
+
+                require_schema = bool(
+                    getattr(get_config(), "wire_require_schema", False)
+                )
+            except Exception:
+                require_schema = False
+        self.require_schema = require_schema
         self._ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._outbox: list = []
         self._outbox_lock = threading.Lock()
         self._flush_scheduled = False
         self._closed = False
+        self._hello_seen = False
         self._recv_task: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
 
     def start(self):
         self._loop = asyncio.get_running_loop()
+        # version handshake: the very first frame each side emits
+        # (reference: schema'd services reject unknown protocol versions
+        # at the connection edge)
+        self._enqueue(0, ONEWAY, "__hello__",
+                      {"protocol": wire.PROTOCOL_VERSION})
         self._recv_task = asyncio.create_task(self._recv_loop())
         return self
+
+    def _handshake(self, method: str, payload) -> bool:
+        """Returns True when the connection may proceed; tears down on
+        a missing or mismatched hello."""
+        if method == "__goodbye__":
+            self._teardown(RpcError(
+                f"peer {self.name} rejected connection: {payload}"
+            ))
+            return False
+        if method != "__hello__":
+            reason = (
+                f"expected protocol handshake, got {method!r} — peer is "
+                f"running an incompatible (pre-handshake) build"
+            )
+            self._enqueue(0, ONEWAY, "__goodbye__", reason)
+            self._flush()
+            self._teardown(RpcError(reason))
+            return False
+        peer = (payload or {}).get("protocol")
+        if peer != wire.PROTOCOL_VERSION:
+            reason = (
+                f"protocol version mismatch: peer {self.name} speaks "
+                f"{peer!r}, this endpoint {wire.PROTOCOL_VERSION}"
+            )
+            self._enqueue(0, ONEWAY, "__goodbye__", reason)
+            self._flush()
+            self._teardown(RpcError(reason))
+            return False
+        self._hello_seen = True
+        return True
 
     # ---- sending -----------------------------------------------------
     def _enqueue(self, msg_id, kind, method, payload):
@@ -181,9 +260,9 @@ class Connection:
     async def _recv_loop(self):
         try:
             while True:
-                msg_id, kind, method, blob = await read_frame(self.reader)
+                msg_id, kind, method, codec, blob = await read_frame(self.reader)
                 try:
-                    payload = pickle.loads(blob)
+                    payload = decode_payload(codec, blob, self.require_schema)
                 except Exception as de:  # noqa: BLE001 — isolate per message
                     # a payload only the sender can deserialize (e.g. a
                     # function pickled by reference to a module missing
@@ -201,6 +280,15 @@ class Connection:
                     else:
                         logger.warning("dropping undeserializable one-way "
                                        "%s from %s: %r", method, self.name, de)
+                    if not self._hello_seen:
+                        # an undecodable FIRST frame is a protocol
+                        # mismatch, not a payload problem: reject
+                        self._handshake("__corrupt__", None)
+                        return
+                    continue
+                if not self._hello_seen or method in ("__hello__", "__goodbye__"):
+                    if not self._handshake(method, payload):
+                        return
                     continue
                 if kind == REPLY:
                     fut = self._pending.get(msg_id)
@@ -309,9 +397,16 @@ class Server:
     async def stop(self):
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # close live connections BEFORE wait_closed: under Python 3.12
+        # wait_closed blocks until every connection is done, so the old
+        # order deadlocked when peers were still attached
         for conn in list(self.connections):
             await conn.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
+            except asyncio.TimeoutError:
+                pass
 
 
 async def connect_unix(path: str, handler=None, name="client") -> Connection:

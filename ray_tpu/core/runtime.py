@@ -260,6 +260,10 @@ class Runtime:
         # executor side: task id -> transit pins on foreign refs that
         # rode out in that task's returns (released by transit_release)
         self._return_transit: Dict[bytes, list] = {}
+        # owner side: task id -> registration-ack futures for contained
+        # borrows arriving in STREAM items (awaited with the final
+        # result's acks before transit_release)
+        self._stream_reg_acks: Dict[bytes, list] = {}
         # borrow-registration ACKs outstanding in this worker; awaited
         # before any task result is sent (see on_ref_deserialized)
         self._pending_borrow_acks: list = []
@@ -1770,27 +1774,37 @@ class Runtime:
                 if assigned is not None:
                     assigned.pop(result.task_id.binary(), None)
         acks = self._complete_task(result)
+        acks.extend(
+            self._stream_reg_acks.pop(result.task_id.binary(), ())
+        )
+        if entry is not None:
+            # dispatch first: queued tasks must not idle behind the
+            # borrow-ack confirmation below (which only gates the
+            # executor's transit_release, not this worker's reuse)
+            self._drain_pool(pool, lease)
+            await self._maybe_return_lease(pool, lease)
         if entry is not None or assigned is not None:
             # executor conns only (not daemon relays): confirm that the
-            # contained borrows in this result are ON THE BOOKS at their
-            # owners (await the registration acks) before releasing the
+            # contained borrows in this result (and its stream items)
+            # are ON THE BOOKS at their owners before releasing the
             # executor's transit pins; a failed registration keeps the
             # pins (job-exit fallback) instead of risking a free
             confirmed = True
-            for f in acks:
-                try:
-                    await asyncio.wait_for(asyncio.wrap_future(f), 10)
-                except Exception:
-                    confirmed = False
+            if acks:
+                done, pending = await asyncio.wait(
+                    [asyncio.wrap_future(f) for f in acks], timeout=10
+                )
+                confirmed = not pending and all(
+                    t.exception() is None for t in done
+                )
+                for t in pending:
+                    t.cancel()
             if confirmed:
                 try:
                     conn.send("transit_release",
                               {"task_id": result.task_id.binary()})
                 except Exception:
                     pass
-        if entry is not None:
-            self._drain_pool(pool, lease)
-            await self._maybe_return_lease(pool, lease)
 
     async def _maybe_return_lease(self, pool: _LeasePool, lease: _Lease):
         """Idle lease handling: keep the worker warm for a grace period
@@ -1875,7 +1889,11 @@ class Runtime:
                 st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
                 contained = ret[3] if len(ret) > 3 else None
             if contained:
-                self._register_contained(oid.binary(), contained)
+                # acks parked per task: _h_task_result awaits them before
+                # confirming transit_release, so streamed items get the
+                # same registered-before-release guarantee as returns
+                acks = self._stream_reg_acks.setdefault(tid, [])
+                self._register_contained(oid.binary(), contained, acks)
             st.ready.set()
             self.objects[oid.binary()] = st
             self._add_local_ref(oid.binary())
@@ -2030,15 +2048,21 @@ class Runtime:
         with self._state_lock:
             rc = self.refs.get(payload["id"])
             if rc:
-                rc.borrowers -= 1
                 b = payload.get("borrower")
                 if b is not None:
                     b = tuple(b)
-                    n = rc.borrower_addrs.get(b, 0) - 1
+                    n = rc.borrower_addrs.get(b, 0)
                     if n <= 0:
+                        # no matching registration from this borrower (its
+                        # add_borrow was lost en route): rejecting the
+                        # unmatched remove keeps the count from going
+                        # negative and freeing under live borrowers
+                        return
+                    if n == 1:
                         rc.borrower_addrs.pop(b, None)
                     else:
-                        rc.borrower_addrs[b] = n
+                        rc.borrower_addrs[b] = n - 1
+                rc.borrowers -= 1
                 self._maybe_free(payload["id"])
 
     async def _h_transit_release(self, payload, conn):
@@ -2128,6 +2152,10 @@ class Runtime:
             return inst
 
         self.actor_instance = await loop.run_in_executor(self._exec_pool, _make)
+        # borrows registered while deserializing init args must be ACKed
+        # before this reply: the driver's create-reply releases its
+        # init-arg transit pins (same ordering guarantee as task results)
+        await self._await_borrow_acks()
         return {"ok": True}
 
     async def _exec_actor_ordered(self, spec: TaskSpec, conn):
@@ -2677,7 +2705,11 @@ def on_ref_deserialized(ref: ObjectRef):
                     rt.noded.call("route", {**payload, "want_reply": True}),
                     rt.loop,
                 )
-                rt._pending_borrow_acks.append(fut)
+                with rt._state_lock:
+                    # under the lock: _await_borrow_acks rebuilds this
+                    # list during its prune, and a bare append could be
+                    # lost to that assignment
+                    rt._pending_borrow_acks.append(fut)
             except Exception:
                 pass
         else:

@@ -1,0 +1,336 @@
+"""Schema'd control-plane wire codec (versioned, no-pickle).
+
+Reference analog: `src/ray/protobuf/*.proto` (24 files) — every
+control-plane message has a typed schema and a protocol version, and
+peers reject version mismatches at connect time.  The reference
+compiles protobufs; here the codec is a small tagged binary format with
+a per-class field registry, which buys the same properties without a
+compiler step:
+
+- **No pickle on the control path.**  `decode` never unpickles: only
+  plain data (None/bool/int/float/str/bytes/list/tuple/dict/set),
+  registered schema classes (encoded as field lists), and exceptions
+  rebuilt from an allowlist (ray_tpu.* and builtins).  User payloads
+  (task args, function blobs, object values) ride as OPAQUE BYTES
+  produced by the serialization layer and are deserialized only at
+  their consumer — the worker executing the task — never by relaying
+  daemons.
+- **Versioned.**  `PROTOCOL_VERSION` rides in the connection handshake
+  (`rpc.py`); a mismatched peer is rejected cleanly before any payload
+  decodes.
+- **Schema'd.**  Control dataclasses (TaskSpec, TaskResult, Resources,
+  ActorCreationSpec, ...) register field lists; unknown fields from a
+  newer minor revision are ignored on decode and missing fields take
+  the dataclass default (forward/backward compat within a major
+  version).
+
+`encode` raises `WireError` for values outside the model — the rpc
+layer then falls back to a cloudpickle frame marked with a distinct
+codec id, which daemons can be configured to refuse
+(`wire_require_schema`); the escape hatch exists for out-of-tree
+extensions, never for the core protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+PROTOCOL_VERSION = 1
+
+# type tags
+_NONE = 0x00
+_TRUE = 0x01
+_FALSE = 0x02
+_INT = 0x03
+_FLOAT = 0x04
+_STR = 0x05
+_BYTES = 0x06
+_LIST = 0x07
+_TUPLE = 0x08
+_DICT = 0x09
+_SET = 0x0A
+_SCHEMA = 0x0B
+_EXC = 0x0C
+
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+
+class WireError(Exception):
+    pass
+
+
+class SchemaRegistry:
+    def __init__(self):
+        self.by_cls: Dict[type, Tuple[str, Tuple[str, ...]]] = {}
+        self.by_name: Dict[str, Tuple[Callable, frozenset]] = {}
+
+    def register(self, cls: type, fields, construct: Optional[Callable] = None,
+                 name: str = ""):
+        """`fields` are attribute names encoded in order; decode calls
+        `construct(**present_known_fields)` (default: the class itself,
+        with dataclass defaults covering missing fields)."""
+        n = name or cls.__name__
+        self.by_cls[cls] = (n, tuple(fields))
+        self.by_name[n] = (construct or cls, frozenset(fields))
+        return cls
+
+
+registry = SchemaRegistry()
+
+
+def _encode(out: List[bytes], v: Any):
+    t = type(v)
+    if v is None:
+        out.append(b"\x00")
+    elif v is True:
+        out.append(b"\x01")
+    elif v is False:
+        out.append(b"\x02")
+    elif t is int:
+        if not -(2**63) <= v < 2**63:
+            raise WireError(f"int out of i64 range: {v}")
+        out.append(b"\x03")
+        out.append(_I64.pack(v))
+    elif t is float:
+        out.append(b"\x04")
+        out.append(_F64.pack(v))
+    elif t is str:
+        b = v.encode()
+        out.append(b"\x05" + _U32.pack(len(b)) + b)
+    elif t in (bytes, bytearray, memoryview):
+        b = bytes(v)
+        out.append(b"\x06" + _U32.pack(len(b)) + b)
+    elif t is list:
+        out.append(b"\x07" + _U32.pack(len(v)))
+        for x in v:
+            _encode(out, x)
+    elif t is tuple:
+        out.append(b"\x08" + _U32.pack(len(v)))
+        for x in v:
+            _encode(out, x)
+    elif t is dict:
+        out.append(b"\x09" + _U32.pack(len(v)))
+        for k, x in v.items():
+            _encode(out, k)
+            _encode(out, x)
+    elif t in (set, frozenset):
+        out.append(b"\x0a" + _U32.pack(len(v)))
+        for x in v:
+            _encode(out, x)
+    else:
+        ent = registry.by_cls.get(t)
+        if ent is not None:
+            name, fields = ent
+            nb = name.encode()
+            out.append(b"\x0b" + _U32.pack(len(nb)) + nb + _U32.pack(len(fields)))
+            for f in fields:
+                fb = f.encode()
+                out.append(_U32.pack(len(fb)) + fb)
+                _encode(out, getattr(v, f))
+        elif isinstance(v, BaseException):
+            et = type(v)
+            out.append(b"\x0c")
+            _encode(out, (
+                et.__module__, et.__qualname__,
+                [a if _is_plain(a) else repr(a) for a in v.args],
+            ))
+        else:
+            raise WireError(
+                f"type {t.__module__}.{t.__qualname__} is not "
+                f"wire-encodable (register a schema or pass bytes)"
+            )
+
+
+def _is_plain(v) -> bool:
+    return v is None or type(v) in (bool, int, float, str, bytes)
+
+
+def _exc_allowed(module: str, qualname: str) -> Optional[type]:
+    """Exception classes reconstructable on decode: ray_tpu's own and
+    builtins only — never arbitrary imports."""
+    if module == "builtins":
+        import builtins
+
+        t = getattr(builtins, qualname, None)
+    elif module == "ray_tpu.exceptions" or module == "ray_tpu.core.rpc":
+        import importlib
+
+        try:
+            mod = importlib.import_module(module)
+        except Exception:
+            return None
+        t = mod
+        for part in qualname.split("."):
+            t = getattr(t, part, None)
+            if t is None:
+                return None
+    else:
+        return None
+    if isinstance(t, type) and issubclass(t, BaseException):
+        return t
+    return None
+
+
+# schema + field names repeat on every frame: intern them (bounded by
+# the set of distinct identifiers actually used on the wire)
+_name_cache: Dict[bytes, str] = {}
+
+
+def _intern(b: bytes) -> str:
+    s = _name_cache.get(b)
+    if s is None:
+        if len(_name_cache) > 4096:
+            _name_cache.clear()
+        s = _name_cache[b] = b.decode()
+    return s
+
+
+def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
+    """Returns (value, new_pos).  Operates on bytes with explicit
+    offsets — the hot path of every daemon relay, so no reader-object
+    indirection and no per-field memoryview churn."""
+    tag = buf[pos]
+    pos += 1
+    if tag == _STR:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        if pos + n > len(buf):
+            raise WireError("truncated frame")
+        return buf[pos : pos + n].decode(), pos + n
+    if tag == _BYTES:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        if pos + n > len(buf):
+            raise WireError("truncated frame")
+        return buf[pos : pos + n], pos + n
+    if tag == _INT:
+        return _I64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _NONE:
+        return None, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _FLOAT:
+        return _F64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _LIST or tag == _TUPLE or tag == _SET:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        out = []
+        for _ in range(n):
+            v, pos = _decode(buf, pos)
+            out.append(v)
+        if tag == _LIST:
+            return out, pos
+        return (tuple(out) if tag == _TUPLE else set(out)), pos
+    if tag == _DICT:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _decode(buf, pos)
+            v, pos = _decode(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _SCHEMA:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        name = _intern(buf[pos : pos + n])
+        pos += n
+        nf = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        fields = {}
+        for _ in range(nf):
+            ln = _U32.unpack_from(buf, pos)[0]
+            pos += 4
+            fname = _intern(buf[pos : pos + ln])
+            pos += ln
+            v, pos = _decode(buf, pos)
+            fields[fname] = v
+        ent = registry.by_name.get(name)
+        if ent is None:
+            raise WireError(f"unknown schema {name!r}")
+        construct, known = ent
+        if not known.issuperset(fields):
+            # forward compat: drop fields a newer peer added
+            fields = {k: v for k, v in fields.items() if k in known}
+        return construct(**fields), pos
+    if tag == _EXC:
+        (module, qualname, args), pos = _decode(buf, pos)
+        t = _exc_allowed(module, qualname)
+        if t is not None:
+            try:
+                return t(*args), pos
+            except Exception:
+                pass
+        from ray_tpu.core import rpc as _rpc
+
+        return _rpc.RpcError(f"{module}.{qualname}{tuple(args)!r}"), pos
+    raise WireError(f"bad wire tag 0x{tag:02x}")
+
+
+def encode(v: Any) -> bytes:
+    out: List[bytes] = []
+    _encode(out, v)
+    return b"".join(out)
+
+
+def decode(data) -> Any:
+    buf = bytes(data)
+    try:
+        v, pos = _decode(buf, 0)
+    except (IndexError, struct.error):
+        raise WireError("truncated frame") from None
+    if pos != len(buf):
+        raise WireError("trailing bytes after value")
+    return v
+
+
+# ----------------------------------------------------------------------
+# core schema registrations (the ~20 control-plane message classes)
+# ----------------------------------------------------------------------
+_registered = False
+
+
+def register_core_schemas():
+    global _registered
+    if _registered:
+        return
+    _registered = True
+    from ray_tpu.core import ids as _ids
+    from ray_tpu.core import task_spec as _ts
+
+    def _id_construct(cls):
+        return lambda **kw: cls(kw["_bytes"])
+
+    for cls in (_ids.JobID, _ids.TaskID, _ids.ObjectID, _ids.ActorID,
+                _ids.WorkerID, _ids.PlacementGroupID):
+        registry.register(cls, ["_bytes"], construct=_id_construct(cls))
+
+    registry.register(_ts.ArgRef, ["id_bytes", "owner"])
+    registry.register(_ts.Resources,
+                      ["num_cpus", "num_tpus", "memory", "custom"])
+    registry.register(_ts.SchedulingStrategy,
+                      ["kind", "node_id", "soft", "pg_id",
+                       "pg_bundle_index", "pg_capture_child_tasks"])
+    registry.register(_ts.TaskSpec, [
+        "task_id", "function_id", "function_blob", "args", "kwargs",
+        "num_returns", "owner", "resources", "max_retries",
+        "retry_exceptions", "strategy", "name", "actor_id", "seq_no",
+        "trace_ctx", "runtime_env", "env_hash",
+    ])
+    registry.register(_ts.ActorCreationSpec, [
+        "actor_id", "class_id", "class_blob", "init_args", "init_kwargs",
+        "owner", "resources", "max_restarts", "max_task_retries",
+        "max_concurrency", "is_async", "name", "namespace",
+        "streaming_methods", "strategy", "lifetime", "runtime_env",
+    ])
+    registry.register(_ts.TaskResult, [
+        "task_id", "status", "returns", "error", "execution_info",
+    ])
