@@ -45,6 +45,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    log_to_driver: Optional[bool] = None,
     _system_config: Optional[Dict[str, Any]] = None,
     **_kwargs,
 ):
@@ -72,6 +73,8 @@ def init(
         cfg = Config().apply_env_overrides()
         if _system_config:
             cfg.apply_dict(_system_config)
+        if log_to_driver is not None:
+            cfg.log_to_driver = log_to_driver
         if object_store_memory:
             cfg.object_store_memory = object_store_memory
         set_config(cfg)

@@ -52,6 +52,10 @@ class Config:
     task_retry_delay_ms: int = 0
     #: default max retries for tasks (reference default 3)
     task_max_retries: int = 3
+    #: ship worker task/actor prints to the owning driver's stderr
+    #: (reference: log_monitor.py tail -> driver stdout); files under
+    #: the session dir remain the durable copy either way
+    log_to_driver: bool = True
     #: refuse pickled (non-schema) control frames: only the wire codec
     #: (`core/wire.py`) is accepted on this process's connections
     #: (RT_WIRE_REQUIRE_SCHEMA=1; reference analog: protobuf-only

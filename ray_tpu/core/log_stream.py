@@ -1,0 +1,128 @@
+"""Driver log streaming: worker prints surface at the driver.
+
+Reference: `_private/log_monitor.py:103` — a per-node monitor tails
+worker log files and republishes lines to the driver's stdout via GCS
+pubsub.  TPU-native redesign: the WORKER wraps its own stdout/stderr
+with a line tee, so each line is attributed to the exact task/actor
+that printed it (the reference can only attribute per job by file
+name) and routed directly to the owning driver over the existing
+daemon relay — no tailing latency and no extra monitor process.
+Worker log files stay the durable source of truth (the tee passes
+through); `log_to_driver=False` (config) disables shipping, and a
+dead/unreachable owner degrades to file-only logging.
+
+C-level writes to fd 1/2 (native libraries) bypass a Python-level tee
+and land only in the worker's log file — the dashboard tail covers
+those, same as the reference before its file monitor picks them up.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import threading
+from typing import Optional, Tuple
+
+_MAX_LINE = 8192
+_BATCH_MAX = 64
+
+
+class _TeeStream(io.TextIOBase):
+    """Line-buffering tee: passthrough + per-task shipping."""
+
+    def __init__(self, passthrough, stream: str):
+        self._pass = passthrough
+        self._stream = stream  # "out" | "err"
+        self._buf: dict = {}  # thread ident -> partial line
+        self._lock = threading.Lock()
+
+    # -- io.TextIOBase surface ----------------------------------------
+    def writable(self):
+        return True
+
+    @property
+    def encoding(self):
+        return getattr(self._pass, "encoding", "utf-8")
+
+    def fileno(self):
+        return self._pass.fileno()
+
+    def isatty(self):
+        return False
+
+    def write(self, s):
+        if not isinstance(s, str):
+            s = str(s)
+        try:
+            self._pass.write(s)
+        except Exception:
+            pass
+        ctx = _current_ctx()
+        if ctx is None:
+            return len(s)
+        tid = threading.get_ident()
+        with self._lock:
+            pending = self._buf.get(tid, "") + s
+            lines = pending.split("\n")
+            self._buf[tid] = lines[-1][-_MAX_LINE:]
+            complete = [ln[:_MAX_LINE] for ln in lines[:-1]]
+        if complete:
+            _ship(ctx, self._stream, complete)
+        return len(s)
+
+    def flush(self):
+        try:
+            self._pass.flush()
+        except Exception:
+            pass
+        tid = threading.get_ident()
+        with self._lock:
+            rest = self._buf.pop(tid, "")
+        if rest:
+            ctx = _current_ctx()
+            if ctx is not None:
+                _ship(ctx, self._stream, [rest])
+
+
+def _current_ctx() -> Optional[Tuple[tuple, str]]:
+    """(owner_address, display_name) of the task running on this
+    thread, or None outside task execution / when shipping is off."""
+    from ray_tpu.core.runtime import _runtime
+
+    rt = _runtime
+    if rt is None or rt._shutdown or not rt.cfg.log_to_driver:
+        return None
+    return getattr(rt._task_local, "log_ctx", None)
+
+
+def _ship(ctx, stream: str, lines):
+    from ray_tpu.core.runtime import _runtime
+
+    rt = _runtime
+    if rt is None or rt.noded is None:
+        return
+    owner, name = ctx
+    for i in range(0, len(lines), _BATCH_MAX):
+        try:
+            rt.noded.send_threadsafe("route", {
+                "target": tuple(owner),
+                "method": "worker_log",
+                "payload": {
+                    "lines": lines[i : i + _BATCH_MAX],
+                    "pid": os.getpid(),
+                    "name": name,
+                    "stream": stream,
+                },
+                "want_reply": False,
+            })
+        except Exception:
+            return  # owner/daemon unreachable: file-only from here
+
+
+def install_worker_tee():
+    """Wrap this worker's stdout/stderr (idempotent)."""
+    if not isinstance(sys.stdout, _TeeStream):
+        sys.stdout = _TeeStream(sys.stdout, "out")
+    if not isinstance(sys.stderr, _TeeStream):
+        sys.stderr = _TeeStream(sys.stderr, "err")

@@ -98,7 +98,9 @@ def frame_bytes(msg_id: int, kind: int, method: str, payload) -> bytes:
     try:
         blob = wire.encode(payload)
         codec = CODEC_WIRE
-    except wire.WireError:
+    except (wire.WireError, UnicodeError, OverflowError, ValueError):
+        # UnicodeError: lone-surrogate strings (os.environ via
+        # surrogateescape) that str.encode rejects but pickle carries
         blob = _dumps_oob(payload)
         codec = CODEC_PICKLE
     m = method.encode()

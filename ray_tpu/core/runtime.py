@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import sys
 import threading
 import time
 import traceback
@@ -267,6 +268,10 @@ class Runtime:
         # borrow-registration ACKs outstanding in this worker; awaited
         # before any task result is sent (see on_ref_deserialized)
         self._pending_borrow_acks: list = []
+        # driver side: recent worker log lines (name, pid, stream, line)
+        # received via worker_log — tests and tooling read this; the
+        # lines are also echoed to stderr (core/log_stream.py)
+        self._worker_log_lines: deque = deque(maxlen=2000)
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -1349,6 +1354,12 @@ class Runtime:
                             self._maybe_free(a.id_bytes)
                 self._release_transit(pt.transit)
                 pt.transit = []
+                # popped at EVERY final completion path (incl. the
+                # worker-died/cancel callers of _complete_task), so dead
+                # attempts can't leak ack lists or poison a retry
+                acks.extend(
+                    self._stream_reg_acks.pop(result.task_id.binary(), ())
+                )
                 return acks
             # failure path
             retriable = result.status == "worker_died" or (
@@ -1401,6 +1412,9 @@ class Runtime:
                             self._maybe_free(a.id_bytes)
                 self._release_transit(pt.transit)
                 pt.transit = []
+                acks.extend(
+                    self._stream_reg_acks.pop(result.task_id.binary(), ())
+                )
         if resubmit:
             delay = self.cfg.task_retry_delay_ms / 1000.0
             spec = pt.spec
@@ -1774,9 +1788,6 @@ class Runtime:
                 if assigned is not None:
                     assigned.pop(result.task_id.binary(), None)
         acks = self._complete_task(result)
-        acks.extend(
-            self._stream_reg_acks.pop(result.task_id.binary(), ())
-        )
         if entry is not None:
             # dispatch first: queued tasks must not idle behind the
             # borrow-ack confirmation below (which only gates the
@@ -2065,6 +2076,26 @@ class Runtime:
                 rc.borrowers -= 1
                 self._maybe_free(payload["id"])
 
+    async def _h_worker_log(self, payload, conn):
+        """Driver side: task/actor print lines from a worker (reference:
+        `log_monitor.py:103` republishing worker logs to the driver)."""
+        if not self.cfg.log_to_driver:
+            return
+        name = payload.get("name", "?")
+        pid = payload.get("pid", 0)
+        stream = payload.get("stream", "out")
+        out = sys.stderr
+        for line in payload.get("lines") or ():
+            self._worker_log_lines.append((name, pid, stream, line))
+            try:
+                out.write(f"({name} pid={pid}) {line}\n")
+            except Exception:
+                return
+        try:
+            out.flush()
+        except Exception:
+            pass
+
     async def _h_transit_release(self, payload, conn):
         """The owner of a task's returns has registered its contained
         borrows with every inner owner: this executor's transit pins on
@@ -2286,20 +2317,42 @@ class Runtime:
                 else:
                     method = getattr(self.actor_instance, mname)
                 if asyncio.iscoroutinefunction(method):
-                    with _tracing.execution_span(spec.name, trace_ctx):
-                        value = await method(*args, **kwargs)
+                    self._task_local.log_ctx = (spec.owner, spec.name)
+                    try:
+                        with _tracing.execution_span(spec.name, trace_ctx):
+                            value = await method(*args, **kwargs)
+                    finally:
+                        try:
+                            sys.stdout.flush()
+                            sys.stderr.flush()
+                        except Exception:
+                            pass
+                        self._task_local.log_ctx = None
                 else:
 
                     def _call_method():
                         self._task_local.task_id = spec.task_id
-                        with _tracing.execution_span(spec.name, trace_ctx):
-                            return method(*args, **kwargs)
+                        self._task_local.log_ctx = (spec.owner, spec.name)
+                        try:
+                            with _tracing.execution_span(spec.name, trace_ctx):
+                                return method(*args, **kwargs)
+                        finally:
+                            # flush BEFORE clearing: a partial line left
+                            # in the tee's thread buffer would otherwise
+                            # prepend itself to the NEXT task's output
+                            try:
+                                sys.stdout.flush()
+                                sys.stderr.flush()
+                            except Exception:
+                                pass
+                            self._task_local.log_ctx = None
 
                     value = await loop.run_in_executor(self._exec_pool, _call_method)
             else:
 
                 def _call():
                     self._task_local.task_id = spec.task_id
+                    self._task_local.log_ctx = (spec.owner, spec.name)
                     # registered for mid-execution cancellation
                     # (_h_cancel_task async-raises into this thread);
                     # register/pop under _state_lock so a cancel can
@@ -2316,6 +2369,14 @@ class Runtime:
                                 committed = True
                             return value
                         finally:
+                            # partial printed lines ship before the
+                            # context clears
+                            try:
+                                sys.stdout.flush()
+                                sys.stderr.flush()
+                            except Exception:
+                                pass
+                            self._task_local.log_ctx = None
                             # after this pop no NEW cancel can be
                             # delivered (raise and pop share the lock)
                             with self._state_lock:

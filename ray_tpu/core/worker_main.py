@@ -53,6 +53,13 @@ def main():
     rt.start(node_socket, (host, int(port)),
              serve_dir=os.path.dirname(node_socket))
 
+    # task/actor prints stream to the owning driver (reference:
+    # log_monitor.py tailing worker files); the tee passes through to
+    # this worker's session-dir log file either way
+    from ray_tpu.core.log_stream import install_worker_tee
+
+    install_worker_tee()
+
     # exit when the node daemon goes away (socket closes) or parent dies
     ppid = os.getppid()
     try:

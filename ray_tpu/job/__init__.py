@@ -10,6 +10,7 @@ STOPPED) through the control plane's KV store.
 from ray_tpu.job.api import (
     JobStatus,
     get_job_info,
+    follow_job_logs,
     get_job_logs,
     get_job_status,
     list_jobs,
@@ -21,6 +22,7 @@ from ray_tpu.job.api import (
 __all__ = [
     "JobStatus",
     "get_job_info",
+    "follow_job_logs",
     "get_job_logs",
     "get_job_status",
     "list_jobs",

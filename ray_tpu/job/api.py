@@ -157,6 +157,17 @@ class JobSupervisor:
         except OSError:
             return b""
 
+    def read_from(self, offset: int, nbytes: int = 65536):
+        """Incremental read for `rt job logs -f` (reference: the job
+        SDK's tail_job_logs streaming).  Returns (chunk, new_offset)."""
+        try:
+            with open(self._log_path, "rb") as f:
+                f.seek(offset)
+                data = f.read(nbytes)
+                return data, offset + len(data)
+        except OSError:
+            return b"", offset
+
     def ping(self) -> bool:
         return True
 
@@ -227,6 +238,37 @@ def get_job_logs(job_id: str) -> str:
                 f"logs for {job_id!r} are no longer reachable (supervisor "
                 f"exited; {info['log_path']} not on this node)"
             ) from e
+
+
+def follow_job_logs(job_id: str, poll_s: float = 0.5):
+    """Generator yielding log chunks (str) until the job reaches a
+    terminal status and the log is drained — `rt job logs -f`
+    (reference: JobSubmissionClient.tail_job_logs)."""
+    get_job_info(job_id)
+    try:
+        sup = rt.get_actor(f"_job_supervisor:{job_id}")
+    except Exception:
+        # supervisor past its linger window: everything the job printed
+        # is already on disk — same fallback as the non-follow path
+        yield get_job_logs(job_id)
+        return
+    offset = 0
+    while True:
+        chunk, offset = rt.get(sup.read_from.remote(offset), timeout=15)
+        if chunk:
+            yield chunk.decode("utf-8", errors="replace")
+            continue  # drain fast while data is flowing
+        status = get_job_status(job_id)
+        if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                      JobStatus.STOPPED):
+            while True:  # drain the FULL tail, not one chunk
+                chunk, offset = rt.get(
+                    sup.read_from.remote(offset), timeout=15
+                )
+                if not chunk:
+                    return
+                yield chunk.decode("utf-8", errors="replace")
+        time.sleep(poll_s)
 
 
 def list_jobs() -> List[Dict[str, Any]]:

@@ -47,6 +47,9 @@ def main(argv=None):
     for name in ("status", "logs", "stop"):
         jc = jsub.add_parser(name)
         jc.add_argument("job_id")
+        if name == "logs":
+            jc.add_argument("-f", "--follow", action="store_true",
+                            help="stream logs until the job finishes")
     jsub.add_parser("list")
     args = p.parse_args(argv)
 
@@ -80,7 +83,11 @@ def main(argv=None):
             elif args.job_cmd == "status":
                 print(job_api.get_job_status(args.job_id))
             elif args.job_cmd == "logs":
-                print(job_api.get_job_logs(args.job_id), end="")
+                if getattr(args, "follow", False):
+                    for chunk in job_api.follow_job_logs(args.job_id):
+                        print(chunk, end="", flush=True)
+                else:
+                    print(job_api.get_job_logs(args.job_id), end="")
             elif args.job_cmd == "stop":
                 print(job_api.stop_job(args.job_id))
             elif args.job_cmd == "list":

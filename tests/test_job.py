@@ -51,3 +51,22 @@ def test_job_env_and_metadata(cluster):
     assert job.wait_job(jid, timeout=60) == job.JobStatus.SUCCEEDED
     assert "V=42" in job.get_job_logs(jid)
     assert job.get_job_info(jid)["metadata"]["owner"] == "test"
+
+
+def test_follow_job_logs_streams_until_done(cluster):
+    jid = job.submit_job(
+        f"{sys.executable} -u -c \""
+        "import time\n"
+        "for i in range(5):\n"
+        "    print('tick', i, flush=True)\n"
+        "    time.sleep(0.3)\n"
+        "print('done')\"",
+    )
+    chunks = list(job.follow_job_logs(jid, poll_s=0.2))
+    text = "".join(chunks)
+    assert all(f"tick {i}" in text for i in range(5)), text
+    assert "done" in text
+    # follow streamed incrementally (more than one chunk) and the job
+    # finished
+    assert len(chunks) >= 2
+    assert job.get_job_status(jid) == job.JobStatus.SUCCEEDED
