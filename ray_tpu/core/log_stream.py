@@ -18,6 +18,7 @@ those, same as the reference before its file monitor picks them up.
 
 from __future__ import annotations
 
+import contextvars
 import io
 import os
 import sys
@@ -26,6 +27,14 @@ from typing import Optional, Tuple
 
 _MAX_LINE = 8192
 _BATCH_MAX = 64
+
+# (owner_address, display_name) of the task whose code is running in
+# the current context.  A ContextVar — not a thread-local — because
+# concurrent ASYNC actor methods interleave on one event-loop thread;
+# each asyncio task carries its own context copy.
+log_ctx_var: contextvars.ContextVar[Optional[Tuple[tuple, str]]] = (
+    contextvars.ContextVar("rt_log_ctx", default=None)
+)
 
 
 class _TeeStream(io.TextIOBase):
@@ -86,14 +95,14 @@ class _TeeStream(io.TextIOBase):
 
 
 def _current_ctx() -> Optional[Tuple[tuple, str]]:
-    """(owner_address, display_name) of the task running on this
-    thread, or None outside task execution / when shipping is off."""
+    """(owner_address, display_name) of the task running in this
+    context, or None outside task execution / when shipping is off."""
     from ray_tpu.core.runtime import _runtime
 
     rt = _runtime
     if rt is None or rt._shutdown or not rt.cfg.log_to_driver:
         return None
-    return getattr(rt._task_local, "log_ctx", None)
+    return log_ctx_var.get()
 
 
 def _ship(ctx, stream: str, lines):

@@ -2317,7 +2317,9 @@ class Runtime:
                 else:
                     method = getattr(self.actor_instance, mname)
                 if asyncio.iscoroutinefunction(method):
-                    self._task_local.log_ctx = (spec.owner, spec.name)
+                    from ray_tpu.core.log_stream import log_ctx_var
+
+                    _log_tok = log_ctx_var.set((spec.owner, spec.name))
                     try:
                         with _tracing.execution_span(spec.name, trace_ctx):
                             value = await method(*args, **kwargs)
@@ -2327,12 +2329,14 @@ class Runtime:
                             sys.stderr.flush()
                         except Exception:
                             pass
-                        self._task_local.log_ctx = None
+                        log_ctx_var.reset(_log_tok)
                 else:
 
                     def _call_method():
+                        from ray_tpu.core.log_stream import log_ctx_var
+
                         self._task_local.task_id = spec.task_id
-                        self._task_local.log_ctx = (spec.owner, spec.name)
+                        _log_tok = log_ctx_var.set((spec.owner, spec.name))
                         try:
                             with _tracing.execution_span(spec.name, trace_ctx):
                                 return method(*args, **kwargs)
@@ -2345,14 +2349,16 @@ class Runtime:
                                 sys.stderr.flush()
                             except Exception:
                                 pass
-                            self._task_local.log_ctx = None
+                            log_ctx_var.reset(_log_tok)
 
                     value = await loop.run_in_executor(self._exec_pool, _call_method)
             else:
 
                 def _call():
+                    from ray_tpu.core.log_stream import log_ctx_var
+
                     self._task_local.task_id = spec.task_id
-                    self._task_local.log_ctx = (spec.owner, spec.name)
+                    _log_tok = log_ctx_var.set((spec.owner, spec.name))
                     # registered for mid-execution cancellation
                     # (_h_cancel_task async-raises into this thread);
                     # register/pop under _state_lock so a cancel can
@@ -2376,7 +2382,7 @@ class Runtime:
                                 sys.stderr.flush()
                             except Exception:
                                 pass
-                            self._task_local.log_ctx = None
+                            log_ctx_var.reset(_log_tok)
                             # after this pop no NEW cancel can be
                             # delivered (raise and pop share the lock)
                             with self._state_lock:

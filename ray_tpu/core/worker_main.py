@@ -50,15 +50,15 @@ def main():
     # be pushed the instant registration lands, and its user code may
     # call get_runtime() immediately
     set_runtime(rt)
-    rt.start(node_socket, (host, int(port)),
-             serve_dir=os.path.dirname(node_socket))
-
-    # task/actor prints stream to the owning driver (reference:
+    # tee BEFORE registering: a task can land the instant registration
+    # does, and its first prints must not bypass the stream (reference:
     # log_monitor.py tailing worker files); the tee passes through to
     # this worker's session-dir log file either way
     from ray_tpu.core.log_stream import install_worker_tee
 
     install_worker_tee()
+    rt.start(node_socket, (host, int(port)),
+             serve_dir=os.path.dirname(node_socket))
 
     # exit when the node daemon goes away (socket closes) or parent dies
     ppid = os.getppid()

@@ -17,8 +17,11 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
+    read_numpy,
     read_parquet,
     read_text,
 )
@@ -43,8 +46,11 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
+    "read_numpy",
     "read_parquet",
     "read_text",
 ]

@@ -473,6 +473,11 @@ class Dataset:
     def write_json(self, path: str) -> int:
         return self._write(ds_mod.write_json_block, path)
 
+    def write_numpy(self, path: str, column: str = "data") -> int:
+        return self._write(
+            lambda p: ds_mod.write_numpy_block(p, column), path
+        )
+
     # ---- global aggregates -------------------------------------------
     def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dict[str, Any]:
         states = [a.init() for a in aggs]
@@ -657,3 +662,23 @@ def read_json(paths) -> Dataset:
 
 def read_text(paths) -> Dataset:
     return _read_ds(ds_mod.text_tasks(paths), "Read(text)")
+
+
+def read_numpy(paths) -> Dataset:
+    return _read_ds(ds_mod.numpy_tasks(paths), "Read(numpy)")
+
+
+def read_binary_files(paths, include_paths: bool = True) -> Dataset:
+    return _read_ds(
+        ds_mod.binary_tasks(paths, include_paths=include_paths),
+        "Read(binary)",
+    )
+
+
+def read_images(paths, size=None, mode=None,
+                include_paths: bool = False) -> Dataset:
+    return _read_ds(
+        ds_mod.images_tasks(paths, size=size, mode=mode,
+                            include_paths=include_paths),
+        "Read(images)",
+    )

@@ -130,7 +130,124 @@ def text_tasks(paths) -> List[Callable[[], List[B.Block]]]:
     return [make(f) for f in files]
 
 
+def numpy_tasks(paths) -> List[Callable[[], List[B.Block]]]:
+    """.npy (one `data` column) and .npz (one column per array) files
+    (reference: `_internal/datasource/numpy_datasource.py`)."""
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            if f.endswith(".npz"):
+                with np.load(f) as z:
+                    return [{k: z[k] for k in z.files}]
+            return [{"data": np.load(f)}]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def binary_tasks(paths, include_paths: bool = True) -> List[Callable[[], List[B.Block]]]:
+    """Raw file bytes, one row per file (reference:
+    `_internal/datasource/binary_datasource.py`).  Bytes land in an
+    object-dtype column (ragged payloads)."""
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            with open(f, "rb") as fh:
+                data = fh.read()
+            blk: B.Block = {
+                "bytes": np.asarray([data], dtype=object),
+            }
+            if include_paths:
+                blk["path"] = np.asarray([f])
+            return [blk]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tiff", ".webp")
+
+
+def images_tasks(paths, size: Optional[tuple] = None,
+                 mode: Optional[str] = None,
+                 include_paths: bool = False) -> List[Callable[[], List[B.Block]]]:
+    """Decoded images as HWC uint8 arrays — the TPU-training input
+    format (reference: `_internal/datasource/image_datasource.py`,
+    which also decodes eagerly into numpy).  `size=(h, w)` resizes so
+    rows stack into one dense `image` tensor; without it, mixed
+    dimensions fall back to an object column."""
+    # directories filter to image extensions (a labels.csv next to the
+    # images must not poison the read); explicitly named files pass
+    # through untouched
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.lower().endswith(_IMAGE_EXTS)
+            ))
+        else:
+            files.extend(
+                f for f in _expand_paths(p)
+                if f.lower().endswith(_IMAGE_EXTS) or f == p
+            )
+    if not files:
+        raise FileNotFoundError(f"no image files matched: {paths}")
+
+    def make(f: str):
+        def read():
+            from PIL import Image
+
+            with Image.open(f) as im:
+                if mode:
+                    im = im.convert(mode)
+                if size is not None:
+                    im = im.resize((size[1], size[0]))
+                arr = np.asarray(im)
+            if size is not None:
+                col = arr[None]  # stackable: (1, h, w[, c])
+            else:
+                col = np.empty(1, dtype=object)
+                col[0] = arr
+            blk: B.Block = {"image": col}
+            if include_paths:
+                blk["path"] = np.asarray([f])
+            return [blk]
+
+        return read
+
+    return [make(f) for f in files]
+
+
 # ---- writers (run as map tasks) --------------------------------------
+def write_numpy_block(path_dir: str, column: str = "data"):
+    def write(blk: B.Block) -> List[B.Block]:
+        import uuid
+
+        arr = np.asarray(blk[column])
+        if arr.dtype == object:
+            # np.save would pickle these, and read_numpy (rightly)
+            # loads with allow_pickle=False — fail loudly at write time
+            raise ValueError(
+                f"write_numpy: column {column!r} has object dtype "
+                f"(ragged rows); convert to a dense dtype first"
+            )
+        os.makedirs(path_dir, exist_ok=True)
+        f = os.path.join(path_dir, f"part-{uuid.uuid4().hex[:12]}.npy")
+        np.save(f, arr, allow_pickle=False)
+        return [{"path": np.asarray([f]),
+                 "num_rows": np.asarray([B.num_rows(blk)])}]
+
+    return write
+
+
+
 def write_parquet_block(path_dir: str):
     def write(blk: B.Block) -> List[B.Block]:
         import uuid

@@ -95,6 +95,7 @@ class StreamingExecutor:
         self.max_stage_bytes = ctx.max_stage_inflight_bytes
         self._actor_depth = ctx.actor_pool_pipeline_depth
         self._remote_opts = {"num_cpus": num_cpus, "num_returns": 2}
+        self._meta_sizes: Dict[bytes, int] = {}
         self.stats: Dict[str, Any] = {"stages": self.plan.describe(), "tasks": 0}
 
     # -- stage generators ---------------------------------------------
@@ -113,13 +114,28 @@ class StreamingExecutor:
         """Estimated bytes of an input block, WITHOUT stalling the
         pipeline: metadata is consulted only when already materialized
         (a dict, or a completed task's ready ref) — else 0 (unknown,
-        count-based pressure still applies)."""
+        count-based pressure still applies).  Resolved sizes are cached
+        by ref so multi-stage pipelines probe the runtime once per
+        block, not once per stage (the per-block probe the round-2
+        review flagged)."""
         if isinstance(meta, dict):
             return int(meta.get("size_bytes", 0))
+        cache = self._meta_sizes
+        try:
+            key = meta.binary()
+        except Exception:
+            key = None
+        if key is not None and key in cache:
+            return cache[key]
         try:
             done, _ = rt.wait([meta], timeout=0)
             if done:
-                return int(rt.get(meta).get("size_bytes", 0))
+                size = int(rt.get(meta).get("size_bytes", 0))
+                if key is not None:
+                    if len(cache) > 4096:
+                        cache.clear()
+                    cache[key] = size
+                return size
         except Exception:
             pass
         return 0

@@ -74,7 +74,10 @@ class LogicalPlan:
         return LogicalPlan(self.ops + [op])
 
     def optimized(self) -> "LogicalPlan":
-        """Fuse consecutive MapOps (reference MapFusion)."""
+        """Fuse consecutive MapOps, then fold a leading Map into the
+        Read tasks (reference MapFusion incl. read fusion) — one remote
+        task reads AND transforms, halving task count and object-plane
+        traffic for the common read->map_batches pipeline."""
         fused: List[Op] = []
         for op in self.ops:
             if (
@@ -84,6 +87,13 @@ class LogicalPlan:
             ):
                 prev = fused.pop()
                 fused.append(_fuse(prev, op))
+            elif (
+                isinstance(op, MapOp)
+                and fused
+                and isinstance(fused[-1], ReadOp)
+            ):
+                prev = fused.pop()
+                fused.append(_fuse_read(prev, op))
             else:
                 fused.append(op)
         return LogicalPlan(fused)
@@ -102,3 +112,21 @@ def _fuse(a: MapOp, b: MapOp) -> MapOp:
         return out
 
     return MapOp(fn=fused, name=f"{a.name}->{b.name}")
+
+
+def _fuse_read(r: ReadOp, m: MapOp) -> ReadOp:
+    fm = m.fn
+
+    def make(task):
+        def read_and_map() -> List[B.Block]:
+            out: List[B.Block] = []
+            for blk in task():
+                out.extend(fm(blk))
+            return out
+
+        return read_and_map
+
+    return ReadOp(
+        read_tasks=[make(t) for t in r.read_tasks],
+        name=f"{r.name}->{m.name}",
+    )

@@ -253,8 +253,26 @@ def follow_job_logs(job_id: str, poll_s: float = 0.5):
         yield get_job_logs(job_id)
         return
     offset = 0
+
+    def _file_tail_from(off: int) -> str:
+        # supervisor expired mid-stream (linger timer): the log file has
+        # the rest — valid on the node that hosted it
+        try:
+            info = get_job_info(job_id)
+            with open(info["log_path"], "rb") as f:
+                f.seek(off)
+                return f.read().decode("utf-8", errors="replace")
+        except Exception:
+            return ""
+
     while True:
-        chunk, offset = rt.get(sup.read_from.remote(offset), timeout=15)
+        try:
+            chunk, offset = rt.get(sup.read_from.remote(offset), timeout=15)
+        except Exception:
+            rest = _file_tail_from(offset)
+            if rest:
+                yield rest
+            return
         if chunk:
             yield chunk.decode("utf-8", errors="replace")
             continue  # drain fast while data is flowing
@@ -262,9 +280,15 @@ def follow_job_logs(job_id: str, poll_s: float = 0.5):
         if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
                       JobStatus.STOPPED):
             while True:  # drain the FULL tail, not one chunk
-                chunk, offset = rt.get(
-                    sup.read_from.remote(offset), timeout=15
-                )
+                try:
+                    chunk, offset = rt.get(
+                        sup.read_from.remote(offset), timeout=15
+                    )
+                except Exception:
+                    rest = _file_tail_from(offset)
+                    if rest:
+                        yield rest
+                    return
                 if not chunk:
                     return
                 yield chunk.decode("utf-8", errors="replace")

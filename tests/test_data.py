@@ -27,7 +27,8 @@ def test_map_filter_fusion(rt_start):
     from ray_tpu.data.executor import StreamingExecutor
 
     ex = StreamingExecutor(ds._plan)
-    assert len(ex.plan.ops) == 2  # Read + fused Map
+    # maps fuse together AND fold into the read tasks (read fusion)
+    assert len(ex.plan.ops) == 1
     rows = ds.take_all()
     assert len(rows) == 25
     assert rows[3] == {"id": 6, "sq": 36}
@@ -343,3 +344,72 @@ def test_streaming_split_equal(rt_start):
     assert len(set(counts)) == 1, f"unequal shard sizes: {counts}"
     assert counts[0] >= 100 // 4  # at most n-1 rows dropped overall
     assert len(ids) == len(set(ids))  # no duplication
+
+
+# ----------------------------------------------------------------------
+# round-3 datasource breadth (reference: _internal/datasource/
+# numpy/binary/image datasources) + read->map fusion
+# ----------------------------------------------------------------------
+def test_read_numpy_npy_and_npz(rt_start, tmp_path):
+    np.save(tmp_path / "a.npy", np.arange(6))
+    np.savez(tmp_path / "b.npz", x=np.ones(3), y=np.zeros(3))
+    ds = rd.read_numpy(str(tmp_path / "a.npy"))
+    rows = ds.take_all()
+    assert [r["data"] for r in rows] == list(range(6))
+    ds2 = rd.read_numpy(str(tmp_path / "b.npz"))
+    rows2 = ds2.take_all()
+    assert len(rows2) == 3 and rows2[0]["x"] == 1.0 and rows2[0]["y"] == 0.0
+
+
+def test_write_then_read_numpy_roundtrip(rt_start, tmp_path):
+    out = str(tmp_path / "npy_out")
+    n = rd.range(10).map_batches(
+        lambda b: {"data": b["id"] * 2}
+    ).write_numpy(out)
+    assert n == 10
+    back = rd.read_numpy(out + "/*.npy")
+    vals = sorted(r["data"] for r in back.take_all())
+    assert vals == [i * 2 for i in range(10)]
+
+
+def test_read_binary_files(rt_start, tmp_path):
+    (tmp_path / "one.bin").write_bytes(b"\x01\x02\x03")
+    (tmp_path / "two.bin").write_bytes(b"hello")
+    ds = rd.read_binary_files(str(tmp_path) + "/*.bin")
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert rows[0]["bytes"] == b"\x01\x02\x03"
+    assert rows[1]["bytes"] == b"hello"
+    assert rows[0]["path"].endswith("one.bin")
+
+
+def test_read_images_resized_stack(rt_start, tmp_path):
+    from PIL import Image
+
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0)]):
+        Image.new("RGB", (8 + i * 4, 6), color).save(tmp_path / f"im{i}.png")
+    ds = rd.read_images(str(tmp_path) + "/*.png", size=(16, 16), mode="RGB")
+    rows = ds.take_all()
+    assert len(rows) == 2
+    for r in rows:
+        assert r["image"].shape == (16, 16, 3)
+        assert r["image"].dtype == np.uint8
+    # dominant channels survived the resize
+    sums = sorted(tuple(int(r["image"][..., c].sum() > 0) for c in range(3))
+                  for r in rows)
+    assert sums == [(0, 1, 0), (1, 0, 0)]
+
+
+def test_read_map_fusion_single_task_per_file(rt_start, tmp_path):
+    """A leading map folds into the read tasks: one remote task per
+    file does read AND transform (reference: read fusion)."""
+    for i in range(3):
+        np.save(tmp_path / f"p{i}.npy", np.full(4, i))
+    ds = rd.read_numpy(str(tmp_path) + "/*.npy").map_batches(
+        lambda b: {"data": b["data"] + 100}
+    )
+    from ray_tpu.data.executor import StreamingExecutor
+
+    ex = StreamingExecutor(ds._plan)
+    assert "Read(numpy)->" in ex.plan.describe()
+    vals = sorted(r["data"] for r in ds.take_all())
+    assert vals[:4] == [100] * 4 and len(vals) == 12
