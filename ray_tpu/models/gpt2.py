@@ -45,6 +45,9 @@ class GPT2Config:
     # single largest tensor in the step) at ~1e-3 loss precision;
     # `forward()` always returns f32 logits for inference callers
     logits_dtype: Any = jnp.float32
+    # layer-scan unroll factor: >1 lets XLA fuse/pipeline across block
+    # boundaries at the cost of code size (must divide n_layer)
+    scan_unroll: int = 1
     # remat policy: "full" recomputes the whole block backward (min
     # memory); "dots" saves matmul outputs (checkpoint_policies
     # dots_with_no_batch_dims_saveable); "names" saves exactly the
@@ -56,10 +59,10 @@ class GPT2Config:
     remat_policy: str = "full"
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots", "names"):
+        if self.remat_policy not in ("full", "dots", "names", "half"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
-                "expected 'full', 'dots', or 'names'"
+                "expected 'full', 'dots', 'names', or 'half'"
             )
 
     @property
@@ -164,7 +167,7 @@ def backbone(cfg: GPT2Config, params: Dict, tokens: jax.Array,
 
     blocks = params["blocks"]
 
-    def body(x, layer_params):
+    def _make_one(layer_params):
         # layer_params: one layer's slice of every block param
         from jax.ad_checkpoint import checkpoint_name
 
@@ -208,6 +211,10 @@ def backbone(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             ].astype(cfg.dtype)
             return x1 + h2
 
+        return one
+
+    def body(x, layer_params):
+        one = _make_one(layer_params)
         if cfg.remat:
             if cfg.remat_policy == "dots":
                 fn = jax.checkpoint(
@@ -227,8 +234,26 @@ def backbone(cfg: GPT2Config, params: Dict, tokens: jax.Array,
             fn = one
         return fn(x), None
 
+    def body_pair(x, pair_params):
+        # remat_policy="half": checkpoint only the FIRST of each layer
+        # pair — halves the backward's recompute FLOPs for half the
+        # activation memory of no-remat (the sweet spot when full
+        # activations OOM but full recompute wastes ~2N FLOPs/token)
+        p0 = jax.tree.map(lambda a: a[0], pair_params)
+        p1 = jax.tree.map(lambda a: a[1], pair_params)
+        x = jax.checkpoint(_make_one(p0))(x)
+        return _make_one(p1)(x), None
+
     x = x.astype(cfg.dtype)
-    x, _ = lax.scan(body, x, blocks)
+    if cfg.remat and cfg.remat_policy == "half":
+        if cfg.n_layer % 2:
+            raise ValueError("remat_policy='half' needs an even n_layer")
+        pairs = jax.tree.map(
+            lambda a: a.reshape(cfg.n_layer // 2, 2, *a.shape[1:]), blocks
+        )
+        x, _ = lax.scan(body_pair, x, pairs, unroll=cfg.scan_unroll)
+    else:
+        x, _ = lax.scan(body, x, blocks, unroll=cfg.scan_unroll)
     return _layer_norm(
         x, params["lnf_g"].astype(cfg.dtype), params["lnf_b"].astype(cfg.dtype)
     )

@@ -187,3 +187,41 @@ def test_mixtral_ep_mesh_matches_local():
     np.testing.assert_allclose(
         np.asarray(local_logits), np.asarray(ep_logits), atol=2e-2
     )
+
+
+def test_gpt2_remat_policies_agree():
+    """Every remat policy (and no remat) computes the same loss and
+    gradients — they only trade memory for recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    # f32 compute: bf16 would add save-vs-recompute rounding noise that
+    # has nothing to do with the policies' correctness
+    import jax.numpy as _jnp
+
+    base = dict(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                n_head=4, dtype=_jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 128,
+                                dtype=jnp.int32)
+    ref = None
+    for kwargs in (
+        {"remat": False},
+        {"remat_policy": "full"},
+        {"remat_policy": "dots"},
+        {"remat_policy": "names"},
+        {"remat_policy": "half"},
+        {"remat_policy": "full", "scan_unroll": 2},
+    ):
+        cfg = gpt2.GPT2Config(**base, **kwargs)
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2.loss_fn(cfg, p, tokens)
+        )(params)
+        g0 = float(jnp.asarray(jax.tree.leaves(grads)[0]).sum())
+        if ref is None:
+            ref = (float(loss), g0)
+        else:
+            assert abs(float(loss) - ref[0]) < 1e-4, kwargs
+            assert abs(g0 - ref[1]) < 1e-3, kwargs
