@@ -46,7 +46,8 @@ class GPT2Config:
     # `forward()` always returns f32 logits for inference callers
     logits_dtype: Any = jnp.float32
     # layer-scan unroll factor: >1 lets XLA fuse/pipeline across block
-    # boundaries at the cost of code size (must divide n_layer)
+    # boundaries at the cost of code size (any positive value; the scan
+    # length is n_layer, or n_layer/2 under remat_policy="half")
     scan_unroll: int = 1
     # remat policy: "full" recomputes the whole block backward (min
     # memory); "dots" saves matmul outputs (checkpoint_policies
@@ -64,6 +65,10 @@ class GPT2Config:
                 f"unknown remat_policy {self.remat_policy!r}; "
                 "expected 'full', 'dots', 'names', or 'half'"
             )
+        if self.remat_policy == "half" and self.n_layer % 2:
+            raise ValueError("remat_policy='half' needs an even n_layer")
+        if self.scan_unroll < 1:
+            raise ValueError("scan_unroll must be >= 1")
 
     @property
     def head_dim(self) -> int:

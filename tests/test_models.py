@@ -191,18 +191,11 @@ def test_mixtral_ep_mesh_matches_local():
 
 def test_gpt2_remat_policies_agree():
     """Every remat policy (and no remat) computes the same loss and
-    gradients — they only trade memory for recompute."""
-    import jax
-    import jax.numpy as jnp
-
-    from ray_tpu.models import gpt2
-
-    # f32 compute: bf16 would add save-vs-recompute rounding noise that
-    # has nothing to do with the policies' correctness
-    import jax.numpy as _jnp
-
+    gradients — they only trade memory for recompute.  f32 compute:
+    bf16 would add save-vs-recompute rounding noise that has nothing to
+    do with the policies' correctness."""
     base = dict(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
-                n_head=4, dtype=_jnp.float32)
+                n_head=4, dtype=jnp.float32)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 128,
                                 dtype=jnp.int32)
     ref = None
@@ -219,9 +212,14 @@ def test_gpt2_remat_policies_agree():
         loss, grads = jax.value_and_grad(
             lambda p: gpt2.loss_fn(cfg, p, tokens)
         )(params)
-        g0 = float(jnp.asarray(jax.tree.leaves(grads)[0]).sum())
         if ref is None:
-            ref = (float(loss), g0)
+            ref = (float(loss), grads)
         else:
             assert abs(float(loss) - ref[0]) < 1e-4, kwargs
-            assert abs(g0 - ref[1]) < 1e-3, kwargs
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                    err_msg=str(kwargs),
+                ),
+                grads, ref[1],
+            )
