@@ -103,6 +103,15 @@ class Config:
     #: disable durability entirely (no persist loop) (reference: in-memory vs Redis StoreClient
     #: choice, `redis_store_client.h:106`)
     controller_store_url: str = ""
+    #: fixed TCP port for the controller (0 = ephemeral).  A pinned
+    #: port is what lets worker daemons reconnect to a RESTARTED head
+    #: (reference: raylets reconnect to the GCS at its known address,
+    #: `gcs_redis_failure_detector.h`)
+    controller_port: int = 0
+    #: how long a worker daemon keeps retrying the controller before
+    #: giving up and exiting (reference: `ray_config_def.h`
+    #: gcs_rpc_server_reconnect_timeout_s)
+    controller_reconnect_timeout_s: float = 60.0
 
     # ---- rpc ---------------------------------------------------------
     #: max message size on the control plane

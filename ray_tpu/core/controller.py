@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import rpc
 from ray_tpu.core.config import get_config
-from ray_tpu.core.task_spec import ActorCreationSpec, fits as _fits
+from ray_tpu.core.task_spec import ActorCreationSpec, fits as _fits, match_labels
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +39,20 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.time)
     is_head: bool = False
     conn: Optional[rpc.Connection] = None
+
+
+def filter_by_labels(nodes, label_hard, label_soft):
+    """Label constraints over node candidates (reference:
+    `node_label_scheduling_policy.h:25`): `hard` filters, `soft` only
+    narrows preference when at least one node satisfies it."""
+    if label_hard:
+        nodes = [n for n in nodes if match_labels(label_hard, n.labels)]
+    if label_soft and nodes:
+        preferred = [n for n in nodes
+                     if match_labels(label_soft, n.labels)]
+        if preferred:
+            nodes = preferred
+    return nodes
 
 
 @dataclass
@@ -81,10 +95,15 @@ class Controller:
         self.kv: Dict[str, bytes] = {}
         self.jobs: Dict[str, Dict] = {}
         self.placement_groups: Dict[bytes, Any] = {}  # filled by placement module
+        self._rehydrated_pgs: Dict[str, Dict] = {}  # set by load_persisted
         self.pending_demand: Dict[tuple, float] = {}  # demand sig -> last ts
         from collections import deque
 
         self.task_events: deque = deque(maxlen=50_000)
+        # structured cluster events (reference: `src/ray/util/event.h` +
+        # `dashboard/modules/event/` — lifecycle events surfaced
+        # cluster-wide)
+        self.cluster_events: deque = deque(maxlen=10_000)
         self._pg_manager = None  # set by placement module
         self._health_task: Optional[asyncio.Task] = None
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
@@ -104,9 +123,14 @@ class Controller:
                 # drivers on restart)
                 if job.get("status") == "RUNNING":
                     job["status"] = "DEAD"
+            # placement groups rehydrate once the PG manager attaches
+            # (reference: GcsInitData placement-group table); bundle
+            # reservations re-apply as their nodes re-register
+            self._rehydrated_pgs = snap.get("pgs", {})
             logger.info(
-                "controller rehydrated %d kv keys, %d jobs via %s",
+                "controller rehydrated %d kv keys, %d jobs, %d pgs via %s",
                 len(self.kv), len(self.jobs),
+                len(self._rehydrated_pgs),
                 type(self._store).__name__,
             )
         except Exception as e:  # noqa: BLE001 — rehydration is
@@ -131,8 +155,20 @@ class Controller:
                     v = cloudpickle.dumps(v)  # kv contract is bytes, but
                     # the store must never be the thing that breaks
                 kv[k] = bytes(v)
+            pgs = {}
+            for pid, info in self.placement_groups.items():
+                if getattr(info, "state", None) == "REMOVED":
+                    continue
+                pgs[pid.hex()] = {
+                    "bundles": [dict(b) for b in info.bundles],
+                    "strategy": info.strategy,
+                    "state": info.state,
+                    "bundle_nodes": list(info.bundle_nodes),
+                    "name": info.name,
+                }
             self._store.save(
-                {"kv": kv, "jobs": self.jobs, "ts": time.time()}
+                {"kv": kv, "jobs": self.jobs, "pgs": pgs,
+                 "ts": time.time()}
             )
             self._dirty = False
             return True
@@ -174,11 +210,29 @@ class Controller:
                     if misses[node.node_id] >= threshold:
                         await self._mark_node_dead(node, "health check failed")
 
+    def _record_event(self, event_type: str, message: str,
+                      severity: str = "INFO", **custom_fields):
+        from ray_tpu.util.events import make_event
+
+        self.cluster_events.append(make_event(
+            event_type, message, severity=severity, source="controller",
+            **custom_fields,
+        ))
+
     async def _mark_node_dead(self, node: NodeInfo, reason: str):
         if not node.alive:
             return
+        if self.nodes.get(node.node_id) is not node:
+            # a newer registration superseded this NodeInfo (daemon
+            # reconnect): the stale connection's close must not kill
+            # the live node or fail over its actors
+            return
         logger.warning("node %s dead: %s", node.node_id, reason)
         node.alive = False
+        self._record_event(
+            "NODE_DEAD", f"node {node.node_id[:8]} dead: {reason}",
+            severity="WARNING", node_id=node.node_id, reason=reason,
+        )
         self._publish("node_dead", {"node_id": node.node_id, "reason": reason})
         # restart or bury actors that lived there
         for info in list(self.actors.values()):
@@ -213,7 +267,23 @@ class Controller:
             conn.on_close = lambda c, n=node: asyncio.ensure_future(
                 self._mark_node_dead(n, "connection lost")
             )
+        # re-apply CREATED placement-group reservations charged to this
+        # node: registration always reports FULL capacity, so both a
+        # daemon reconnect and a controller-restart re-registration
+        # would otherwise forget the bundles (reference: raylets restore
+        # PG bundle resources on GCS restart)
+        for info in self.placement_groups.values():
+            if getattr(info, "state", None) != "CREATED":
+                continue
+            for idx, nid in enumerate(info.bundle_nodes):
+                if nid == node.node_id:
+                    for k, v in info.bundles[idx].items():
+                        node.resources[k] = node.resources.get(k, 0.0) - v
         self._publish("node_added", {"node_id": node.node_id})
+        self._record_event(
+            "NODE_ADDED", f"node {node.node_id[:8]} joined",
+            node_id=node.node_id, resources=dict(node.resources),
+        )
         logger.info("node registered: %s resources=%s", node.node_id, node.resources)
         if self._pg_manager is not None:
             self._pg_manager.retry_pending()
@@ -293,6 +363,10 @@ class Controller:
             out = [n for n in self.nodes.values() if n.alive]
             if strategy.kind == "node_affinity" and strategy.node_id:
                 out = [n for n in out if n.node_id == strategy.node_id]
+            if strategy.kind == "node_labels":
+                out = filter_by_labels(
+                    out, strategy.label_hard, strategy.label_soft
+                )
             if (self._pg_manager is not None
                     and strategy.kind == "placement_group"):
                 node_id = self._pg_manager.node_for_bundle(
@@ -335,9 +409,69 @@ class Controller:
         detail = "; ".join(errors) if errors else "no alive candidate nodes"
         return False, f"no node can host actor: {detail}"
 
+    async def handle_readopt_actor(self, payload, conn):
+        """A (re)connecting daemon reports an actor it already hosts;
+        rebuild the registry entry + named lookup so a restarted
+        controller heals without restarting user state (reference: GCS
+        restart re-binds live actors from GcsInitData +
+        raylet re-registration, `gcs_actor_manager.h`)."""
+        spec: ActorCreationSpec = payload["spec"]
+        aid = spec.actor_id.binary()
+        addr = (payload["node_id"], payload["worker_id"])
+        if spec.name:
+            holder = self.named_actors.get((spec.namespace, spec.name))
+            if holder is not None and holder != aid:
+                # the name was re-claimed by a NEW actor created after
+                # the controller restarted: the old copy must not steal
+                # it back — two live actors under one name
+                self._record_event(
+                    "ACTOR_READOPT_REJECTED",
+                    f"actor {spec.actor_id.hex()[:8]} readopt rejected "
+                    f"(name {spec.name!r} held by a newer actor)",
+                    severity="WARNING", actor_id=spec.actor_id.hex(),
+                )
+                return {"ok": False, "action": "kill"}
+        info = self.actors.get(aid)
+        if info is not None and (
+            info.state in ("RESTARTING", "DEAD")
+            or (info.address is not None and tuple(info.address) != addr)
+        ):
+            # the controller already failed this actor over (transient
+            # connection drop -> _mark_node_dead -> restart elsewhere):
+            # accepting the re-adoption would leave TWO live copies.
+            # The stale copy must die instead.
+            self._record_event(
+                "ACTOR_READOPT_REJECTED",
+                f"actor {spec.actor_id.hex()[:8]} readopt rejected "
+                f"(state={info.state})",
+                severity="WARNING", actor_id=spec.actor_id.hex(),
+            )
+            return {"ok": False, "action": "kill"}
+        if info is None:
+            info = ActorInfo(spec=spec)
+            self.actors[aid] = info
+        info.state = "ALIVE"
+        info.address = addr
+        if spec.name:
+            self.named_actors[(spec.namespace, spec.name)] = aid
+        self._record_event(
+            "ACTOR_READOPTED",
+            f"actor {spec.actor_id.hex()[:8]} re-adopted from node "
+            f"{payload['node_id'][:8]}",
+            actor_id=spec.actor_id.hex(), node_id=payload["node_id"],
+        )
+        return {"ok": True}
+
     async def _handle_actor_failure(self, info: ActorInfo, cause: str):
         """Restart policy (reference: gcs_actor_manager.h:274 restart on
         worker/node death up to max_restarts)."""
+        self._record_event(
+            "ACTOR_FAILED",
+            f"actor {info.spec.actor_id.hex()[:8]} failed: {cause}",
+            severity="WARNING", actor_id=info.spec.actor_id.hex(),
+            cause=cause,
+            will_restart=info.restarts_used < info.spec.max_restarts,
+        )
         if info.restarts_used < info.spec.max_restarts:
             info.restarts_used += 1
             info.state = "RESTARTING"
@@ -369,6 +503,17 @@ class Controller:
     async def handle_actor_worker_died(self, payload, conn):
         info = self.actors.get(payload["actor_id"])
         if info and info.state == "ALIVE":
+            # only the node CURRENTLY hosting the actor may report its
+            # death: a reconnecting daemon killing a stale superseded
+            # copy (readopt rejected) must not fail over the healthy
+            # replacement running elsewhere
+            reporter = payload.get("node_id")
+            if (
+                reporter is not None
+                and info.address is not None
+                and info.address[0] != reporter
+            ):
+                return {"ok": True, "ignored": "stale host"}
             await self._handle_actor_failure(info, payload.get("cause", "worker died"))
         return {"ok": True}
 
@@ -497,6 +642,29 @@ class Controller:
         out.reverse()
         return out
 
+    # ---- structured cluster events (reference: `src/ray/util/event.h`,
+    # `dashboard/modules/event/`) --------------------------------------
+    async def handle_report_cluster_event(self, payload, conn):
+        self.cluster_events.append(payload["event"])
+        return {"ok": True}
+
+    async def handle_list_cluster_events(self, payload, conn):
+        payload = payload or {}
+        severity = payload.get("severity")
+        event_type = payload.get("event_type")
+        limit = payload.get("limit", 200)
+        out = []
+        for ev in reversed(self.cluster_events):
+            if severity and ev.get("severity") != severity:
+                continue
+            if event_type and ev.get("event_type") != event_type:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
     async def handle_report_pending_demand(self, payload, conn):
         """Demand ledger for the autoscaler (reference:
         `gcs_autoscaler_state_manager.h` pending resource demand)."""
@@ -506,19 +674,51 @@ class Controller:
         self.pending_demand[sig] = _t.time()
         return {"ok": True}
 
-    async def handle_report_node_load(self, payload, conn):
-        n = self.nodes.get(payload["node_id"])
-        if n is not None:
-            import time as _t
+    _LOAD_FIELDS = ("used", "busy", "queued", "workers", "host")
 
+    async def handle_report_node_load(self, payload, conn):
+        """Versioned delta sync of per-node load (reference:
+        `ray_syncer.h:88` — nodes broadcast deltas against a shared
+        version; periodic full snapshots heal any divergence).
+
+        Payload forms:
+        - `{"v": n, "full": {...}}`        — full snapshot, always applied
+        - `{"v": n, "base": m, "delta": {...}}` — applied only when the
+          stored version == m; otherwise dropped (a later full heals)
+        - `{"v": n}`                        — heartbeat: nothing changed,
+          refresh the staleness clock only
+        - legacy flat payload (no "v")      — treated as a full snapshot
+        """
+        n = self.nodes.get(payload["node_id"])
+        if n is None:
+            return {"ok": True}
+        import time as _t
+
+        now = _t.time()
+        load = getattr(n, "load", None)
+        if "v" not in payload:  # legacy flat full report
             n.load = {
+                **{f: payload.get(f) for f in self._LOAD_FIELDS},
                 "used": payload.get("used", {}),
                 "busy": payload.get("busy", False),
                 "queued": payload.get("queued", 0),
-                "workers": payload.get("workers"),
-                "host": payload.get("host"),
-                "ts": _t.time(),
+                "ts": now,
+                "v": 0,
             }
+            return {"ok": True}
+        v = payload["v"]
+        if "full" in payload:
+            n.load = {**payload["full"], "ts": now, "v": v}
+        elif "delta" in payload:
+            if load is not None and load.get("v") == payload.get("base"):
+                load.update(payload["delta"])
+                load["ts"] = now
+                load["v"] = v
+            # else: divergent base — drop; the sender's periodic full
+            # snapshot resynchronizes within a few ticks
+        else:  # heartbeat
+            if load is not None and load.get("v") == v:
+                load["ts"] = now
         return {"ok": True}
 
     async def handle_get_worker_snapshot(self, payload, conn):
@@ -549,8 +749,22 @@ class Controller:
             if now - ts < 5.0
         }
         self.pending_demand = fresh
+        # gang demand: PENDING placement groups whose bundles no current
+        # node set can host (reference: `gcs_autoscaler_state_manager.h`
+        # reports pending PG demand so the autoscaler can provision a
+        # whole slice as one unit)
+        pending_gangs = [
+            {
+                "pg_id": pid.hex() if hasattr(pid, "hex") else str(pid),
+                "bundles": [dict(b) for b in info.bundles],
+                "strategy": info.strategy,
+            }
+            for pid, info in self.placement_groups.items()
+            if getattr(info, "state", None) == "PENDING"
+        ]
         return {
             "pending_demands": [dict(sig) for sig in fresh],
+            "pending_gangs": pending_gangs,
             "nodes": [
                 {
                     "node_id": n.node_id,
@@ -618,6 +832,9 @@ class Controller:
             if n.alive and n.node_id not in exclude
             and _fits(demand, n.resources)
         ]
+        feasible = filter_by_labels(
+            feasible, payload.get("label_hard"), payload.get("label_soft")
+        )
         if not feasible:
             return None
         if payload.get("spread"):

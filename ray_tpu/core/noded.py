@@ -37,7 +37,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 from ray_tpu.core import accelerators, rpc
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID
-from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits
+from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits, match_labels
 from ray_tpu.shm import ObjectExistsError, ShmStore
 
 logger = logging.getLogger(__name__)
@@ -113,6 +113,10 @@ class NodeDaemon:
         self.store: Optional[ShmStore] = None
         self.workers: Dict[str, WorkerState] = {}  # worker_id -> state
         self._conn_worker: Dict[rpc.Connection, str] = {}
+        # actor_id -> (ActorCreationSpec, worker_id) for actors this
+        # node hosts — re-reported to a restarted controller so the
+        # registry heals (re-adoption)
+        self._hosted_actors: Dict[bytes, Tuple[Any, str]] = {}
         self.task_queue: Deque[TaskSpec] = deque()
         self.controller_addr = controller_addr
         self.controller_conn: Optional[rpc.Connection] = None
@@ -170,25 +174,15 @@ class NodeDaemon:
             self.controller.load_persisted()
             self.controller._pg_manager = PlacementGroupManager(self.controller)
             ctl_server = rpc.Server(self.controller, name="controller")
-            self.controller_port = await ctl_server.start_tcp("127.0.0.1", 0)
+            self.controller_port = await ctl_server.start_tcp(
+                "127.0.0.1", self.cfg.controller_port
+            )
             self._ctl_server = ctl_server
             self.controller.start_health_checks()
             self.controller_addr = ("127.0.0.1", self.controller_port)
 
         # register with the controller like any node
-        self.controller_conn = await rpc.connect_tcp(
-            *self.controller_addr, handler=self._ctl_push, name="noded->controller"
-        )
-        await self.controller_conn.call(
-            "register_node",
-            {
-                "node_id": self.node_id,
-                "addr": ("127.0.0.1", self.tcp_port),
-                "resources": dict(self.total_resources),
-                "is_head": self.is_head,
-                "labels": dict(self.node_labels),
-            },
-        )
+        await self._connect_controller()
         for _ in range(self.num_workers):
             self._spawn_worker()
         asyncio.ensure_future(self._retry_queue_loop())
@@ -200,6 +194,88 @@ class NodeDaemon:
             self.num_workers,
             self.total_resources,
         )
+
+    async def _connect_controller(self):
+        """Connect + register with the controller; arms the reconnect
+        handler so a worker daemon survives a head/controller restart
+        (reference: raylets reconnect to a restarted GCS and the
+        cluster keeps running through the downtime,
+        `gcs_redis_failure_detector.h` + test_gcs_fault_tolerance)."""
+        self.controller_conn = await rpc.connect_tcp(
+            *self.controller_addr, handler=self._ctl_push,
+            name="noded->controller",
+        )
+        if not self.is_head:
+            self.controller_conn.on_close = self._on_controller_lost
+        await self.controller_conn.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "addr": ("127.0.0.1", self.tcp_port),
+                "resources": dict(self.total_resources),
+                "is_head": self.is_head,
+                "labels": dict(self.node_labels),
+            },
+        )
+        # re-adopt: tell the (possibly restarted) controller which
+        # actors this node already hosts, so the registry and named
+        # lookups heal without restarting user state
+        for aid, (aspec, worker_id) in list(self._hosted_actors.items()):
+            if worker_id in self.workers:
+                try:
+                    reply = await self.controller_conn.call(
+                        "readopt_actor",
+                        {"spec": aspec, "node_id": self.node_id,
+                         "worker_id": worker_id},
+                    )
+                except Exception:
+                    logger.exception("actor re-adoption failed")
+                    continue
+                if not reply.get("ok") and reply.get("action") == "kill":
+                    # the controller failed this actor over during the
+                    # disconnect — this copy is stale and must not keep
+                    # running beside its replacement
+                    logger.warning(
+                        "killing stale actor copy %s (superseded during "
+                        "controller disconnect)", aspec.actor_id.hex()[:8],
+                    )
+                    self._hosted_actors.pop(aid, None)
+                    w = self.workers.get(worker_id)
+                    if w is not None:
+                        # unlink the actor BEFORE the kill: the exit
+                        # handler must not report an actor death for a
+                        # copy the controller already replaced
+                        w.actor_id = None
+                        try:
+                            os.kill(w.pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            pass
+        # force the next load report to be a FULL snapshot: the new
+        # controller has no delta base
+        self._last_load_report = None
+
+    def _on_controller_lost(self, conn):
+        if self._draining:
+            return
+        logger.warning("controller connection lost; reconnecting")
+        asyncio.ensure_future(self._reconnect_controller())
+
+    async def _reconnect_controller(self):
+        deadline = time.monotonic() + self.cfg.controller_reconnect_timeout_s
+        while time.monotonic() < deadline:
+            if self._draining:
+                return
+            try:
+                await self._connect_controller()
+                logger.info("reconnected to controller")
+                return
+            except Exception:
+                await asyncio.sleep(1.0)
+        logger.error(
+            "controller unreachable for %.0fs; daemon shutting down",
+            self.cfg.controller_reconnect_timeout_s,
+        )
+        os.kill(os.getpid(), signal.SIGTERM)
 
     async def _ctl_push(self, method, payload, conn):
         if method == "ping":
@@ -289,12 +365,20 @@ class NodeDaemon:
         for spec in w.in_flight.values():
             result = TaskResult(task_id=spec.task_id, status="worker_died")
             asyncio.ensure_future(self._route_to_owner(spec.owner, "task_result", result))
+        # the tasks are dead with the worker: clear them BEFORE the
+        # lease release, whose not-in-flight guard would otherwise skip
+        # the resource refund forever (the worker is about to become
+        # unreachable)
+        w.in_flight = {}
         self._release_lease(w)
-        if w.actor_id is not None and self.controller_conn:
-            self.controller_conn.send(
-                "actor_worker_died",
-                {"actor_id": w.actor_id, "cause": reason},
-            )
+        if w.actor_id is not None:
+            self._hosted_actors.pop(w.actor_id, None)
+            if self.controller_conn:
+                self.controller_conn.send(
+                    "actor_worker_died",
+                    {"actor_id": w.actor_id, "cause": reason,
+                     "node_id": self.node_id},
+                )
         if w.kind == "worker" and not self._draining:
             self._spawn_worker()
         self._schedule()
@@ -349,6 +433,42 @@ class NodeDaemon:
                         result = TaskResult(task_id=spec.task_id, status="worker_died")
                         await self._route_to_owner(spec.owner, "task_result", result)
                         return
+        elif strat.kind == "node_labels":
+            # a daemon with a local HARD match may host the task
+            # outright (soft is only a preference); otherwise — local
+            # hard miss, or a soft-only strategy that must see the
+            # cluster-wide soft candidates — the controller picks via
+            # filter_by_labels.  `label_routed` marks an already-routed
+            # forward so the receiving daemon queues in one hop, while
+            # the constraints stay attached for label-aware spillback.
+            if strat.label_routed or (
+                strat.label_hard
+                and match_labels(strat.label_hard, self.node_labels)
+            ):
+                target = self.node_id
+            else:
+                target = await self.controller_conn.call(
+                    "find_node_for",
+                    {"resources": spec.resources.as_dict(), "exclude": [],
+                     "label_hard": strat.label_hard,
+                     "label_soft": strat.label_soft},
+                )
+            if target is None:
+                from ray_tpu.core import serialization as ser
+
+                result = TaskResult(
+                    task_id=spec.task_id, status="infeasible",
+                    error=ser.serialize_to_bytes(ValueError(
+                        "no node matches NodeLabelSchedulingStrategy hard "
+                        f"expressions {strat.label_hard}"),
+                        tag=ser.TAG_ERROR),
+                )
+                await self._route_to_owner(spec.owner, "task_result", result)
+                return
+            if target != self.node_id:
+                spec.strategy.label_routed = True
+                (await self._node_conn(target)).send("submit_task", spec)
+                return
         elif strat.kind == "spread":
             target = await self.controller_conn.call(
                 "find_node_for",
@@ -521,19 +641,48 @@ class NodeDaemon:
                     load1 = os.getloadavg()[0]
                 except OSError:
                     load1 = 0.0
+                report = {
+                    "used": used, "busy": busy,
+                    "queued": len(self.task_queue),
+                    "workers": self._worker_inventory(),
+                    "host": {
+                        "load1": load1,
+                        "mem_used": mem_used,
+                        "mem_total": mem_total,
+                    },
+                }
                 self.controller_conn.send(
-                    "report_node_load",
-                    {"node_id": self.node_id, "used": used, "busy": busy,
-                     "queued": len(self.task_queue),
-                     "workers": self._worker_inventory(),
-                     "host": {
-                         "load1": load1,
-                         "mem_used": mem_used,
-                         "mem_total": mem_total,
-                     }},
+                    "report_node_load", self._load_sync_payload(report)
                 )
             except Exception:
                 pass
+
+    # RaySyncer-style delta sync (reference: `ray_syncer.h:88`): send
+    # only fields that changed since the last report, a bare-version
+    # heartbeat when nothing did, and a full snapshot every
+    # LOAD_FULL_EVERY ticks so a restarted/diverged controller
+    # resynchronizes without a handshake.
+    LOAD_FULL_EVERY = 10
+
+    def _load_sync_payload(self, report: Dict[str, Any]) -> Dict[str, Any]:
+        tick = self._load_tick = getattr(self, "_load_tick", 0) + 1
+        last = getattr(self, "_last_load_report", None)
+        v = getattr(self, "_load_v", 0)
+        if last is None or tick % self.LOAD_FULL_EVERY == 0:
+            self._load_v = v = v + 1
+            payload = {"node_id": self.node_id, "v": v, "full": report}
+        else:
+            delta = {
+                k: val for k, val in report.items() if last.get(k) != val
+            }
+            if delta:
+                self._load_v = v = v + 1
+                payload = {"node_id": self.node_id, "v": v,
+                           "base": v - 1, "delta": delta}
+            else:
+                payload = {"node_id": self.node_id, "v": v}  # heartbeat
+        self._last_load_report = report
+        return payload
 
     # ------------------------------------------------------------------
     # object spilling (reference: LocalObjectManager, SpillObjects
@@ -673,15 +822,19 @@ class NodeDaemon:
 
     async def _maybe_spill(self, spec: TaskSpec):
         """Spillback: if this node can never or not-soon run the task,
-        hand it to another node (reference: cluster_task_manager.cc:44)."""
+        hand it to another node (reference: cluster_task_manager.cc:44).
+        Hard label constraints ride along — spillback must never move a
+        task onto a node its NodeLabelSchedulingStrategy excludes."""
         demand = spec.resources.as_dict()
         if _fits(demand, self.total_resources):
             return  # feasible here, just busy: keep queued
         if self.controller_conn is None:
             return
-        target = await self.controller_conn.call(
-            "find_node_for", {"resources": demand, "exclude": [self.node_id]}
-        )
+        query = {"resources": demand, "exclude": [self.node_id]}
+        if spec.strategy.kind == "node_labels":
+            query["label_hard"] = spec.strategy.label_hard
+            query["label_soft"] = spec.strategy.label_soft
+        target = await self.controller_conn.call("find_node_for", query)
         if target is None:
             # unschedulable cluster-wide: feed the autoscaler's demand
             # ledger (reference: pending demand in LoadMetrics driving
@@ -1461,6 +1614,9 @@ class NodeDaemon:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) + v
             return {"ok": False, "error": reply.get("error", "init failed")}
+        self._hosted_actors[aspec.actor_id.binary()] = (
+            aspec, target.worker_id
+        )
         # replace the consumed pool worker
         if sum(1 for w in self.workers.values() if w.kind == "worker" and w.actor_id is None) < self.num_workers:
             self._spawn_worker()

@@ -45,6 +45,25 @@ class PlacementGroupManager:
         self.controller = controller
         self.groups: Dict[bytes, PlacementGroupInfo] = {}
         controller.placement_groups = self.groups
+        # rehydrate groups persisted by a previous controller
+        # incarnation (reference: GcsInitData placement-group table) —
+        # reservations re-apply per node in handle_register_node as
+        # daemons (re)register with full capacity
+        for pid_hex, d in getattr(controller, "_rehydrated_pgs",
+                                  {}).items():
+            info = PlacementGroupInfo(
+                pg_id=bytes.fromhex(pid_hex),
+                bundles=[dict(b) for b in d["bundles"]],
+                strategy=d["strategy"],
+                state=d["state"],
+                bundle_nodes=list(d["bundle_nodes"]),
+                name=d.get("name", ""),
+                ready_event=asyncio.Event(),
+            )
+            if info.state == "CREATED":
+                info.ready_event.set()
+            self.groups[info.pg_id] = info
+        controller._rehydrated_pgs = {}
 
     async def create(self, pg_id: bytes, bundles, strategy: str, name: str = "") -> PlacementGroupInfo:
         info = PlacementGroupInfo(
@@ -72,6 +91,7 @@ class PlacementGroupManager:
         info.bundle_nodes = placed
         info.state = "CREATED"
         info.ready_event.set()
+        self.controller._mark_dirty()
         return True
 
     def retry_pending(self):
@@ -170,4 +190,5 @@ class PlacementGroupManager:
                 for k, v in info.bundles[idx].items():
                     node.resources[k] = node.resources.get(k, 0.0) + v
         info.state = "REMOVED"
+        self.controller._mark_dirty()
         self.retry_pending()

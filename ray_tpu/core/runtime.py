@@ -647,7 +647,12 @@ class Runtime:
         if renv:
             # tasks with a runtime env run on DEDICATED workers keyed
             # by env hash (reference: worker-pool runtime-env matching)
-            from ray_tpu.core.runtime_env import runtime_env_hash
+            from ray_tpu.core.runtime_env import (
+                runtime_env_hash,
+                validate_runtime_env,
+            )
+
+            validate_runtime_env(renv)
 
             renv = self._run(self._prepare_runtime_env(dict(renv)))
             env_hash = runtime_env_hash(renv)
@@ -1058,6 +1063,10 @@ class Runtime:
 
     async def _create_actor(self, cls, args, kwargs, options):
         renv = options.get("runtime_env")
+        if renv:
+            from ray_tpu.core.runtime_env import validate_runtime_env
+
+            validate_runtime_env(renv)
         if renv and renv.get("py_modules"):
             options = dict(options)
             options["runtime_env"] = await self._prepare_runtime_env(renv)
@@ -1584,6 +1593,24 @@ class Runtime:
             raise ValueError("num_returns exceeds number of refs")
         done_flags = [False] * len(refs)
 
+        # Synchronous readiness scan FIRST: already-ready refs (and the
+        # `wait(timeout=0)` poll controllers issue every tick) cost zero
+        # task allocations.  Without this, a 1k-ref drain loop
+        # (`done, pending = wait(pending, 1)`) re-arms a coroutine per
+        # ref per call — O(n^2) task churn across the drain.
+        pending_idx: List[int] = []
+        for i, r in enumerate(refs):
+            st = self.objects.get(r.binary())
+            if st is not None:
+                if st.ready.is_set():
+                    done_flags[i] = True
+                else:
+                    pending_idx.append(i)
+            elif self.store.contains(r.binary()):
+                done_flags[i] = True
+            else:
+                pending_idx.append(i)
+
         async def _one(i, r):
             st = self.objects.get(r.binary())
             if st is not None:
@@ -1608,15 +1635,15 @@ class Runtime:
                     await asyncio.sleep(0.005)
             done_flags[i] = True
 
-        tasks = [asyncio.create_task(_one(i, r)) for i, r in enumerate(refs)]
-        # one scheduling pass so each waiter observes already-ready
-        # objects — without it `wait(timeout=0)` (the non-blocking poll
-        # used by controllers) would always report nothing ready
-        await asyncio.sleep(0)
-        tasks = [t for t in tasks if not t.done()]
+        tasks: List[asyncio.Task] = []
+        if sum(done_flags) < num_returns and (timeout is None or timeout > 0):
+            # waiters only for the refs the scan saw as pending
+            tasks = [
+                asyncio.create_task(_one(i, refs[i])) for i in pending_idx
+            ]
         try:
             deadline = None if timeout is None else time.monotonic() + timeout
-            while sum(done_flags) < num_returns:
+            while tasks and sum(done_flags) < num_returns:
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     break

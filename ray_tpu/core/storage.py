@@ -5,10 +5,12 @@ file-system, and Redis backends behind one interface
 (`store_client.h`, `redis_store_client.h:106`), which is what makes
 GCS fault tolerance a deployment choice rather than a code path.
 
-Here the durable unit is the controller SNAPSHOT (kv + jobs): backends
-implement atomic save/load of one snapshot dict
+Here the durable unit is the controller SNAPSHOT (kv + jobs +
+placement groups): backends implement atomic save/load of one snapshot
+dict
 
-    {"kv": {str: bytes}, "jobs": {str: dict}, "ts": float}
+    {"kv": {str: bytes}, "jobs": {str: dict}, "pgs": {str: dict},
+     "ts": float}
 
 - ``FileStoreClient``: json + base64, atomic rename (the default —
   survives head-process restart on one machine),
@@ -81,6 +83,7 @@ class FileStoreClient(StoreClient):
                 for k, v in raw.get("kv", {}).items()
             },
             "jobs": raw.get("jobs", {}),
+            "pgs": raw.get("pgs", {}),
             "ts": raw.get("ts", 0.0),
         }
 
@@ -91,6 +94,7 @@ class FileStoreClient(StoreClient):
                 for k, v in snapshot.get("kv", {}).items()
             },
             "jobs": snapshot.get("jobs", {}),
+            "pgs": snapshot.get("pgs", {}),
             "ts": snapshot.get("ts", time.time()),
         }
         tmp = self.path + ".tmp"

@@ -88,6 +88,7 @@ class SchedulingStrategy:
     """Placement constraints (reference: `util/scheduling_strategies.py`).
 
     kind: "default" | "spread" | "node_affinity" | "placement_group"
+         | "node_labels"
     """
 
     kind: str = "default"
@@ -96,6 +97,39 @@ class SchedulingStrategy:
     pg_id: Optional[bytes] = None
     pg_bundle_index: int = -1
     pg_capture_child_tasks: bool = False
+    # label expressions for kind="node_labels": lists of
+    # (key, op, values) with op in {"in","not_in","exists","does_not_exist"}
+    # (reference: `util/scheduling_strategies.py:135`
+    # NodeLabelSchedulingStrategy hard/soft expression maps)
+    label_hard: Optional[List[Tuple[str, str, List[str]]]] = None
+    label_soft: Optional[List[Tuple[str, str, List[str]]]] = None
+    # set when a daemon already routed this task via the controller's
+    # label-aware pick: the receiving daemon queues locally instead of
+    # re-routing (keeps daemon-to-daemon forwards one-hop while the
+    # label constraints stay attached for label-aware spillback)
+    label_routed: bool = False
+
+
+def match_labels(exprs, labels: Dict[str, str]) -> bool:
+    """True when every (key, op, values) expression holds for `labels`
+    (reference semantics: `node_label_scheduling_policy.h:25`)."""
+    for key, op, values in exprs or []:
+        present = key in labels
+        if op == "exists":
+            if not present:
+                return False
+        elif op == "does_not_exist":
+            if present:
+                return False
+        elif op == "in":
+            if not present or labels[key] not in values:
+                return False
+        elif op == "not_in":
+            if present and labels[key] in values:
+                return False
+        else:
+            raise ValueError(f"unknown label operator: {op}")
+    return True
 
 
 @dataclass

@@ -318,7 +318,8 @@ def register_core_schemas():
                       ["num_cpus", "num_tpus", "memory", "custom"])
     registry.register(_ts.SchedulingStrategy,
                       ["kind", "node_id", "soft", "pg_id",
-                       "pg_bundle_index", "pg_capture_child_tasks"])
+                       "pg_bundle_index", "pg_capture_child_tasks",
+                       "label_hard", "label_soft", "label_routed"])
     registry.register(_ts.TaskSpec, [
         "task_id", "function_id", "function_blob", "args", "kwargs",
         "num_returns", "owner", "resources", "max_retries",

@@ -1,0 +1,177 @@
+"""Grafana dashboard factory + built-in cluster metrics.
+
+Reference: `dashboard/modules/metrics/grafana_dashboard_factory.py` —
+Grafana dashboard JSON generated from declarative panel configs over the
+metrics the cluster exports, so operators import one file instead of
+hand-building boards.  `rt grafana-dashboard --out d/` and
+`GET /api/grafana_dashboard` both emit it.
+
+The built-in gauges mirror the reference's core `ray_*` series
+(`src/ray/stats/metric_defs.h:46-120` — nodes/actors/scheduler/object
+store) and are refreshed from controller state at scrape time by the
+dashboard's `/metrics` handler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.util.metrics import Gauge
+
+# -- built-in cluster metrics -------------------------------------------
+_builtin: Dict[str, Gauge] = {}
+
+
+def _gauge(name: str, desc: str, tag_keys=()) -> Gauge:
+    g = _builtin.get(name)
+    if g is None:
+        g = _builtin[name] = Gauge(name, desc, tag_keys=tag_keys)
+    return g
+
+
+async def update_builtin_metrics(ctl):
+    """Refresh cluster gauges from controller state; `ctl(method,
+    payload=None)` is the dashboard's controller-call coroutine."""
+    nodes = await ctl("get_nodes") or []
+    _gauge("rt_nodes", "cluster nodes by liveness", ("state",)).set(
+        float(sum(1 for n in nodes if n["alive"])), {"state": "alive"}
+    )
+    _gauge("rt_nodes", "cluster nodes by liveness", ("state",)).set(
+        float(sum(1 for n in nodes if not n["alive"])), {"state": "dead"}
+    )
+    actors = await ctl("list_actors") or []
+    by_state: Dict[str, int] = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    g = _gauge("rt_actors", "actors by state", ("state",))
+    g.clear()  # states with zero actors must stop exporting old counts
+    for state, count in by_state.items():
+        g.set(float(count), {"state": state})
+    auto = await ctl("get_autoscaler_state") or {}
+    _gauge("rt_pending_demands", "unscheduled resource demands").set(
+        float(len(auto.get("pending_demands", [])))
+    )
+    _gauge("rt_pending_gangs", "unplaced placement groups").set(
+        float(len(auto.get("pending_gangs", [])))
+    )
+    snap = await ctl("get_worker_snapshot")
+    if snap is not None:
+        _gauge("rt_workers", "live worker processes").set(float(len(snap)))
+    # serve replica targets vs running, per (app, deployment)
+    try:
+        from ray_tpu.serve.api import _get_controller_async
+        from ray_tpu.core.runtime import get_runtime
+
+        controller = await _get_controller_async()
+        ref = controller.get_serve_status.remote()
+        status = await get_runtime()._get_one(ref)
+    except Exception:
+        status = {}
+    g = _gauge("rt_serve_replicas", "serve replicas",
+               ("app", "deployment", "kind"))
+    g.clear()  # deleted apps/deployments must not export stale series
+    for app, deployments in (status or {}).items():
+        for dep, info in deployments.items():
+            tags = {"app": app, "deployment": dep}
+            g.set(float(info.get("running", 0)), {**tags, "kind": "running"})
+            g.set(float(info.get("target_replicas", 0)),
+                  {**tags, "kind": "target"})
+
+
+# -- dashboard generation -----------------------------------------------
+@dataclass
+class Target:
+    expr: str
+    legend: str = ""
+
+
+@dataclass
+class Panel:
+    title: str
+    unit: str = "short"
+    targets: List[Target] = field(default_factory=list)
+    description: str = ""
+
+
+DEFAULT_PANELS: List[Panel] = [
+    Panel("Alive nodes", targets=[Target('rt_nodes{state="alive"}', "alive"),
+                                  Target('rt_nodes{state="dead"}', "dead")]),
+    Panel("Actors by state",
+          targets=[Target("rt_actors", "{{state}}")]),
+    Panel("Live workers", targets=[Target("rt_workers", "workers")]),
+    Panel("Pending resource demands",
+          targets=[Target("rt_pending_demands", "demands"),
+                   Target("rt_pending_gangs", "gangs")],
+          description="nonzero sustained = cluster needs to scale up"),
+    Panel("Serve replicas: running vs target",
+          targets=[Target('rt_serve_replicas{kind="running"}',
+                          "{{app}}/{{deployment}} running"),
+                   Target('rt_serve_replicas{kind="target"}',
+                          "{{app}}/{{deployment}} target")],
+          description="running < target sustained = replicas failing "
+                      "to start"),
+]
+
+
+def _panel_json(p: Panel, panel_id: int, x: int, y: int) -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": p.title,
+        "description": p.description,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": {"unit": p.unit}, "overrides": []},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "targets": [
+            {
+                "expr": t.expr,
+                "legendFormat": t.legend,
+                "refId": chr(ord("A") + i),
+            }
+            for i, t in enumerate(p.targets)
+        ],
+    }
+
+
+def dashboard_json(title: str = "ray_tpu cluster",
+                   panels: Optional[List[Panel]] = None,
+                   uid: str = "ray-tpu-default") -> Dict[str, Any]:
+    """A complete importable Grafana dashboard document."""
+    panels = DEFAULT_PANELS if panels is None else panels
+    out_panels = []
+    for i, p in enumerate(panels):
+        x = (i % 2) * 12
+        y = (i // 2) * 8
+        out_panels.append(_panel_json(p, i + 1, x, y))
+    return {
+        "uid": uid,
+        "title": title,
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "refresh": "15s",
+        "schemaVersion": 39,
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": out_panels,
+    }
+
+
+def default_dashboard() -> Dict[str, Any]:
+    return dashboard_json()
+
+
+def write_dashboards(out_dir: str) -> List[str]:
+    """Write the generated dashboard files (the factory's CLI shape)."""
+    import json
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "ray_tpu_default_dashboard.json")
+    with open(path, "w") as f:
+        json.dump(default_dashboard(), f, indent=2)
+    return [path]

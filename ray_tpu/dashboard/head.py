@@ -140,6 +140,18 @@ class DashboardHead:
             limit = int(req.query_params.get("limit", "100"))
             events = await self._ctl("list_task_events", {"limit": limit})
             return httpd.json_response(events)
+        if path == "/api/cluster_events":
+            # structured event log (reference: `dashboard/modules/event/`)
+            events = await self._ctl("list_cluster_events", {
+                "limit": int(req.query_params.get("limit", "200")),
+                "severity": req.query_params.get("severity"),
+                "event_type": req.query_params.get("event_type"),
+            })
+            return httpd.json_response(events or [])
+        if path == "/api/grafana_dashboard":
+            from ray_tpu.dashboard.grafana import default_dashboard
+
+            return httpd.json_response(default_dashboard())
         if path == "/api/timeline":
             events = await self._ctl("list_task_events", {"limit": 50_000})
             trace = [
@@ -174,41 +186,29 @@ class DashboardHead:
                 loop = asyncio.get_running_loop()
 
                 def _deploy():
-                    import importlib
-                    import sys
+                    from ray_tpu.serve import schema as serve_schema
 
-                    from ray_tpu import serve
-
-                    added = []
-                    for d in body.get("import_dirs", []):
-                        if d not in sys.path:
-                            sys.path.insert(0, d)
-                            added.append(d)
-                    try:
-                        mod_name, _, var = body["import_path"].partition(":")
-                        if mod_name in sys.modules:
-                            # REdeploy must see edited code, not the
-                            # import cache (first deploy imports once)
-                            mod = importlib.reload(sys.modules[mod_name])
-                        else:
-                            mod = importlib.import_module(mod_name)
-                        app = getattr(mod, var)
-                    finally:
-                        for d in added:
-                            try:
-                                sys.path.remove(d)
-                            except ValueError:
-                                pass
-                    serve.run(
-                        app,
-                        name=body.get("name", "default"),
-                        route_prefix=body.get("route_prefix", "/"),
+                    # reference-shaped multi-app document
+                    # (`serve/schema.py` ServeDeploySchema) or the
+                    # single-app shorthand {import_path, name, ...}
+                    doc = (
+                        body if "applications" in body
+                        else {"applications": [body]}
                     )
+                    return serve_schema.deploy_from_schema(doc)
 
-                await loop.run_in_executor(None, _deploy)
-                return httpd.json_response({"ok": True})
+                try:
+                    deployed = await loop.run_in_executor(None, _deploy)
+                except Exception as e:  # validation errors -> 400
+                    return httpd.json_response(
+                        {"error": str(e)}, status=400
+                    )
+                return httpd.json_response(
+                    {"ok": True, "applications": deployed}
+                )
             return httpd.json_response(
-                {"error": "use PUT with {import_path, name, route_prefix}"},
+                {"error": "use PUT with a ServeDeploySchema document "
+                          "{applications: [{import_path, name, ...}]}"},
                 status=405,
             )
         if path.startswith("/api/serve/applications/") and req.method == "DELETE":
@@ -269,6 +269,15 @@ class DashboardHead:
         if path == "/metrics":
             from ray_tpu.util.metrics import export_text
 
+            # refresh the built-in cluster gauges at scrape time so the
+            # Prometheus view (and the generated Grafana dashboard)
+            # reflects controller state without a push pipeline
+            try:
+                from ray_tpu.dashboard.grafana import update_builtin_metrics
+
+                await update_builtin_metrics(self._ctl)
+            except Exception:
+                pass
             return 200, "text/plain; version=0.0.4", export_text().encode()
         return 404, "text/plain", b"not found"
 

@@ -28,6 +28,17 @@ def main(argv=None):
 
         cmd = {"cluster-status": "status"}.get(argv[0], argv[0])
         return cluster_main([cmd] + argv[1:])
+    if argv and argv[0] == "grafana-dashboard":
+        # generated dashboard files, no cluster needed (reference:
+        # `grafana_dashboard_factory.py`)
+        gp = argparse.ArgumentParser(prog="ray_tpu grafana-dashboard")
+        gp.add_argument("--out", default="grafana_dashboards")
+        gargs = gp.parse_args(argv[1:])
+        from ray_tpu.dashboard.grafana import write_dashboards
+
+        for path in write_dashboards(gargs.out):
+            print(path)
+        return 0
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", required=True,
                    help="head ready-file path (printed at init)")
@@ -37,6 +48,9 @@ def main(argv=None):
     lp.add_argument("what", choices=["tasks", "actors", "nodes", "jobs",
                                      "placement-groups", "workers"])
     lp.add_argument("--limit", type=int, default=100)
+    ep = sub.add_parser("events", help="structured cluster event log")
+    ep.add_argument("--severity", default=None)
+    ep.add_argument("--limit", type=int, default=100)
     tp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     tp.add_argument("--output", default="timeline.json")
     jp = sub.add_parser("job", help="job submission")
@@ -69,6 +83,14 @@ def main(argv=None):
                 "workers": state.list_workers,
             }[args.what]
             print(json.dumps(fn(), indent=2, default=str))
+        elif args.cmd == "events":
+            from ray_tpu.core.runtime import get_runtime
+
+            events = get_runtime().controller_call(
+                "list_cluster_events",
+                {"severity": args.severity, "limit": args.limit},
+            )
+            print(json.dumps(events, indent=2))
         elif args.cmd == "timeline":
             events = state.timeline(args.output)
             print(f"wrote {len(events)} events to {args.output}")

@@ -1,12 +1,14 @@
 """ActorPool: multiplex work over a fixed set of actors.
 
-Reference: `python/ray/util/actor_pool.py` — same surface
-(map/map_unordered/submit/get_next/get_next_unordered/has_next).
+Reference: `python/ray/util/actor_pool.py` — same public surface
+(map/map_unordered/submit/get_next/get_next_unordered/has_next), own
+bookkeeping: results are tracked by submission sequence number with a
+single in-flight table keyed by ref.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, List, TypeVar
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Tuple, TypeVar
 
 import ray_tpu as rt
 
@@ -16,66 +18,68 @@ V = TypeVar("V")
 class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List = []
+        # ref -> (submission seq, actor) for every in-flight task
+        self._inflight: Dict[Any, Tuple[int, Any]] = {}
+        # submission seq -> ref, drained in order by get_next
+        self._by_seq: Dict[int, Any] = {}
+        self._submit_seq = 0
+        self._deliver_seq = 0
+        self._backlog: List[Tuple[Callable, Any]] = []
 
     def submit(self, fn: Callable, value: Any):
         """fn(actor, value) -> ObjectRef (reference: ActorPool.submit)."""
         if self._idle:
             actor = self._idle.pop()
             ref = fn(actor, value)
-            self._future_to_actor[ref] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+            self._inflight[ref] = (self._submit_seq, actor)
+            self._by_seq[self._submit_seq] = ref
+            self._submit_seq += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._inflight) or bool(self._backlog)
 
-    def _return_actor(self, actor):
+    def _release(self, actor):
         self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+        if self._backlog:
+            self.submit(*self._backlog.pop(0))
 
     def get_next(self, timeout: float = None) -> Any:
         """Next result in submission order.  On timeout the future stays
         queued and the actor stays busy, so a retry sees the same task
         (reference: `actor_pool.py` keeps state on TimeoutError)."""
-        if self._next_return_index not in self._index_to_future:
+        if self._deliver_seq not in self._by_seq:
             raise StopIteration("no pending results")
-        ref = self._index_to_future[self._next_return_index]
+        ref = self._by_seq[self._deliver_seq]
         if timeout is not None:
             ready, _ = rt.wait([ref], num_returns=1, timeout=timeout)
             if not ready:
                 raise TimeoutError("get_next timed out")
-        idx, actor = self._future_to_actor.pop(ref)
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
+        _, actor = self._inflight.pop(ref)
+        del self._by_seq[self._deliver_seq]
+        self._deliver_seq += 1
         try:
             return rt.get(ref)
         finally:
-            self._return_actor(actor)
+            self._release(actor)
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         """Next result in completion order."""
-        if not self._future_to_actor:
+        if not self._inflight:
             raise StopIteration("no pending results")
         ready, _ = rt.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout
+            list(self._inflight), num_returns=1, timeout=timeout
         )
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         ref = ready[0]
-        idx, actor = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(idx, None)
+        seq, actor = self._inflight.pop(ref)
+        self._by_seq.pop(seq, None)
         try:
             return rt.get(ref)
         finally:
-            self._return_actor(actor)
+            self._release(actor)
 
     def map(self, fn: Callable, values: Iterable[V]) -> Iterator[Any]:
         for v in values:
@@ -96,4 +100,4 @@ class ActorPool:
         return self._idle.pop() if self._idle else None
 
     def push(self, actor):
-        self._return_actor(actor)
+        self._release(actor)

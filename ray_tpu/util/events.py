@@ -1,0 +1,97 @@
+"""Structured cluster event log.
+
+Reference: `src/ray/util/event.h` (`RAY_EVENT` — structured events with
+severity/label/source/custom fields, written to per-process
+`event_*.log` JSON-lines files and surfaced by
+`dashboard/modules/event/`).  Here: every process can emit events
+through :func:`report_event`; they land in a JSON-lines file under the
+session dir AND in the controller's in-memory ring, which the dashboard
+(`/api/cluster_events`) and the state CLI read cluster-wide.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# severities (reference: `event.h` EventSeverity)
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+FATAL = "FATAL"
+
+_SEVERITIES = (DEBUG, INFO, WARNING, ERROR, FATAL)
+
+_lock = threading.Lock()
+_log_path: Optional[str] = None
+
+
+def configure_event_log(session_dir: str):
+    """Point the local JSON-lines sink at a session directory (one
+    `events.jsonl` per process tree, like the reference's per-source
+    event files)."""
+    global _log_path
+    with _lock:
+        _log_path = os.path.join(session_dir, "events.jsonl")
+
+
+def make_event(event_type: str, message: str, *, severity: str = INFO,
+               source: str = "", **custom_fields: Any) -> Dict[str, Any]:
+    if severity not in _SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r}")
+    return {
+        "timestamp": time.time(),
+        "severity": severity,
+        "event_type": event_type,
+        "source": source or f"pid-{os.getpid()}",
+        "message": message,
+        "custom_fields": custom_fields,
+    }
+
+
+def _write_local(ev: Dict[str, Any]):
+    with _lock:
+        path = _log_path
+    if path is None:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(ev) + "\n")
+    except OSError:
+        pass
+
+
+def report_event(event_type: str, message: str, *, severity: str = INFO,
+                 source: str = "", **custom_fields: Any) -> Dict[str, Any]:
+    """Emit a structured event: local JSON-lines sink + the controller
+    ring (best-effort — events must never take a process down)."""
+    ev = make_event(event_type, message, severity=severity, source=source,
+                    **custom_fields)
+    _write_local(ev)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        if rt is not None:
+            rt.controller_call("report_cluster_event", {"event": ev})
+    except Exception:
+        pass
+    return ev
+
+
+def read_local_events(session_dir: str) -> List[Dict[str, Any]]:
+    path = os.path.join(session_dir, "events.jsonl")
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    except OSError:
+        pass
+    return out

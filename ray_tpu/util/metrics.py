@@ -76,6 +76,14 @@ class Gauge(Metric):
         with self._lock:
             self._values[_label_key(self._merge(tags))] = float(value)
 
+    def clear(self):
+        """Drop all tagged series — refresh-style exporters that
+        recompute the full tag set each pass call this first so
+        vanished tag values (a deleted app, a drained state) stop
+        exporting stale samples."""
+        with self._lock:
+            self._values.clear()
+
     def _samples(self):
         with self._lock:
             return [(dict(k), v) for k, v in self._values.items()]

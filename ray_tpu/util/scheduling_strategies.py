@@ -46,6 +46,100 @@ class PlacementGroupSchedulingStrategy:
         )
 
 
+class In:
+    """Label value must be one of the given values."""
+
+    def __init__(self, *values):
+        _check_values(values, "In")
+        self.values = list(values)
+
+    _op = "in"
+
+
+class NotIn:
+    """Label value must not be any of the given values."""
+
+    def __init__(self, *values):
+        _check_values(values, "NotIn")
+        self.values = list(values)
+
+    _op = "not_in"
+
+
+class Exists:
+    """Label key must be present on the node."""
+
+    values: list = []
+    _op = "exists"
+
+
+class DoesNotExist:
+    """Label key must be absent from the node."""
+
+    values: list = []
+    _op = "does_not_exist"
+
+
+def _check_values(values, op_name: str):
+    if not values:
+        raise ValueError(f"{op_name}() requires at least one value")
+    for v in values:
+        if not isinstance(v, str):
+            raise ValueError(
+                f"{op_name}() values must be str, got {type(v).__name__}"
+            )
+
+
+def _expressions(mapping, param: str):
+    """{"key": In("a", "b"), ...} -> [(key, op, values), ...] for the
+    internal strategy (reference: `_convert_map_to_expressions`,
+    `scheduling_strategies.py:159`)."""
+    if mapping is None:
+        return []
+    if not isinstance(mapping, dict):
+        raise ValueError(
+            f"The {param} parameter must be a dict of label matchers"
+        )
+    out = []
+    for key, matcher in mapping.items():
+        if not isinstance(key, str):
+            raise ValueError(f"label keys must be str, got {key!r}")
+        if not isinstance(matcher, (In, NotIn, Exists, DoesNotExist)):
+            raise ValueError(
+                f"value for {key!r} must be In/NotIn/Exists/DoesNotExist, "
+                f"got {type(matcher).__name__}"
+            )
+        out.append((key, matcher._op, list(matcher.values)))
+    return out
+
+
+class NodeLabelSchedulingStrategy:
+    """Label-based node selection (reference:
+    `util/scheduling_strategies.py:135`): `hard` expressions must all
+    match the target node's labels; among hard-feasible nodes, ones
+    matching `soft` are preferred.
+
+    scheduling_strategy=NodeLabelSchedulingStrategy(
+        {"tpu-slice": Exists()}, soft={"region": In("us-central2")})
+    """
+
+    def __init__(self, hard, *, soft=None):
+        self.hard = _expressions(hard, "hard")
+        self.soft = _expressions(soft, "soft")
+        if not (self.hard or self.soft):
+            raise ValueError(
+                "NodeLabelSchedulingStrategy requires at least one of "
+                "`hard` or `soft` to be non-empty"
+            )
+
+    def _to_internal(self) -> _Internal:
+        return _Internal(
+            kind="node_labels",
+            label_hard=self.hard,
+            label_soft=self.soft,
+        )
+
+
 @dataclass
 class NodeAffinitySchedulingStrategy:
     """Pin to a node by id; `soft=True` allows fallback if the node is

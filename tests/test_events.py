@@ -1,0 +1,104 @@
+"""Structured cluster event log + Grafana dashboard factory
+(reference: `src/ray/util/event.h`, `dashboard/modules/event/`,
+`dashboard/modules/metrics/grafana_dashboard_factory.py`)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ray_tpu.core.controller import Controller
+from ray_tpu.util import events as ev_mod
+
+
+class _FakeConn:
+    def send(self, *a, **k):
+        pass
+
+
+def test_make_event_shape_and_severity():
+    ev = ev_mod.make_event("JOB_STARTED", "job j1 started",
+                           severity=ev_mod.WARNING, job_id="j1")
+    assert ev["event_type"] == "JOB_STARTED"
+    assert ev["severity"] == "WARNING"
+    assert ev["custom_fields"] == {"job_id": "j1"}
+    assert ev["timestamp"] > 0
+    with pytest.raises(ValueError):
+        ev_mod.make_event("X", "y", severity="LOUD")
+
+
+def test_local_jsonl_sink(tmp_path):
+    ev_mod.configure_event_log(str(tmp_path))
+    try:
+        ev_mod._write_local(ev_mod.make_event("A", "one"))
+        ev_mod._write_local(ev_mod.make_event("B", "two"))
+        out = ev_mod.read_local_events(str(tmp_path))
+        assert [e["event_type"] for e in out] == ["A", "B"]
+    finally:
+        ev_mod._log_path = None
+
+
+def test_controller_event_ring_and_filters():
+    ctl = Controller()
+    # lifecycle events emitted by the controller itself
+    asyncio.run(ctl.handle_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1),
+         "resources": {"CPU": 4}, "is_head": False},
+        _FakeConn(),
+    ))
+    asyncio.run(ctl._mark_node_dead(ctl.nodes["n1"], "test kill"))
+    # client-reported event
+    asyncio.run(ctl.handle_report_cluster_event(
+        {"event": ev_mod.make_event("CUSTOM", "hi", severity="ERROR")},
+        _FakeConn(),
+    ))
+    all_ev = asyncio.run(ctl.handle_list_cluster_events({}, _FakeConn()))
+    types = [e["event_type"] for e in all_ev]
+    assert "NODE_ADDED" in types and "NODE_DEAD" in types
+    assert types[-1] == "CUSTOM"
+    warn = asyncio.run(ctl.handle_list_cluster_events(
+        {"severity": "WARNING"}, _FakeConn()))
+    assert {e["event_type"] for e in warn} == {"NODE_DEAD"}
+    only = asyncio.run(ctl.handle_list_cluster_events(
+        {"event_type": "CUSTOM"}, _FakeConn()))
+    assert len(only) == 1 and only[0]["severity"] == "ERROR"
+
+
+def test_grafana_dashboard_generation(tmp_path):
+    from ray_tpu.dashboard import grafana
+
+    doc = grafana.default_dashboard()
+    assert doc["panels"], "dashboard must have panels"
+    ids = [p["id"] for p in doc["panels"]]
+    assert len(ids) == len(set(ids))
+    for p in doc["panels"]:
+        assert p["targets"], f"panel {p['title']} has no queries"
+        for t in p["targets"]:
+            assert t["expr"].strip()
+    # the written file is valid importable JSON
+    [path] = grafana.write_dashboards(str(tmp_path))
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["uid"] == doc["uid"]
+
+
+def test_builtin_metrics_refresh():
+    from ray_tpu.dashboard import grafana
+    from ray_tpu.util.metrics import export_text
+
+    ctl = Controller()
+    asyncio.run(ctl.handle_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1),
+         "resources": {"CPU": 4}, "is_head": False},
+        _FakeConn(),
+    ))
+
+    async def ctl_call(method, payload=None):
+        handler = getattr(ctl, f"handle_{method}", None)
+        if handler is None:
+            return None
+        return await handler(payload or {}, _FakeConn())
+
+    asyncio.run(grafana.update_builtin_metrics(ctl_call))
+    text = export_text()
+    assert 'rt_nodes{state="alive"} 1' in text

@@ -1,0 +1,96 @@
+"""RaySyncer-style versioned delta load reports (reference:
+`ray_syncer.h:88` delta broadcast + periodic resync)."""
+
+import asyncio
+import types
+
+from ray_tpu.core.controller import Controller
+from ray_tpu.core.noded import NodeDaemon
+
+
+class _FakeConn:
+    def send(self, *a, **k):
+        pass
+
+
+def _register(ctl, node_id="n1"):
+    asyncio.run(ctl.handle_register_node(
+        {"node_id": node_id, "addr": ("127.0.0.1", 1),
+         "resources": {"CPU": 4}, "is_head": False},
+        _FakeConn(),
+    ))
+
+
+def _report(ctl, payload):
+    asyncio.run(ctl.handle_report_node_load(payload, _FakeConn()))
+
+
+def test_controller_applies_full_delta_heartbeat():
+    ctl = Controller()
+    _register(ctl)
+    n = ctl.nodes["n1"]
+    _report(ctl, {"node_id": "n1", "v": 1, "full": {
+        "used": {"CPU": 1}, "busy": True, "queued": 3,
+        "workers": [{"pid": 1}], "host": {"load1": 0.5},
+    }})
+    assert n.load["v"] == 1 and n.load["queued"] == 3
+    ts1 = n.load["ts"]
+    # delta against the right base merges
+    _report(ctl, {"node_id": "n1", "v": 2, "base": 1,
+                  "delta": {"queued": 0, "busy": False}})
+    assert n.load["v"] == 2
+    assert n.load["queued"] == 0 and n.load["busy"] is False
+    assert n.load["workers"] == [{"pid": 1}]  # untouched fields survive
+    # heartbeat refreshes ts only
+    _report(ctl, {"node_id": "n1", "v": 2})
+    assert n.load["ts"] >= ts1 and n.load["queued"] == 0
+
+
+def test_controller_drops_divergent_delta_until_full():
+    ctl = Controller()
+    _register(ctl)
+    n = ctl.nodes["n1"]
+    _report(ctl, {"node_id": "n1", "v": 5, "full": {"queued": 1,
+                                                    "used": {}, "busy": False}})
+    # a delta whose base does not match the stored version is dropped
+    _report(ctl, {"node_id": "n1", "v": 9, "base": 8,
+                  "delta": {"queued": 99}})
+    assert n.load["queued"] == 1 and n.load["v"] == 5
+    # the next full snapshot heals
+    _report(ctl, {"node_id": "n1", "v": 10, "full": {"queued": 99,
+                                                     "used": {}, "busy": True}})
+    assert n.load["queued"] == 99 and n.load["v"] == 10
+
+
+def test_controller_accepts_legacy_flat_report():
+    ctl = Controller()
+    _register(ctl)
+    _report(ctl, {"node_id": "n1", "used": {"CPU": 2}, "busy": True,
+                  "queued": 7})
+    n = ctl.nodes["n1"]
+    assert n.load["queued"] == 7 and n.load["busy"] is True
+
+
+def test_noded_payload_generator_delta_and_resync():
+    d = types.SimpleNamespace(node_id="n1",
+                              LOAD_FULL_EVERY=NodeDaemon.LOAD_FULL_EVERY)
+    gen = lambda rep: NodeDaemon._load_sync_payload(d, rep)  # noqa: E731
+    r1 = {"used": {}, "busy": False, "queued": 0,
+          "workers": [], "host": {"load1": 0.1}}
+    p = gen(dict(r1))
+    assert "full" in p and p["v"] == 1  # first report is full
+    # unchanged -> heartbeat (no payload body)
+    p = gen(dict(r1))
+    assert set(p) == {"node_id", "v"} and p["v"] == 1
+    # one field changes -> delta with only that field
+    r2 = dict(r1, queued=4)
+    p = gen(dict(r2))
+    assert p["v"] == 2 and p["base"] == 1
+    assert p["delta"] == {"queued": 4}
+    # every LOAD_FULL_EVERY-th tick resyncs with a full snapshot
+    last = None
+    for _ in range(NodeDaemon.LOAD_FULL_EVERY):
+        last = gen(dict(r2))
+        if "full" in last:
+            break
+    assert "full" in last

@@ -178,3 +178,86 @@ def test_pip_runtime_env_for_actor(rt_start, local_wheel):
     a = UsesWheel.remote()
     assert rt.get(a.magic.remote(), timeout=300) == 12345
     rt.kill(a)
+
+
+# ----------------------------------------------------------------------
+# conda plugin (reference: `_private/runtime_env/conda.py` CondaPlugin)
+# ----------------------------------------------------------------------
+def test_pip_conda_mutually_exclusive():
+    with pytest.raises(ValueError):
+        re_mod.validate_runtime_env({"pip": ["x"], "conda": "base"})
+    re_mod.validate_runtime_env({"conda": "base"})
+    re_mod.validate_runtime_env(None)
+
+
+def _write_fake_conda(tmp_path, py_tag):
+    """A stand-in conda binary: `env list --json` reports one named env;
+    `env create -p <prefix> -f <yml>` materializes a prefix whose
+    site-packages contains a marker module.  The real binary is absent
+    from CI images, and the plugin's contract (resolve name / create
+    prefix / site-packages on sys.path) is what needs testing."""
+    envs_root = tmp_path / "conda_envs"
+    named = envs_root / "demo-env"
+    sp = named / "lib" / py_tag / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "condademo.py").write_text("MAGIC = 54321\n")
+    exe = tmp_path / "conda"
+    exe.write_text(f"""#!{sys.executable}
+import json, os, sys
+
+args = sys.argv[1:]
+if args[:3] == ["env", "list", "--json"]:
+    print(json.dumps({{"envs": ["{named}"]}}))
+elif args[:2] == ["env", "create"]:
+    prefix = args[args.index("-p") + 1]
+    with open(args[args.index("-f") + 1]) as f:
+        spec = json.load(f)
+    sp = os.path.join(prefix, "lib", "{py_tag}", "site-packages")
+    os.makedirs(sp)
+    with open(os.path.join(sp, "condademo2.py"), "w") as f:
+        f.write("NAME = %r\\n" % spec["name"])
+else:
+    sys.exit(2)
+""")
+    exe.chmod(0o755)
+    return str(exe)
+
+
+def test_conda_named_env(tmp_path, monkeypatch):
+    import asyncio
+
+    py_tag = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    monkeypatch.setenv("RT_CONDA_EXE", _write_fake_conda(tmp_path, py_tag))
+    plug = re_mod._CondaPlugin()
+    asyncio.run(plug.setup("demo-env", None))
+    try:
+        import condademo
+
+        assert condademo.MAGIC == 54321
+    finally:
+        sys.path = [p for p in sys.path if "conda_envs" not in p]
+        sys.modules.pop("condademo", None)
+    with pytest.raises(Exception, match="not found"):
+        asyncio.run(plug.setup("no-such-env", None))
+
+
+def test_conda_dict_env_created_once(tmp_path, monkeypatch):
+    import asyncio
+
+    py_tag = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    monkeypatch.setenv("RT_CONDA_EXE", _write_fake_conda(tmp_path, py_tag))
+    monkeypatch.setenv("RT_TMPDIR", str(tmp_path / "rt"))
+    spec = {"name": "built-env", "dependencies": ["python"]}
+    plug = re_mod._CondaPlugin()
+    asyncio.run(plug.setup(spec, None))
+    prefix = re_mod.conda_env_cache_dir(spec)
+    try:
+        import condademo2
+
+        assert condademo2.NAME == "built-env"
+        assert os.path.exists(os.path.join(prefix, ".rt_conda_done"))
+        # second setup is a cache hit (create would fail: prefix exists)
+        asyncio.run(plug.setup(spec, None))
+    finally:
+        sys.path = [p for p in sys.path if "conda_cache" not in p]
+        sys.modules.pop("condademo2", None)

@@ -159,3 +159,111 @@ def test_cancel_interrupts_daemon_routed_task(tmp_path):
             rt.get(ref, timeout=30)
     finally:
         c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# NodeLabelSchedulingStrategy (reference: util/scheduling_strategies.py:135,
+# node_label_scheduling_policy.h:25)
+# ---------------------------------------------------------------------------
+
+def test_match_labels_operators():
+    from ray_tpu.core.task_spec import match_labels
+
+    labels = {"region": "us", "tpu": "v5e"}
+    assert match_labels([("region", "in", ["us", "eu"])], labels)
+    assert not match_labels([("region", "in", ["eu"])], labels)
+    assert match_labels([("region", "not_in", ["eu"])], labels)
+    assert not match_labels([("region", "not_in", ["us"])], labels)
+    assert match_labels([("tpu", "exists", [])], labels)
+    assert not match_labels([("gpu", "exists", [])], labels)
+    assert match_labels([("gpu", "does_not_exist", [])], labels)
+    assert not match_labels([("tpu", "does_not_exist", [])], labels)
+    # absent key: In fails, NotIn holds (reference semantics)
+    assert not match_labels([("zone", "in", ["a"])], labels)
+    assert match_labels([("zone", "not_in", ["a"])], labels)
+
+
+def test_node_label_strategy_validation():
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, Exists, In, NodeLabelSchedulingStrategy, NotIn,
+    )
+
+    s = NodeLabelSchedulingStrategy(
+        {"region": In("us"), "gpu": DoesNotExist()},
+        soft={"tpu": Exists(), "gen": NotIn("v2")},
+    )
+    internal = s._to_internal()
+    assert internal.kind == "node_labels"
+    assert ("region", "in", ["us"]) in internal.label_hard
+    assert ("tpu", "exists", []) in internal.label_soft
+    with pytest.raises(ValueError):
+        NodeLabelSchedulingStrategy({})
+    with pytest.raises(ValueError):
+        NodeLabelSchedulingStrategy({"k": "not-a-matcher"})
+    with pytest.raises(ValueError):
+        In()
+
+
+def _register_labeled(ctl, node_id, labels):
+    asyncio.run(ctl.handle_register_node(
+        {"node_id": node_id, "addr": ("127.0.0.1", 1),
+         "resources": {"CPU": 4}, "labels": labels, "is_head": False},
+        _FakeConn(),
+    ))
+
+
+def test_find_node_for_label_filtering():
+    ctl = Controller()
+    _register_labeled(ctl, "n_us", {"region": "us"})
+    _register_labeled(ctl, "n_eu", {"region": "eu", "fast": "1"})
+    # hard filters candidates
+    pick = asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 1}, "exclude": [],
+         "label_hard": [("region", "in", ["eu"])]}, _FakeConn()
+    ))
+    assert pick == "n_eu"
+    # soft reorders preference but does not exclude
+    pick = asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 1}, "exclude": [],
+         "label_soft": [("fast", "exists", [])]}, _FakeConn()
+    ))
+    assert pick == "n_eu"
+    # unsatisfiable soft falls back to any feasible node
+    pick = asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 1}, "exclude": [],
+         "label_soft": [("nope", "exists", [])]}, _FakeConn()
+    ))
+    assert pick in ("n_us", "n_eu")
+    # unsatisfiable hard -> None
+    assert asyncio.run(ctl.handle_find_node_for(
+        {"resources": {"CPU": 1}, "exclude": [],
+         "label_hard": [("region", "in", ["asia"])]}, _FakeConn()
+    )) is None
+
+
+def test_node_label_strategy_e2e():
+    from ray_tpu.util.scheduling_strategies import (
+        In, NodeLabelSchedulingStrategy,
+    )
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 1,
+                                "labels": {"tier": "head"}})
+    c.connect()
+    try:
+        c.add_node(num_cpus=2, num_workers=1, labels={"tier": "worker"})
+        c.wait_for_nodes()
+        head_sock = rt.get(_where.remote(), timeout=120)
+        sock = rt.get(_where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                {"tier": In("worker")})
+        ).remote(), timeout=120)
+        assert sock != head_sock, "task did not land on the labeled node"
+        # infeasible hard constraint surfaces an error
+        with pytest.raises(Exception):
+            rt.get(_where.options(
+                scheduling_strategy=NodeLabelSchedulingStrategy(
+                    {"tier": In("gpu-pool")})
+            ).remote(), timeout=60)
+    finally:
+        c.shutdown()
