@@ -10,10 +10,22 @@ test backend.
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.v2 import (
+    AutoscalerV2,
+    AutoscalerV2Config,
+    InstanceManager,
+    NodeTypeConfigV2,
+    ResourceDemandScheduler,
+)
 
 __all__ = [
     "AutoscalerConfig",
+    "AutoscalerV2",
+    "AutoscalerV2Config",
+    "InstanceManager",
     "LocalNodeProvider",
     "NodeProvider",
+    "NodeTypeConfigV2",
+    "ResourceDemandScheduler",
     "StandardAutoscaler",
 ]

@@ -135,6 +135,30 @@ class GcpTpuNodeProvider(NodeProvider):
             ids.append(node_id)
         return ids
 
+    def create_slice(self, node_config: Dict[str, Any], hosts: int) -> List[str]:
+        """Atomic multi-host scale-up: one Cloud TPU node whose
+        accelerator_type spans all `hosts` hosts — the API allocates the
+        whole ICI-connected slice or fails, so no rollback choreography
+        is needed (reference analog: pod-level `TPU-{pod}-head` gang
+        resource, `_private/accelerators/tpu.py:381`)."""
+        from ray_tpu.core.accelerators import num_hosts_in_slice
+
+        cfg = dict(node_config)
+        cfg.setdefault("accelerator_type", self.accelerator_type)
+        actual = num_hosts_in_slice(cfg["accelerator_type"])
+        if actual != hosts:
+            # a mismatch would book phantom capacity: the instance
+            # table records `hosts` hosts but the slice delivers
+            # `actual` — gang demand absorbs into capacity that never
+            # arrives and the PG pends forever
+            raise ValueError(
+                f"accelerator_type {cfg['accelerator_type']!r} spans "
+                f"{actual} host(s) but the node type requests "
+                f"hosts_per_slice={hosts}; align the type's "
+                "provider_config.accelerator_type with hosts_per_slice"
+            )
+        return self.create_node(cfg, 1)
+
     def terminate_node(self, provider_id: str):
         self._transport(
             "DELETE", self._url(f"{self._parent}/nodes/{provider_id}"), None

@@ -21,6 +21,26 @@ class NodeProvider:
         """Launch nodes; returns provider node ids."""
         raise NotImplementedError
 
+    def create_slice(self, node_config: Dict[str, Any], hosts: int) -> List[str]:
+        """Provision `hosts` ICI-connected hosts as ONE unit (a TPU
+        slice).  Default: per-host creation — the v2 autoscaler rolls
+        the whole set back on partial failure, giving all-or-nothing
+        semantics on top.  Cloud providers that can allocate a slice in
+        a single API call (one multi-host TPU VM node) override this.
+        """
+        out: List[str] = []
+        try:
+            for _ in range(hosts):
+                out.extend(self.create_node(node_config, 1))
+        except Exception:
+            for pid in out:
+                try:
+                    self.terminate_node(pid)
+                except Exception:
+                    pass
+            raise
+        return out
+
     def terminate_node(self, provider_id: str):
         raise NotImplementedError
 
@@ -58,6 +78,7 @@ class LocalNodeProvider(NodeProvider):
                 controller_addr=self._controller_addr,
                 num_cpus=num_cpus,
                 resources=resources,
+                labels=dict(node_config.get("labels", {})) or None,
                 num_workers=int(node_config.get("num_workers", 2)),
             )
             pid = f"local-{idx}"
