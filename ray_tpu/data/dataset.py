@@ -126,6 +126,7 @@ class Dataset:
 
     def add_column(self, name: str, fn: Callable[[B.Block], np.ndarray]) -> "Dataset":
         def op(blk: B.Block) -> List[B.Block]:
+            blk = B.ensure_numpy(blk)
             out = dict(blk)
             out[name] = np.asarray(fn(blk))
             return [out]
@@ -134,18 +135,26 @@ class Dataset:
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def op(blk: B.Block) -> List[B.Block]:
+            if B.is_arrow_block(blk):
+                return [blk.drop_columns([c for c in cols
+                                          if c in blk.column_names])]
             return [{k: v for k, v in blk.items() if k not in cols}]
 
         return self._with_op(MapOp(op, name="Map(drop_columns)"))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
         def op(blk: B.Block) -> List[B.Block]:
+            if B.is_arrow_block(blk):
+                return [blk.select(cols)]
             return [{k: blk[k] for k in cols}]
 
         return self._with_op(MapOp(op, name="Map(select_columns)"))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         def op(blk: B.Block) -> List[B.Block]:
+            if B.is_arrow_block(blk):
+                return [blk.rename_columns(
+                    [mapping.get(c, c) for c in blk.column_names])]
             return [{mapping.get(k, k): v for k, v in blk.items()}]
 
         return self._with_op(MapOp(op, name="Map(rename_columns)"))
@@ -182,7 +191,7 @@ class Dataset:
         seen = []
         seen_set = set()
         for blk in self._iter_blocks():
-            for v in np.asarray(blk[column]).tolist():
+            for v in np.asarray(B.column_numpy(blk, column)).tolist():
                 k = v if not isinstance(v, list) else tuple(v)
                 if k not in seen_set:
                     seen_set.add(k)
@@ -268,7 +277,7 @@ class Dataset:
         def op(blocks: List[B.Block]) -> List[B.Block]:
             k = max(1, len(blocks))
             full = B.concat(blocks)
-            order = np.argsort(full[key], kind="stable")
+            order = np.argsort(B.column_numpy(full, key), kind="stable")
             if descending:
                 order = order[::-1]
             out = B.take_indices(full, order)
@@ -521,10 +530,10 @@ class GroupedData:
         def op(blocks: List[B.Block]) -> List[B.Block]:
             groups: Dict[Any, List[Any]] = {}
             for blk in blocks:
-                keys = blk[key]
+                keys = B.column_numpy(blk, key)
                 for g in np.unique(keys):
                     idx = np.nonzero(keys == g)[0]
-                    sub = B.take_indices(blk, idx)
+                    sub = B.ensure_numpy(B.take_indices(blk, idx))
                     gk = g.item() if hasattr(g, "item") else g
                     st = groups.setdefault(gk, [a.init() for a in aggs])
                     for i, a in enumerate(aggs):
@@ -563,10 +572,12 @@ class GroupedData:
 
         def op(blocks: List[B.Block]) -> List[B.Block]:
             full = B.concat(blocks)
-            keys = full[key]
+            keys = B.column_numpy(full, key)
             out: List[B.Block] = []
             for g in np.unique(keys):
-                sub = B.take_indices(full, np.nonzero(keys == g)[0])
+                sub = B.ensure_numpy(
+                    B.take_indices(full, np.nonzero(keys == g)[0])
+                )
                 out.append(_coerce_batch(fn(sub)))
             return out
 
@@ -577,8 +588,8 @@ def _zip_task(n_left: int, *blocks):
     """Remote: zip left/right block lists; returns (ref, meta) pairs."""
     import ray_tpu as rt
 
-    left = B.concat(list(blocks[:n_left]))
-    right = B.concat(list(blocks[n_left:]))
+    left = B.ensure_numpy(B.concat(list(blocks[:n_left])))
+    right = B.ensure_numpy(B.concat(list(blocks[n_left:])))
     if B.num_rows(left) != B.num_rows(right):
         raise ValueError("zip requires equal row counts")
     merged = dict(left)

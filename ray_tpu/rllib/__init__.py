@@ -7,7 +7,7 @@ host-collective gradient allreduce (the CPU-fleet path).  PPO is the
 first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
-from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, IMPALAConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
+from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, IMPALAConfig, MARWIL, MARWILConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
@@ -23,6 +23,8 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "CQL",
     "CQLConfig",
     "CartPoleVectorEnv",

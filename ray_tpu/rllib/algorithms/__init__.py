@@ -5,6 +5,7 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
     MultiAgentPPO,
     MultiAgentPPOConfig,
@@ -25,6 +26,8 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
+    "MARWIL",
+    "MARWILConfig",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "PPO",

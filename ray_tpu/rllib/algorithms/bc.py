@@ -90,9 +90,16 @@ def _coerce_offline(input_: Any) -> Dict[str, np.ndarray]:
 
 
 class BC(Algorithm):
+    # subclass hooks (MARWIL swaps both without rebuilding the learner)
+    def _loss_fn(self):
+        return bc_loss
+
+    def _prepare_dataset(self):
+        return _coerce_offline(self.config.input_)
+
     def setup_components(self):
         cfg = self.config
-        self.dataset = _coerce_offline(cfg.input_)
+        self.dataset = self._prepare_dataset()
         obs_dim = self.dataset["obs"].shape[1]
         num_actions = int(self.dataset["actions"].max()) + 1
         self.env_runner_group = None
@@ -112,7 +119,7 @@ class BC(Algorithm):
             hidden=tuple(cfg.model.get("hidden", (64, 64))),
         )
         self.learner_group = LearnerGroup(
-            self.module, bc_loss, num_learners=cfg.num_learners,
+            self.module, self._loss_fn(), num_learners=cfg.num_learners,
             lr=cfg.lr, grad_clip=cfg.grad_clip, seed=cfg.seed, mesh=cfg.mesh,
         )
         self._rng = np.random.default_rng(cfg.seed)
