@@ -50,12 +50,13 @@ def main() -> None:
     on_tpu = jax.default_backend() == "tpu"
     cfg = gpt2.GPT2Config.gpt2_124m()
     if on_tpu:
-        # flash (Pallas, 1024-blocks) beats dense XLA attention by ~13%
-        # end-to-end at these shapes; bf16 lm-head logits halve the
-        # step's largest HBM tensor for another ~2% (loss unchanged to
-        # 3 decimals); batch 32 measured best (40/48+ slower or OOM)
+        # flash (Pallas) with the SINGLE-TILE FUSED backward (dq/dk/dv
+        # in one kernel sharing the s/p/ds recompute + in-kernel delta)
+        # + bf16 lm-head logits + full remat; batch 35 measured best
+        # with the fused bwd (32: 92.3k, 34: 96.7k, 35: 98.1k,
+        # 36: 95.5k tok/s on v5e-1)
         cfg = gpt2.GPT2Config(attention="flash", logits_dtype=jnp.bfloat16)
-        batch, seq, iters = 32, 1024, 6
+        batch, seq, iters = 35, 1024, 6
     else:  # keep CI/CPU runs under a minute; same code path
         cfg = gpt2.GPT2Config(
             vocab_size=8192, n_positions=256, n_embd=256, n_layer=4, n_head=8

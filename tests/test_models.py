@@ -206,6 +206,8 @@ def test_gpt2_remat_policies_agree():
         {"remat_policy": "names"},
         {"remat_policy": "half"},
         {"remat_policy": "full", "scan_unroll": 2},
+        {"remat_skip": 1},
+        {"remat_skip": 2},  # == n_layer: nothing remats
     ):
         cfg = gpt2.GPT2Config(**base, **kwargs)
         params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
@@ -223,3 +225,13 @@ def test_gpt2_remat_policies_agree():
                 ),
                 grads, ref[1],
             )
+
+
+def test_gpt2_remat_skip_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        gpt2.GPT2Config(n_layer=2, remat_skip=3)
+    with pytest.raises(ValueError):
+        gpt2.GPT2Config(remat_skip=1, remat_policy="half")
+    gpt2.GPT2Config(n_layer=2, remat_skip=2)

@@ -157,3 +157,24 @@ def test_moe_expert_parallel_matches_local():
     np.testing.assert_allclose(
         np.asarray(out_ep), np.concatenate(outs), rtol=2e-4, atol=2e-4
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_grad_fused_single_tile(causal):
+    """blocks == T dispatches the FUSED single-tile backward (one
+    kernel computing dq/dk/dv with in-kernel delta) — the bench-shape
+    path; must match dense gradients like the split kernels do."""
+    q, k, v = _qkv(T=32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, 32, 32, True) ** 2)
+
+    def f_plain(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=causal) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_plain, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3
+        )
