@@ -9,6 +9,7 @@ test backend.
 """
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.gke import GkeNodeProvider
 from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
 from ray_tpu.autoscaler.v2 import (
     AutoscalerV2,
@@ -22,6 +23,7 @@ __all__ = [
     "AutoscalerConfig",
     "AutoscalerV2",
     "AutoscalerV2Config",
+    "GkeNodeProvider",
     "InstanceManager",
     "LocalNodeProvider",
     "NodeProvider",

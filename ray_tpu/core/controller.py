@@ -214,10 +214,14 @@ class Controller:
                       severity: str = "INFO", **custom_fields):
         from ray_tpu.util.events import make_event
 
-        self.cluster_events.append(make_event(
+        ev = make_event(
             event_type, message, severity=severity, source="controller",
             **custom_fields,
-        ))
+        )
+        self.cluster_events.append(ev)
+        # live stream to subscribers (reference: GCS event pubsub
+        # channels feeding `ray events`/dashboard watchers)
+        self._publish("cluster_events", ev)
 
     async def _mark_node_dead(self, node: NodeInfo, reason: str):
         if not node.alive:
@@ -249,7 +253,11 @@ class Controller:
                     pass
 
     async def handle_subscribe(self, payload, conn):
-        self._subscribers.setdefault(payload["channel"], []).append(conn)
+        subs = self._subscribers.setdefault(payload["channel"], [])
+        if conn not in subs:  # idempotent: re-subscribes never duplicate
+            subs.append(conn)
+        # closed connections would otherwise accumulate forever
+        subs[:] = [c for c in subs if not c.closed]
         return {"ok": True}
 
     # ---- nodes -------------------------------------------------------
@@ -646,6 +654,7 @@ class Controller:
     # `dashboard/modules/event/`) --------------------------------------
     async def handle_report_cluster_event(self, payload, conn):
         self.cluster_events.append(payload["event"])
+        self._publish("cluster_events", payload["event"])
         return {"ok": True}
 
     async def handle_list_cluster_events(self, payload, conn):

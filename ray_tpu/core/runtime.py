@@ -272,6 +272,8 @@ class Runtime:
         # received via worker_log — tests and tooling read this; the
         # lines are also echoed to stderr (core/log_stream.py)
         self._worker_log_lines: deque = deque(maxlen=2000)
+        # pubsub: channel -> list of local subscriber queues
+        self._pubsub_queues: Dict[str, list] = {}
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -1798,6 +1800,53 @@ class Runtime:
         if fn is None:
             raise rpc.RpcError(f"runtime: no handler {method!r}")
         return await fn(payload, conn)
+
+    async def _h_publish(self, payload, conn):
+        """Pubsub delivery from the controller (reference:
+        `src/ray/pubsub/` long-poll push): fan the message out to every
+        local queue subscribed to its channel."""
+        channel = payload.get("channel")
+        with self._state_lock:
+            queues = list(self._pubsub_queues.get(channel, []))
+        for q in queues:
+            q.put_nowait(payload.get("msg"))
+        return {"ok": True}
+
+    def subscribe(self, channel: str):
+        """Subscribe to a controller pubsub channel; returns an
+        `asyncio.Queue`-backed iterator handle usable from any thread
+        via `next_message(timeout)` (reference: `GcsSubscriber` —
+        typed channel subscription with queued delivery)."""
+        import queue as _q
+
+        q = _q.Queue()
+        with self._state_lock:
+            self._pubsub_queues.setdefault(channel, []).append(q)
+            registered = getattr(self, "_pubsub_registered", None)
+            if registered is None:
+                registered = self._pubsub_registered = set()
+            # register with the controller AT MOST once per channel for
+            # this connection's lifetime — re-registering on each local
+            # watcher would have the controller deliver duplicates
+            need_rpc = channel not in registered
+            registered.add(channel)
+        if need_rpc:
+            self.controller_call("subscribe", {"channel": channel})
+
+        class _Subscription:
+            def __init__(self, runtime):
+                self._rt = runtime
+
+            def next_message(self, timeout=None):
+                return q.get(timeout=timeout)
+
+            def close(self):
+                with self._rt._state_lock:
+                    lst = self._rt._pubsub_queues.get(channel, [])
+                    if q in lst:
+                        lst.remove(q)
+
+        return _Subscription(self)
 
     async def _h_task_result(self, payload, conn):
         """A task we own finished on a worker (direct push reply) or was

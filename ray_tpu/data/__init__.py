@@ -17,13 +17,16 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range,
+    read_avro,
     read_binary_files,
     read_csv,
     read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -46,11 +49,14 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_avro",
     "read_binary_files",
     "read_csv",
     "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
+    "read_tfrecords",
 ]

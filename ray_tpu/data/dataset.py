@@ -487,6 +487,9 @@ class Dataset:
             lambda p: ds_mod.write_numpy_block(p, column), path
         )
 
+    def write_tfrecords(self, path: str) -> int:
+        return self._write(ds_mod.write_tfrecords_block, path)
+
     # ---- global aggregates -------------------------------------------
     def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dict[str, Any]:
         states = [a.init() for a in aggs]
@@ -630,6 +633,9 @@ def _coerce_batch(res) -> B.Block:
 # read API (reference: `ray.data.read_*` / `from_*` in data/read_api.py)
 # ---------------------------------------------------------------------
 def _read_ds(tasks, name) -> Dataset:
+    from ray_tpu.util.usage_stats import record_library_usage
+
+    record_library_usage("data")
     return Dataset(LogicalPlan([ReadOp(tasks, name=name)]))
 
 
@@ -693,3 +699,20 @@ def read_images(paths, size=None, mode=None,
                             include_paths=include_paths),
         "Read(images)",
     )
+
+
+def read_tfrecords(paths, *, parse_example: bool = True,
+                   verify: bool = True) -> Dataset:
+    return _read_ds(
+        ds_mod.tfrecord_tasks(paths, parse_example=parse_example,
+                              verify=verify),
+        "Read(tfrecords)",
+    )
+
+
+def read_avro(paths) -> Dataset:
+    return _read_ds(ds_mod.avro_tasks(paths), "Read(avro)")
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    return _read_ds(ds_mod.sql_tasks(sql, connection_factory), "Read(sql)")

@@ -284,3 +284,81 @@ def write_json_block(path_dir: str):
         return [{"path": np.asarray([f]), "num_rows": np.asarray([B.num_rows(blk)])}]
 
     return write
+
+
+def tfrecord_tasks(paths, *, parse_example: bool = True,
+                   verify: bool = True) -> List[Callable[[], List[B.Block]]]:
+    """One read task per TFRecord file (reference:
+    `_internal/datasource/tfrecords_datasource.py` — there TF-backed;
+    here `data/tfrecord.py`'s native framing + tf.Example codec)."""
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            from ray_tpu.data.tfrecord import read_tfrecords_rows
+
+            return [B.from_rows(
+                read_tfrecords_rows(f, parse_example=parse_example,
+                                    verify=verify)
+            )]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def write_tfrecords_block(path_dir: str):
+    """Write helper: each block becomes one .tfrecord file of
+    tf.Examples (columns -> features)."""
+
+    def write(blk: B.Block) -> List[B.Block]:
+        import uuid
+
+        from ray_tpu.data.tfrecord import encode_example, write_records
+
+        os.makedirs(path_dir, exist_ok=True)
+        f = os.path.join(path_dir, f"part-{uuid.uuid4().hex[:12]}.tfrecord")
+        write_records(f, [
+            encode_example(row) for row in B.iter_rows(blk)
+        ])
+        return [{"path": np.asarray([f]),
+                 "num_rows": np.asarray([B.num_rows(blk)])}]
+
+    return write
+
+
+def avro_tasks(paths) -> List[Callable[[], List[B.Block]]]:
+    """Avro object-container files (reference:
+    `_internal/datasource/avro_datasource.py`); `data/avro.py` is a
+    native reader for null/deflate codecs."""
+    files = _expand_paths(paths)
+
+    def make(f: str):
+        def read():
+            from ray_tpu.data.avro import read_avro_rows
+
+            return [B.from_rows(read_avro_rows(f))]
+
+        return read
+
+    return [make(f) for f in files]
+
+
+def sql_tasks(sql: str, connection_factory) -> List[Callable[[], List[B.Block]]]:
+    """One read task running `sql` through a DB-API connection from
+    `connection_factory` (reference: `_internal/datasource/
+    sql_datasource.py` — same contract: the factory must be
+    serializable, the connection is made ON the worker)."""
+
+    def read():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+        finally:
+            conn.close()
+        return [B.from_rows(rows)]
+
+    return [read]

@@ -88,6 +88,9 @@ class AlgorithmConfig:
         raise NotImplementedError
 
     def build(self):
+        from ray_tpu.util.usage_stats import record_library_usage
+
+        record_library_usage("rllib")
         """Reference: `AlgorithmConfig.build_algo`."""
         per_step = self.num_env_runners * self.num_envs_per_env_runner
         if self.train_batch_size > 0:

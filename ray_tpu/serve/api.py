@@ -363,6 +363,9 @@ def run(
     (reference: `serve/api.py:510` serve.run)."""
     if not isinstance(target, Application):
         raise TypeError("serve.run expects the Application from .bind()")
+    from ray_tpu.util.usage_stats import record_library_usage
+
+    record_library_usage("serve")
     controller = start(proxy=True)
     collected: Dict[str, Any] = {"__app_name__": name}
     _collect_deployments(target, collected)

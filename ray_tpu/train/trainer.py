@@ -107,6 +107,9 @@ class DataParallelTrainer(BaseTrainer):
         return False
 
     def fit(self) -> Result:
+        from ray_tpu.util.usage_stats import record_library_usage
+
+        record_library_usage("train")
         run_dir = self._run_dir()
         ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
         max_failures = self.run_config.failure_config.max_failures

@@ -157,6 +157,9 @@ class Tuner:
         return trials
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.util.usage_stats import record_library_usage
+
+        record_library_usage("tune")
         experiment_dir = self._experiment_dir()
         trials = self._build_trials(experiment_dir)
         controller = TuneController(

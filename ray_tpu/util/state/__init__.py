@@ -154,3 +154,31 @@ __all__ = [
     "summarize_tasks",
     "timeline",
 ]
+
+
+def list_cluster_events(severity: Optional[str] = None,
+                        event_type: Optional[str] = None,
+                        limit: int = 200) -> List[Dict[str, Any]]:
+    """Structured cluster event log (reference: `ray list
+    cluster-events` over `dashboard/modules/event/`)."""
+    return get_runtime().controller_call(
+        "list_cluster_events",
+        {"severity": severity, "event_type": event_type, "limit": limit},
+    )
+
+
+def watch_cluster_events(timeout: Optional[float] = None):
+    """Generator of live cluster events via the controller's pubsub
+    channel (reference: the GCS event pubsub feeding dashboard
+    watchers).  Yields until `timeout` passes with no event."""
+    import queue as _q
+
+    sub = get_runtime().subscribe("cluster_events")
+    try:
+        while True:
+            try:
+                yield sub.next_message(timeout=timeout)
+            except _q.Empty:
+                return
+    finally:
+        sub.close()

@@ -1,0 +1,80 @@
+"""Usage stats: opt-in cluster usage reporting.
+
+Reference: `python/ray/_private/usage/usage_lib.py` — collects
+coarse-grained cluster facts (version, cluster size, which libraries
+were touched) and reports them once per interval, controllable via env
+var.  Differences here, deliberate: reporting is **opt-in**
+(`RT_USAGE_STATS_ENABLED=1`; the reference is opt-out), and the report
+sink is a local JSON file plus an injectable transport — nothing ever
+leaves the machine unless an operator plugs in a real transport.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_ENV = "RT_USAGE_STATS_ENABLED"
+_library_usages: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get(_ENV, "0").lower() in ("1", "true", "yes")
+
+
+def record_library_usage(name: str) -> None:
+    """Called by library entry points (serve.start, Tuner.fit, ...);
+    a no-op set insert when reporting is disabled."""
+    _library_usages.add(name)
+
+
+def _collect(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        nodes = rt.controller_call("get_nodes") if rt is not None else []
+    except Exception:
+        nodes = []
+    total = {}
+    for n in nodes or []:
+        for k, v in (n.get("resources") or {}).items():
+            total[k] = total.get(k, 0.0) + v
+    report = {
+        "schema_version": 1,
+        "timestamp": time.time(),
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "num_nodes": len(nodes or []),
+        "total_resources": total,
+        "libraries_used": sorted(_library_usages),
+    }
+    if extra:
+        report.update(extra)
+    return report
+
+
+def report_usage(transport: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 session_dir: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Collect + deliver one report; returns it (None when disabled).
+    `transport(report)` is the egress seam — absent, the report only
+    lands in `<session_dir>/usage_stats.json`."""
+    if not usage_stats_enabled():
+        return None
+    report = _collect()
+    sdir = session_dir or os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+    try:
+        os.makedirs(sdir, exist_ok=True)
+        with open(os.path.join(sdir, "usage_stats.json"), "w") as f:
+            json.dump(report, f, indent=2)
+    except OSError:
+        pass
+    if transport is not None:
+        try:
+            transport(report)
+        except Exception:
+            pass  # usage stats must never break anything
+    return report

@@ -457,3 +457,118 @@ def test_arrow_carrier_for_string_columns(rt_start, tmp_path):
     batch = next(iter(ds.iter_batches(batch_size=10,
                                       batch_format="pyarrow")))
     assert isinstance(batch, pa.Table)
+
+
+# ---------------------------------------------------------------------------
+# TFRecord / Avro / SQL datasources (reference:
+# _internal/datasource/{tfrecords,avro,sql}_datasource.py)
+# ---------------------------------------------------------------------------
+def test_tfrecord_roundtrip_e2e(rt_start, tmp_path):
+    ds = rd.range(50, parallelism=2).map(
+        lambda r: {"id": r["id"], "name": f"row{r['id']}".encode(),
+                   "score": float(r["id"]) / 2}
+    )
+    n = ds.write_tfrecords(str(tmp_path / "tfr"))
+    assert n == 50
+    back = rd.read_tfrecords(str(tmp_path / "tfr"))
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 50
+    assert rows[10]["id"] == 10
+    assert rows[10]["name"] == b"row10"
+    assert rows[10]["score"] == 5.0
+
+
+def test_tfrecord_raw_records(rt_start, tmp_path):
+    from ray_tpu.data.tfrecord import write_records
+
+    p = str(tmp_path / "raw.tfrecord")
+    write_records(p, [b"alpha", b"beta"])
+    rows = rd.read_tfrecords(p, parse_example=False).take_all()
+    assert [r["data"] for r in rows] == [b"alpha", b"beta"]
+
+
+def _write_avro_manually(path, codec=b"null"):
+    """Hand-rolled container file per the Avro 1.11 spec (fastavro is
+    not in the image; writing the bytes directly IS the spec check)."""
+    import json as _json
+    import struct as _struct
+    import zlib as _zlib
+
+    def zigzag(n):
+        u = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+        out = bytearray()
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            out.append(b | 0x80 if u else b)
+            if not u:
+                return bytes(out)
+
+    def avro_str(s):
+        b = s.encode() if isinstance(s, str) else s
+        return zigzag(len(b)) + b
+
+    schema = {"type": "record", "name": "Rec", "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": "string"},
+        {"name": "score", "type": "double"},
+        {"name": "tag", "type": ["null", "string"]},
+    ]}
+    body = b""
+    recs = [(1, "a", 0.5, None), (2, "b", 1.5, "x"), (-3, "c", 2.5, None)]
+    for rid, name, score, tag in recs:
+        body += zigzag(rid) + avro_str(name)
+        body += _struct.pack("<d", score)
+        body += zigzag(0) if tag is None else zigzag(1) + avro_str(tag)
+    if codec == b"deflate":
+        body = _zlib.compress(body)[2:-4]  # raw stream
+    sync = b"S" * 16
+    blob = b"Obj\x01"
+    blob += zigzag(2)  # metadata map: 2 entries
+    blob += avro_str("avro.schema") + avro_str(_json.dumps(schema))
+    blob += avro_str("avro.codec") + avro_str(codec)
+    blob += zigzag(0)  # end of map
+    blob += sync
+    blob += zigzag(len(recs)) + zigzag(len(body)) + body + sync
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+@pytest.mark.parametrize("codec", [b"null", b"deflate"])
+def test_avro_reader(rt_start, tmp_path, codec):
+    p = str(tmp_path / "t.avro")
+    _write_avro_manually(p, codec)
+    rows = rd.read_avro(p).take_all()
+    assert len(rows) == 3
+    assert rows[0] == {"id": 1, "name": "a", "score": 0.5, "tag": None}
+    assert rows[1]["tag"] == "x"
+    assert rows[2]["id"] == -3
+
+
+def test_read_sql(rt_start, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO users VALUES (?, ?)",
+                     [(1, "ann"), (2, "bob")])
+    conn.commit()
+    conn.close()
+    rows = rd.read_sql(
+        "SELECT id, name FROM users ORDER BY id",
+        lambda: __import__("sqlite3").connect(db),
+    ).take_all()
+    assert rows == [{"id": 1, "name": "ann"}, {"id": 2, "name": "bob"}]
+
+
+def test_tfrecord_malformed_example_falls_back_to_raw(rt_start, tmp_path):
+    """Records that LOOK like an Example prefix but are truncated must
+    surface as raw bytes, not crash the read task."""
+    from ray_tpu.data.tfrecord import write_records
+
+    p = str(tmp_path / "weird.tfrecord")
+    write_records(p, [b"\n\x80", b"plain"])
+    rows = rd.read_tfrecords(p).take_all()
+    assert rows[0]["data"] == b"\n\x80"
+    assert rows[1]["data"] == b"plain"

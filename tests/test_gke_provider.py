@@ -1,0 +1,109 @@
+"""GKE/k8s node provider against a recorded transport (reference:
+`autoscaler/_private/kuberay/node_provider.py` test style — no real
+API server, the transport seam carries everything)."""
+
+import json
+
+from ray_tpu.autoscaler.gke import GkeNodeProvider
+
+
+class FakeK8s:
+    def __init__(self):
+        self.pods = {}
+        self.calls = []
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url, body))
+        if method == "POST":
+            name = body["metadata"]["name"]
+            pod = dict(body)
+            pod["status"] = {"phase": "Pending"}
+            self.pods[name] = pod
+            return pod
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[1]
+            self.pods.pop(name, None)
+            return {}
+        if method == "GET":
+            if "labelSelector" in url:
+                return {"items": list(self.pods.values())}
+            name = url.rsplit("/", 1)[1].split("?")[0]
+            return self.pods.get(name, {})
+        raise AssertionError(method)
+
+    def set_phase(self, name, phase):
+        self.pods[name]["status"]["phase"] = phase
+
+
+def _provider(k8s, **kw):
+    return GkeNodeProvider(
+        "c1", controller_addr=("10.0.0.1", 7000),
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4",
+        transport=k8s, **kw,
+    )
+
+
+def test_create_list_terminate_pods():
+    k8s = FakeK8s()
+    p = _provider(k8s)
+    [pid] = p.create_node({"num_cpus": 2, "num_workers": 2}, 1)
+    assert pid in p.non_terminated_nodes()
+    pod = k8s.pods[pid]
+    assert pod["metadata"]["labels"]["rt-cluster"] == "c1"
+    args = pod["spec"]["containers"][0]["args"]
+    assert "--controller" in args
+    assert args[args.index("--controller") + 1] == "10.0.0.1:7000"
+    # no TPU requested -> no TPU selector or limit
+    assert "nodeSelector" not in pod["spec"]
+    p.terminate_node(pid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_tpu_pod_shape():
+    k8s = FakeK8s()
+    p = _provider(k8s)
+    [pid] = p.create_node({
+        "num_cpus": 8, "resources": {"TPU": 4},
+        "labels": {"tpu-slice": "s1"},
+    }, 1)
+    pod = k8s.pods[pid]
+    limits = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == (
+        "tpu-v5-lite-podslice"
+    )
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    # slice label rides to the daemon AND the pod labels
+    args = pod["spec"]["containers"][0]["args"]
+    assert json.loads(args[args.index("--labels") + 1]) == {
+        "tpu-slice": "s1"
+    }
+    assert pod["metadata"]["labels"]["rt-tpu-slice"] == "s1"
+    assert p.node_resources(pid) == {"CPU": 8.0, "TPU": 4.0}
+
+
+def test_slice_create_rolls_back_on_partial_failure():
+    class Flaky(FakeK8s):
+        def __call__(self, method, url, body):
+            if method == "POST" and len(self.pods) >= 2:
+                raise RuntimeError("quota")
+            return super().__call__(method, url, body)
+
+    k8s = Flaky()
+    p = _provider(k8s)
+    try:
+        p.create_slice({"num_cpus": 1, "labels": {"tpu-slice": "s"}}, 4)
+        raise AssertionError("expected failure")
+    except RuntimeError:
+        pass
+    # the default create_slice rollback removed the partial pods
+    assert p.non_terminated_nodes() == []
+
+
+def test_succeeded_pods_are_not_alive():
+    k8s = FakeK8s()
+    p = _provider(k8s)
+    [pid] = p.create_node({"num_cpus": 1}, 1)
+    k8s.set_phase(pid, "Succeeded")
+    assert p.non_terminated_nodes() == []

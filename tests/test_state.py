@@ -152,3 +152,78 @@ def test_list_workers_cluster_wide(cluster):
     ws = state.list_workers()
     assert ws and all("pid" in w and "node_id" in w for w in ws)
     assert any(w["kind"] == "worker" for w in ws)
+
+
+def test_watch_cluster_events_live_stream(rt_start):
+    """Pubsub consumer path end-to-end: a subscriber sees events
+    published AFTER it subscribed (node lifecycle + client-reported),
+    no polling (reference: src/ray/pubsub/ long-poll channels)."""
+    import threading
+
+    from ray_tpu.util import events as ev_mod
+    from ray_tpu.util import state
+
+    got = []
+    ready = threading.Event()
+
+    def watcher():
+        gen = state.watch_cluster_events(timeout=30)
+        ready.set()
+        for ev in gen:
+            got.append(ev)
+            if ev.get("event_type") == "WATCH_DONE":
+                return
+
+    t = threading.Thread(target=watcher, daemon=True)
+    t.start()
+    assert ready.wait(10)
+    import time as _t
+
+    _t.sleep(0.3)  # let the subscribe RPC land before publishing
+    ev_mod.report_event("WATCH_A", "first")
+    ev_mod.report_event("WATCH_DONE", "sentinel")
+    t.join(timeout=30)
+    assert not t.is_alive(), "watcher never saw the sentinel"
+    types = [e["event_type"] for e in got]
+    assert "WATCH_A" in types and types[-1] == "WATCH_DONE"
+    # the ring also recorded them for late readers
+    listed = state.list_cluster_events(event_type="WATCH_A")
+    assert len(listed) == 1
+
+
+def test_watch_cluster_events_no_duplicates_on_rewatch(rt_start):
+    """A second watch cycle must not double-deliver (the subscribe RPC
+    is idempotent per connection; close() only drops the local queue)."""
+    from ray_tpu.util import events as ev_mod
+    from ray_tpu.util import state
+
+    # first cycle: subscribe, drain one event, close
+    import threading
+    import time as _t
+
+    def run_cycle(tag):
+        got = []
+        ready = threading.Event()
+
+        def watcher():
+            gen = state.watch_cluster_events(timeout=20)
+            ready.set()
+            for ev in gen:
+                got.append(ev)
+                if ev.get("event_type") == f"DONE_{tag}":
+                    return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        _t.sleep(0.3)
+        ev_mod.report_event(f"PING_{tag}", "x")
+        ev_mod.report_event(f"DONE_{tag}", "sentinel")
+        t.join(timeout=20)
+        assert not t.is_alive()
+        return [e["event_type"] for e in got]
+
+    run_cycle("A")
+    types = run_cycle("B")
+    assert types.count("PING_B") == 1, types
+    assert types.count("DONE_B") == 1, types

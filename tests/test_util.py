@@ -188,3 +188,33 @@ def test_ring_allreduce_large_arrays(cluster):
         assert abs(first - expected[0] / world) < 1e-9
     for m in members:
         rt.kill(m)
+
+
+def test_usage_stats_opt_in(tmp_path, monkeypatch):
+    """Reference: `_private/usage/usage_lib.py` — here OPT-IN, local
+    sink, injectable transport; disabled means no file and no calls."""
+    from ray_tpu.util import usage_stats as us
+
+    calls = []
+    monkeypatch.delenv("RT_USAGE_STATS_ENABLED", raising=False)
+    assert us.report_usage(transport=calls.append,
+                           session_dir=str(tmp_path)) is None
+    assert calls == [] and not (tmp_path / "usage_stats.json").exists()
+
+    monkeypatch.setenv("RT_USAGE_STATS_ENABLED", "1")
+    us.record_library_usage("data")
+    us.record_library_usage("serve")
+    report = us.report_usage(transport=calls.append,
+                             session_dir=str(tmp_path))
+    assert report["schema_version"] == 1
+    assert set(report["libraries_used"]) >= {"data", "serve"}
+    assert calls == [report]
+    import json
+
+    on_disk = json.loads((tmp_path / "usage_stats.json").read_text())
+    assert on_disk["schema_version"] == 1
+    # a crashing transport never propagates
+    def boom(_):
+        raise RuntimeError("egress down")
+    assert us.report_usage(transport=boom,
+                           session_dir=str(tmp_path)) is not None
