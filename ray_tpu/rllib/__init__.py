@@ -8,6 +8,13 @@ first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
 from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, IMPALAConfig, MARWIL, MARWILConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
+from ray_tpu.rllib.connectors import (
+    ConnectorPipeline,
+    ConnectorV2,
+    MeanStdObsFilter,
+    ObsClip,
+    RewardClip,
+)
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
@@ -18,6 +25,11 @@ from ray_tpu.rllib.env import (
 
 __all__ = [
     "Algorithm",
+    "ConnectorPipeline",
+    "ConnectorV2",
+    "MeanStdObsFilter",
+    "ObsClip",
+    "RewardClip",
     "AlgorithmConfig",
     "APPO",
     "APPOConfig",

@@ -27,6 +27,10 @@ class AlgorithmConfig:
         self.seed: int = 0
         self.model: Dict[str, Any] = {"hidden": (64, 64)}
         self.mesh: Any = None  # jax Mesh for SPMD learner sharding
+        # env<->module connector pipeline FACTORY (reference:
+        # config.env_runners(env_to_module_connector=...)); a factory —
+        # not an instance — so each runner actor builds its own state
+        self.env_to_module_connector: Any = None
 
     # -- fluent sections (each returns self, reference-style) ----------
     def environment(self, env: Any = None, *, env_config: Optional[Dict] = None,

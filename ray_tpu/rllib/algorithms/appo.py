@@ -135,6 +135,7 @@ class APPO(Algorithm):
             cfg.env, cfg.num_env_runners, cfg.num_envs_per_env_runner,
             cfg.rollout_fragment_length, seed=cfg.seed,
             env_kwargs=cfg.env_kwargs,
+            connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
         self.module = MLPModule(

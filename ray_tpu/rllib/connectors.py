@@ -1,0 +1,215 @@
+"""Connector pipelines: composable transforms on the env<->module path.
+
+Reference: `rllib/connectors/` ConnectorV2 — pluggable pieces that
+transform observations before the module sees them (env-to-module),
+actions before the env sees them (module-to-env), and rewards before
+they land in the train batch.  TPU-native shape: connectors run inside
+the numpy EnvRunner actor (the CPU side), and the TRANSFORMED
+observations are what the rollout batch stores, so the compiled learner
+trains on exactly what the policy acted on — no recompute and no
+train/act skew.
+
+Stateful connectors (the running mean/std filter) expose
+`get_state`/`set_state`; the EnvRunnerGroup merges per-runner states
+periodically (reference: connector state aggregation across
+EnvRunners) so every runner normalizes with the fleet-wide statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform stage.  Override any hook; defaults pass through.
+
+    Hooks run per vector-env step on numpy arrays:
+    - `on_observations(obs[B, D])` before the module forward (and on
+      truncation-bootstrap/final observations),
+    - `on_actions(actions[B])` before `env.step`,
+    - `on_rewards(rewards[B])` before the rollout buffer.
+    """
+
+    def on_observations(self, obs: np.ndarray) -> np.ndarray:
+        return obs
+
+    def on_actions(self, actions: np.ndarray) -> np.ndarray:
+        return actions
+
+    def on_rewards(self, rewards: np.ndarray) -> np.ndarray:
+        return rewards
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    @staticmethod
+    def merge_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Combine per-runner states into the fleet state; default:
+        first non-empty wins (stateless connectors don't care)."""
+        for s in states:
+            if s:
+                return s
+        return {}
+
+
+class ConnectorPipeline(ConnectorV2):
+    """Ordered composition (reference: ConnectorPipelineV2)."""
+
+    def __init__(self, connectors: Sequence[ConnectorV2] = ()):
+        self.connectors = list(connectors)
+
+    def on_observations(self, obs):
+        for c in self.connectors:
+            obs = c.on_observations(obs)
+        return obs
+
+    def on_actions(self, actions):
+        for c in self.connectors:
+            actions = c.on_actions(actions)
+        return actions
+
+    def on_rewards(self, rewards):
+        for c in self.connectors:
+            rewards = c.on_rewards(rewards)
+        return rewards
+
+    def get_state(self):
+        return {str(i): c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state):
+        for i, c in enumerate(self.connectors):
+            if str(i) in state:
+                c.set_state(state[str(i)])
+
+    def merge_states(self, states):  # type: ignore[override]
+        out = {}
+        for i, c in enumerate(self.connectors):
+            key = str(i)
+            out[key] = c.merge_states([s.get(key, {}) for s in states])
+        return out
+
+
+def _welford_add(count, mean, m2, flat):
+    n = flat.shape[0]
+    if n == 0:
+        return count, mean, m2
+    batch_mean = flat.mean(axis=0)
+    batch_m2 = ((flat - batch_mean) ** 2).sum(axis=0)
+    delta = batch_mean - mean
+    total = count + n
+    mean = mean + delta * n / total
+    m2 = m2 + batch_m2 + delta ** 2 * count * n / total
+    return total, mean, m2
+
+
+def _welford_combine(a, b):
+    """Parallel-variance combination of two (count, mean, m2) stats."""
+    ca, ma, m2a = a
+    cb, mb, m2b = b
+    if cb <= 0:
+        return a
+    if ca <= 0:
+        return b
+    delta = mb - ma
+    total = ca + cb
+    return (
+        total,
+        ma + delta * cb / total,
+        m2a + m2b + delta ** 2 * ca * cb / total,
+    )
+
+
+class MeanStdObsFilter(ConnectorV2):
+    """Running observation normalization (reference:
+    `connectors/env_to_module/mean_std_filter.py`): Welford-style
+    running mean/var per feature, observations standardized and
+    clipped.
+
+    Fleet protocol: the filter keeps a synced BASE (set by
+    `set_state` with the merged fleet stats) and a local DELTA of
+    samples seen since; `get_state` reports the delta only, and the
+    merge combines base + one delta per runner — runners never
+    re-contribute history they already reported (a full-state merge
+    would double shared history N-fold per sync and freeze the
+    normalizer on early statistics)."""
+
+    def __init__(self, clip: float = 10.0, eps: float = 1e-8):
+        self.clip = clip
+        self.eps = eps
+        self._base = None  # (count, mean, m2) merged fleet stats
+        self._delta = None  # (count, mean, m2) local since last sync
+
+    def _ensure(self, dim):
+        if self._delta is None:
+            zero = (0.0, np.zeros(dim, np.float64), np.zeros(dim, np.float64))
+            self._delta = zero
+        if self._base is None:
+            self._base = (
+                0.0, np.zeros(dim, np.float64), np.zeros(dim, np.float64)
+            )
+
+    def on_observations(self, obs):
+        obs = np.asarray(obs, np.float32)
+        self._ensure(obs.shape[-1])
+        flat = obs.reshape(-1, obs.shape[-1]).astype(np.float64)
+        self._delta = _welford_add(*self._delta, flat)
+        count, mean, m2 = _welford_combine(self._base, self._delta)
+        std = np.sqrt(m2 / max(count, 1.0)) + self.eps
+        out = (obs - mean.astype(np.float32)) / std.astype(np.float32)
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self):
+        """The DELTA to contribute to the next fleet merge."""
+        if self._delta is None:
+            return {}
+        c, m, m2 = self._delta
+        return {"count": c, "mean": m.copy(), "m2": m2.copy()}
+
+    def set_state(self, state):
+        """Adopt merged fleet stats as the new base; the reported delta
+        is part of it now, so local accumulation restarts."""
+        if not state:
+            return
+        self._base = (
+            state["count"], np.array(state["mean"]), np.array(state["m2"])
+        )
+        dim = self._base[1].shape[0]
+        self._delta = (
+            0.0, np.zeros(dim, np.float64), np.zeros(dim, np.float64)
+        )
+
+    @staticmethod
+    def merge_states(states):
+        live = [s for s in states if s and s.get("mean") is not None]
+        if not live:
+            return {}
+        acc = (0.0, np.zeros_like(np.asarray(live[0]["mean"])),
+               np.zeros_like(np.asarray(live[0]["m2"])))
+        for s in live:
+            acc = _welford_combine(
+                acc, (s["count"], np.asarray(s["mean"]), np.asarray(s["m2"]))
+            )
+        return {"count": acc[0], "mean": acc[1], "m2": acc[2]}
+
+
+class RewardClip(ConnectorV2):
+    """Clip rewards to [-bound, bound] (the Atari-style stabilizer)."""
+
+    def __init__(self, bound: float = 1.0):
+        self.bound = bound
+
+    def on_rewards(self, rewards):
+        return np.clip(rewards, -self.bound, self.bound)
+
+
+class ObsClip(ConnectorV2):
+    def __init__(self, bound: float = 10.0):
+        self.bound = bound
+
+    def on_observations(self, obs):
+        return np.clip(obs, -self.bound, self.bound)

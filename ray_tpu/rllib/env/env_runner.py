@@ -29,7 +29,8 @@ class EnvRunner:
     """One sampling actor (hosts the vector env + numpy policy copy)."""
 
     def __init__(self, env: Any, num_envs: int, rollout_length: int,
-                 seed: int = 0, env_kwargs: Optional[Dict] = None):
+                 seed: int = 0, env_kwargs: Optional[Dict] = None,
+                 connector: Any = None):
         self._env = make_vector_env(env, num_envs, seed=seed,
                                     **(env_kwargs or {}))
         self._T = rollout_length
@@ -37,6 +38,11 @@ class EnvRunner:
         self._obs = self._env.reset(seed=seed)
         self._params: Any = None
         self._weights_version = -1
+        # env<->module transform pipeline (reference: rllib/connectors/
+        # ConnectorV2); a factory callable lets the spec ship by value
+        self._connector = connector() if callable(connector) else connector
+        # end-of-rollout transformed obs, reused by the next sample()
+        self._cached_transformed_obs: Optional[np.ndarray] = None
         # per-sub-env running episode accounting for metrics
         self._ep_return = np.zeros(self._env.num_envs, dtype=np.float64)
         self._ep_len = np.zeros(self._env.num_envs, dtype=np.int64)
@@ -75,8 +81,22 @@ class EnvRunner:
         boot_buf = np.zeros((T, B), np.float32)
 
         select = getattr(module_def, "select_actions_numpy", None)
+        conn = self._connector
         obs = self._obs
         for t in range(T):
+            if conn is not None:
+                # the TRANSFORMED observation is what the policy acts on
+                # AND what the rollout stores — learner and actor see
+                # the same features (no train/act skew).  The previous
+                # rollout already transformed (and ingested) its final
+                # obs for the bootstrap value: reuse that result so the
+                # boundary row is neither double-counted in running
+                # stats nor normalized differently than its bootstrap.
+                if t == 0 and self._cached_transformed_obs is not None:
+                    obs = self._cached_transformed_obs
+                    self._cached_transformed_obs = None
+                else:
+                    obs = conn.on_observations(obs)
             if select is not None:
                 # module-defined exploration (e.g. epsilon-greedy DQN)
                 actions, logp, value = select(
@@ -91,14 +111,25 @@ class EnvRunner:
                 logp = np.log(np.take_along_axis(
                     probs, actions[:, None], axis=-1
                 )[:, 0] + 1e-10)
-            next_obs, rewards, terminated, truncated, info = self._env.step(actions)
+            env_actions = (
+                conn.on_actions(actions) if conn is not None else actions
+            )
+            next_obs, rewards, terminated, truncated, info = self._env.step(
+                env_actions
+            )
             done = terminated | truncated
             obs_buf[t], act_buf[t] = obs, actions
             logp_buf[t], val_buf[t] = logp, value
-            rew_buf[t] = rewards
+            # the buffer stores transformed rewards (clip/scale); the
+            # episode metrics below keep the RAW return
+            rew_buf[t] = (
+                conn.on_rewards(rewards) if conn is not None else rewards
+            )
             term_buf[t], trunc_buf[t] = terminated, truncated
             if truncated.any():
                 final = info["final_observation"][truncated]
+                if conn is not None:
+                    final = conn.on_observations(final)
                 _, fv = module_def.forward_numpy(self._params, final)
                 boot_buf[t, truncated] = fv
             # episode metrics
@@ -114,6 +145,9 @@ class EnvRunner:
                 self._ep_len[done] = 0
             obs = next_obs
         self._obs = obs
+        if conn is not None:
+            obs = conn.on_observations(obs)
+            self._cached_transformed_obs = obs
         _, final_value = module_def.forward_numpy(self._params, obs)
         return {
             "final_obs": obs.copy(),
@@ -133,4 +167,17 @@ class EnvRunner:
         return out
 
     def ping(self) -> bool:
+        return True
+
+    # -- connector state (reference: connector aggregation across
+    # EnvRunners) ------------------------------------------------------
+    def get_connector_state(self):
+        return (
+            self._connector.get_state() if self._connector is not None
+            else {}
+        )
+
+    def set_connector_state(self, state) -> bool:
+        if self._connector is not None and state:
+            self._connector.set_state(state)
         return True

@@ -18,13 +18,16 @@ from ray_tpu.rllib.env.env_runner import EnvRunner
 class EnvRunnerGroup:
     def __init__(self, env: Any, num_runners: int, num_envs_per_runner: int,
                  rollout_length: int, seed: int = 0,
-                 env_kwargs: Optional[Dict] = None):
+                 env_kwargs: Optional[Dict] = None,
+                 connector: Any = None):
         self._env = env
         self._num_runners = num_runners
         self._num_envs = num_envs_per_runner
         self._T = rollout_length
         self._seed = seed
         self._env_kwargs = env_kwargs or {}
+        self._connector_factory = connector
+        self._connector_base: Dict = {}  # merged fleet connector state
         self._runners: List = []
         self._weights: Any = None
         self._weights_version = 0
@@ -35,6 +38,7 @@ class EnvRunnerGroup:
         return rt.remote(EnvRunner).options(num_cpus=1).remote(
             self._env, self._num_envs, self._T,
             seed=self._seed + idx * 10_000, env_kwargs=self._env_kwargs,
+            connector=self._connector_factory,
         )
 
     def env_spec(self) -> Dict[str, int]:
@@ -64,6 +68,11 @@ class EnvRunnerGroup:
                     self._weights, self._weights_version))
         if not out:
             raise RuntimeError("all env runners failed")
+        # fleet-wide connector statistics converge once per sampling
+        # round — centralized here so EVERY algorithm built on the
+        # group gets it (not a per-algorithm opt-in)
+        if self._connector_factory is not None:
+            self.sync_connector_states()
         return out
 
     # -- async sampling (the IMPALA shape) -----------------------------
@@ -145,6 +154,36 @@ class EnvRunnerGroup:
         self._weights_version += 1
         for r in self._runners:
             r.set_weights.remote(params_np, self._weights_version)
+        # connector stats ride the same cadence on the async path
+        if (
+            self._connector_factory is not None
+            and self._weights_version % 8 == 0
+        ):
+            self.sync_connector_states()
+
+    def sync_connector_states(self):
+        """Merge per-runner connector DELTAS over the tracked fleet
+        base and push the result back (reference: connector state
+        aggregation across EnvRunners).  Runners report only samples
+        seen since their last sync, so shared history is never
+        double-counted."""
+        if self._connector_factory is None:
+            return None
+        refs = [r.get_connector_state.remote() for r in self._runners]
+        states = [self._connector_base]
+        for ref in refs:
+            try:
+                states.append(rt.get(ref, timeout=30))
+            except Exception:
+                states.append({})
+        proto = self._connector_factory()
+        merged = proto.merge_states(states)
+        if merged:
+            self._connector_base = merged
+            set_refs = [r.set_connector_state.remote(merged)
+                        for r in self._runners]
+            rt.wait(set_refs, num_returns=len(set_refs), timeout=30)
+        return merged
 
     def pop_metrics(self) -> List[Dict[str, float]]:
         metrics: List[Dict[str, float]] = []

@@ -1,0 +1,107 @@
+"""HuggingFace Transformers integration (reference:
+`train/huggingface/transformers/` — prepare_trainer +
+RayTrainReportCallback inside a TorchTrainer loop)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=2, num_cpus=8, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_hf_trainer_reports_through_session(cluster, tmp_path):
+    import torch
+
+    from ray_tpu.train import ScalingConfig, TorchTrainer
+    from ray_tpu.train.huggingface import (
+        RayTrainReportCallback, prepare_trainer,
+    )
+
+    out_dir = str(tmp_path / "hf_out")
+
+    def loop(config):
+        import torch.nn as nn
+        from transformers import Trainer, TrainingArguments
+
+        class TinyModel(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x=None, labels=None):
+                logits = self.fc(x)
+                loss = nn.functional.cross_entropy(logits, labels)
+                return {"loss": loss, "logits": logits}
+
+        class DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                g = torch.Generator().manual_seed(i)
+                x = torch.randn(4, generator=g)
+                return {"x": x, "labels": int(x.sum() > 0)}
+
+        args = TrainingArguments(
+            output_dir=out_dir,
+            max_steps=4,
+            per_device_train_batch_size=8,
+            logging_steps=2,
+            save_steps=4,
+            save_strategy="steps",
+            report_to=[],
+            use_cpu=True,
+        )
+        trainer = Trainer(model=TinyModel(), args=args, train_dataset=DS())
+        trainer.add_callback(RayTrainReportCallback())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    result = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics and "loss" in result.metrics
+    assert result.metrics["step"] == 4
+    # the HF checkpoint rode through train.report
+    assert result.checkpoint is not None
+    import os
+
+    files = os.listdir(result.checkpoint.to_directory())
+    assert any("model" in f or "safetensors" in f for f in files), files
+
+
+def test_prepare_trainer_is_idempotent_about_callback():
+    from ray_tpu.train.huggingface import (
+        RayTrainReportCallback, prepare_trainer,
+    )
+
+    class FakeHandler:
+        def __init__(self):
+            self.callbacks = [RayTrainReportCallback()]
+
+    class FakeArgs:
+        use_cpu = False
+        output_dir = "/tmp/x"
+
+    class FakeTrainer:
+        args = FakeArgs()
+        callback_handler = FakeHandler()
+
+        def add_callback(self, cb):
+            self.callback_handler.callbacks.append(cb)
+
+    t = FakeTrainer()
+    prepare_trainer(t)
+    assert t.args.use_cpu is True
+    n = sum(isinstance(c, RayTrainReportCallback)
+            for c in t.callback_handler.callbacks)
+    assert n == 1  # already present: not added twice
