@@ -7,7 +7,7 @@ host-collective gradient allreduce (the CPU-fleet path).  PPO is the
 first algorithm (reference: `rllib/algorithms/ppo/`).
 """
 
-from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, IMPALAConfig, MARWIL, MARWILConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
+from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, Dreamer, DreamerConfig, IMPALAConfig, MARWIL, MARWILConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
 from ray_tpu.rllib.connectors import (
     ConnectorPipeline,
     ConnectorV2,
@@ -42,6 +42,8 @@ __all__ = [
     "CartPoleVectorEnv",
     "DQN",
     "DQNConfig",
+    "Dreamer",
+    "DreamerConfig",
     "IMPALA",
     "IMPALAConfig",
     "SAC",

@@ -4,6 +4,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
@@ -24,6 +25,8 @@ __all__ = [
     "CQLConfig",
     "DQN",
     "DQNConfig",
+    "Dreamer",
+    "DreamerConfig",
     "IMPALA",
     "IMPALAConfig",
     "MARWIL",

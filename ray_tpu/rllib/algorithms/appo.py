@@ -238,12 +238,16 @@ class APPO(Algorithm):
     def get_state(self) -> Dict[str, Any]:
         return {
             "learner": self.learner_group.get_state(),
+            "connector": self.env_runner_group.connector_state(),
             "recent_returns": list(self._recent_returns),
             "iteration": self.iteration,
         }
 
     def set_state(self, state: Dict[str, Any]):
         self.learner_group.set_state(state["learner"])
+        self.env_runner_group.restore_connector_state(
+            state.get("connector")
+        )
         self._recent_returns = list(state.get("recent_returns", []))
         self.iteration = state.get("iteration", self.iteration)
         self.env_runner_group.sync_weights(

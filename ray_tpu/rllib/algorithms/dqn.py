@@ -249,6 +249,7 @@ class DQN(Algorithm):
     def get_state(self) -> Dict[str, Any]:
         return {
             "learner": self.learner_group.get_state(),
+            "connector": self.env_runner_group.connector_state(),
             "target_params": self.target_params,
             "buffer": self.buffer,
             "rng": self._rng,
@@ -258,6 +259,9 @@ class DQN(Algorithm):
 
     def set_state(self, state: Dict[str, Any]):
         self.learner_group.set_state(state["learner"])
+        self.env_runner_group.restore_connector_state(
+            state.get("connector")
+        )
         self.target_params = state["target_params"]
         if "buffer" in state:
             self.buffer = state["buffer"]

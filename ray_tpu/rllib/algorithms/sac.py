@@ -220,6 +220,7 @@ class SAC(Algorithm):
     def get_state(self) -> Dict[str, Any]:
         return {
             "learner": self.learner_group.get_state(),
+            "connector": self.env_runner_group.connector_state(),
             "target_params": self.target_params,
             "recent_returns": list(self._recent_returns),
             "iteration": self.iteration,
@@ -227,6 +228,9 @@ class SAC(Algorithm):
 
     def set_state(self, state: Dict[str, Any]):
         self.learner_group.set_state(state["learner"])
+        self.env_runner_group.restore_connector_state(
+            state.get("connector")
+        )
         self.target_params = state["target_params"]
         self._recent_returns = list(state.get("recent_returns", []))
         self.iteration = state.get("iteration", self.iteration)

@@ -42,9 +42,14 @@ class ConnectorV2:
         return rewards
 
     def get_state(self) -> Dict[str, Any]:
+        """Report-and-reset: return the state accumulated since the
+        last call (stateful connectors POP their delta here — see
+        MeanStdObsFilter) so fleet merges never double-count."""
         return {}
 
     def set_state(self, state: Dict[str, Any]) -> None:
+        """Adopt merged fleet state as the new base (must not clear
+        locally accumulated-but-unreported state)."""
         pass
 
     @staticmethod
@@ -164,23 +169,29 @@ class MeanStdObsFilter(ConnectorV2):
         return np.clip(out, -self.clip, self.clip).astype(np.float32)
 
     def get_state(self):
-        """The DELTA to contribute to the next fleet merge."""
+        """POP the delta to contribute to the next fleet merge: the
+        report itself resets local accumulation, so samples arriving
+        between this pop and the later `set_state` land in a FRESH
+        delta instead of being zeroed (async rollouts execute in that
+        window), and a lost `set_state` push can never double-report —
+        the popped samples already live in the merged base."""
         if self._delta is None:
             return {}
         c, m, m2 = self._delta
-        return {"count": c, "mean": m.copy(), "m2": m2.copy()}
+        dim = m.shape[0]
+        self._delta = (
+            0.0, np.zeros(dim, np.float64), np.zeros(dim, np.float64)
+        )
+        return {"count": c, "mean": m, "m2": m2}
 
     def set_state(self, state):
-        """Adopt merged fleet stats as the new base; the reported delta
-        is part of it now, so local accumulation restarts."""
+        """Adopt merged fleet stats as the new base.  The local delta
+        is NOT touched: it only holds samples not yet reported (see
+        get_state's pop semantics)."""
         if not state:
             return
         self._base = (
             state["count"], np.array(state["mean"]), np.array(state["m2"])
-        )
-        dim = self._base[1].shape[0]
-        self._delta = (
-            0.0, np.zeros(dim, np.float64), np.zeros(dim, np.float64)
         )
 
     @staticmethod

@@ -185,6 +185,22 @@ class EnvRunnerGroup:
             rt.wait(set_refs, num_returns=len(set_refs), timeout=30)
         return merged
 
+    def connector_state(self) -> Optional[Dict]:
+        """Fleet connector state for checkpoints (the merged base; a
+        restored policy must act on the SAME normalization it trained
+        with)."""
+        if self._connector_factory is None:
+            return None
+        return self._connector_base
+
+    def restore_connector_state(self, state: Optional[Dict]):
+        if self._connector_factory is None or not state:
+            return
+        self._connector_base = state
+        refs = [r.set_connector_state.remote(state)
+                for r in self._runners]
+        rt.wait(refs, num_returns=len(refs), timeout=30)
+
     def pop_metrics(self) -> List[Dict[str, float]]:
         metrics: List[Dict[str, float]] = []
         refs = [r.pop_metrics.remote() for r in self._runners]

@@ -75,9 +75,20 @@ def prepare_trainer(trainer):
     """
     ctx = _session.get_context()
     args = trainer.args
-    # CPU/gloo image: HF must not probe for CUDA
+    # The gloo worker group is CPU; HF resolved device placement when
+    # the Trainer was CONSTRUCTED, so flipping use_cpu alone is too
+    # late — force the resolved device count to zero and move the
+    # model back, or two workers would contend for cuda:0
     if hasattr(args, "use_cpu"):
         args.use_cpu = True
+    if hasattr(args, "_n_gpu"):
+        args._n_gpu = 0
+    model = getattr(trainer, "model", None)
+    if model is not None and hasattr(model, "to"):
+        try:
+            trainer.model = model.to("cpu")
+        except Exception:
+            pass
     # HF reads the torch.distributed env set up by our backend; make
     # sure per-worker output dirs don't collide — neither across ranks
     # on shared filesystems nor across concurrent runs on one machine

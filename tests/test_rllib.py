@@ -706,10 +706,9 @@ def test_mean_std_filter_normalizes_and_merges():
     merged = MeanStdObsFilter.merge_states([a.get_state(), b.get_state()])
     whole = MeanStdObsFilter()
     whole.on_observations(data)
-    np.testing.assert_allclose(merged["mean"], whole.get_state()["mean"],
-                               rtol=1e-10)
-    np.testing.assert_allclose(merged["m2"], whole.get_state()["m2"],
-                               rtol=1e-8)
+    w = whole.get_state()  # get_state POPS: capture once
+    np.testing.assert_allclose(merged["mean"], w["mean"], rtol=1e-10)
+    np.testing.assert_allclose(merged["m2"], w["m2"], rtol=1e-8)
     assert merged["count"] == 2000
 
 
@@ -788,3 +787,134 @@ def test_mean_std_filter_delta_protocol_no_double_count():
     w = whole.get_state()
     np.testing.assert_allclose(base["mean"], w["mean"], rtol=1e-10)
     np.testing.assert_allclose(base["m2"], w["m2"], rtol=1e-8)
+
+
+# ----------------------------------------------------------------------
+# DreamerV3 (compact) — reference: rllib/algorithms/dreamerv3/
+# ----------------------------------------------------------------------
+def test_dreamer_symlog_roundtrip():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamer import symexp, symlog
+
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 1000.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_dreamer_lambda_returns_match_bruteforce():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamer import lambda_returns
+
+    rng = np.random.default_rng(0)
+    H, N = 6, 3
+    rewards = rng.normal(size=(H, N)).astype(np.float32)
+    conts = rng.uniform(0.5, 1.0, size=(H, N)).astype(np.float32)
+    values = rng.normal(size=(H, N)).astype(np.float32)
+    last = rng.normal(size=N).astype(np.float32)
+    gamma, lam = 0.9, 0.8
+    out = np.asarray(lambda_returns(
+        jnp.asarray(rewards), jnp.asarray(conts), jnp.asarray(values),
+        jnp.asarray(last), gamma, lam,
+    ))
+    # brute force, per env
+    v_next = np.concatenate([values[1:], last[None]], axis=0)
+    expect = np.zeros((H, N), np.float32)
+    nxt = last
+    for t in range(H - 1, -1, -1):
+        expect[t] = rewards[t] + gamma * conts[t] * (
+            (1 - lam) * v_next[t] + lam * nxt
+        )
+        nxt = expect[t]
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_dreamer_world_model_learns_dynamics():
+    """The RSSM world model fits a simple deterministic dynamic: loss
+    components all drop substantially with training."""
+    import jax
+
+    from ray_tpu.rllib.algorithms.dreamer import (
+        DreamerConfig, DreamerModel,
+    )
+    import optax
+
+    cfg = DreamerConfig()
+    cfg.deter_size, cfg.stoch_groups, cfg.stoch_classes = 32, 4, 4
+    cfg.embed_hidden = cfg.head_hidden = (32,)
+    model = DreamerModel(cfg, obs_dim=3, num_actions=2)
+    params = model.init_params(jax.random.PRNGKey(0))
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        # x' = 0.9x + 0.2*(2a-1); reward = -|x0|
+        L, B = 8, 16
+        obs = np.zeros((L, B, 3), np.float32)
+        acts = rng.integers(0, 2, (L, B)).astype(np.int32)
+        x = rng.normal(size=(B, 3)).astype(np.float32)
+        for t in range(L):
+            obs[t] = x
+            x = 0.9 * x + 0.2 * (2 * acts[t, :, None] - 1)
+        return {
+            "obs": obs,
+            "prev_actions": np.concatenate(
+                [np.zeros((1, B), np.int32), acts[:-1]], axis=0),
+            "rewards": -np.abs(obs[..., 0]),
+            "terminated": np.zeros((L, B), bool),
+        }
+
+    @jax.jit
+    def step(params, opt_state, key, batch):
+        (loss, (metrics, _hs, _feats)), grads = jax.value_and_grad(
+            lambda p: model.world_model_loss(p, key, batch), has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (jax.tree.map(lambda p, u: p + u, params, updates),
+                opt_state, metrics)
+
+    key = jax.random.PRNGKey(1)
+    first = None
+    for i in range(60):
+        key, k = jax.random.split(key)
+        params, opt_state, m = step(params, opt_state, k, make_batch())
+        if first is None:
+            first = {k2: float(v) for k2, v in m.items()}
+    last = {k2: float(v) for k2, v in m.items()}
+    # reward/continue heads fit sharply; reconstruction is bounded by
+    # the compact discrete latent (16 categorical dims encoding 3
+    # continuous ones at t=0) so it improves more modestly
+    assert last["reward_loss"] < first["reward_loss"] * 0.5, (first, last)
+    assert last["cont_loss"] < first["cont_loss"] * 0.5, (first, last)
+    assert last["recon_loss"] < first["recon_loss"] * 0.9, (first, last)
+
+
+def test_dreamer_trains_on_cartpole(cluster):
+    """End-to-end smoke: replay fills, world-model + imagination updates
+    run, the policy syncs to runners, and metrics stay finite."""
+    from ray_tpu.rllib.algorithms.dreamer import DreamerConfig
+
+    cfg = DreamerConfig()
+    cfg.environment("CartPole-v1")
+    cfg.env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                    rollout_fragment_length=32)
+    cfg.debugging(seed=0)
+    cfg.deter_size, cfg.stoch_groups, cfg.stoch_classes = 64, 4, 4
+    cfg.embed_hidden = cfg.head_hidden = (64,)
+    cfg.num_updates_per_iter = 2
+    cfg.batch_segments = 8
+    algo = cfg.build()
+    try:
+        results = [algo.train() for _ in range(3)]
+        last = results[-1]
+        for k in ("wm_loss", "actor_loss", "critic_loss",
+                  "imagined_return_mean"):
+            assert np.isfinite(last[k]), (k, last)
+        assert last["replay_rows"] >= 3 * 4 * 32
+        # world model improves across iterations
+        assert last["wm_loss"] < results[0]["wm_loss"], results
+    finally:
+        algo.stop()
