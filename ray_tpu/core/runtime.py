@@ -272,8 +272,10 @@ class Runtime:
         # received via worker_log — tests and tooling read this; the
         # lines are also echoed to stderr (core/log_stream.py)
         self._worker_log_lines: deque = deque(maxlen=2000)
-        # pubsub: channel -> list of local subscriber queues
+        # pubsub: channel -> list of local subscriber queues; channels
+        # registered with the controller (re-sent after a reconnect)
         self._pubsub_queues: Dict[str, list] = {}
+        self._pubsub_registered: set = set()
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -314,9 +316,11 @@ class Runtime:
         self._flush_task = asyncio.ensure_future(
             self._flush_task_events_loop()
         )
+        self._controller_addr = tuple(controller_addr)
         self.controller = await rpc.connect_tcp(
             *controller_addr, handler=self._handle, name="controller"
         )
+        self.controller.on_close = self._on_controller_lost
         info = await self.noded.call(
             "register",
             {
@@ -329,6 +333,56 @@ class Runtime:
         )
         self.node_id = info["node_id"]
         self.store = ShmStore(info["shm_name"])
+
+    # -- controller reconnect (mirrors the daemon-side loop; reference:
+    # drivers reconnect to a restarted GCS at its known address and the
+    # job continues, `gcs_redis_failure_detector.h`) -------------------
+    def _on_controller_lost(self, conn):
+        if self._shutdown:
+            return
+        logger.warning("driver lost controller connection; reconnecting")
+        asyncio.ensure_future(self._reconnect_controller())
+
+    async def _reconnect_controller(self):
+        deadline = time.monotonic() + self.cfg.controller_reconnect_timeout_s
+        while time.monotonic() < deadline and not self._shutdown:
+            try:
+                conn = await rpc.connect_tcp(
+                    *self._controller_addr, handler=self._handle,
+                    name="controller",
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            conn.on_close = self._on_controller_lost
+            self.controller = conn
+            # the restarted controller marked this incarnation's jobs
+            # DEAD (drivers of the previous life are presumed gone):
+            # re-register so job status reflects the live driver —
+            # mirrors the daemon loop's register_node
+            if self.mode == "driver":
+                try:
+                    await conn.call("register_job", {
+                        "job_id": self.job_id.hex(), "pid": os.getpid(),
+                    })
+                except Exception:
+                    logger.exception("job re-registration failed")
+            # durable resubscribe: the restarted controller has no
+            # memory of this connection's pubsub registrations
+            with self._state_lock:
+                channels = list(self._pubsub_registered)
+            for channel in channels:
+                try:
+                    await conn.call("subscribe", {"channel": channel})
+                except Exception:
+                    logger.exception(
+                        "resubscribe failed for channel %r; live "
+                        "delivery on it will not resume", channel,
+                    )
+            logger.info("driver reconnected to controller")
+            return
+        if not self._shutdown:
+            logger.error("controller unreachable; driver calls will fail")
 
     @property
     def address(self) -> Tuple[str, str]:
@@ -1822,14 +1876,11 @@ class Runtime:
         q = _q.Queue()
         with self._state_lock:
             self._pubsub_queues.setdefault(channel, []).append(q)
-            registered = getattr(self, "_pubsub_registered", None)
-            if registered is None:
-                registered = self._pubsub_registered = set()
             # register with the controller AT MOST once per channel for
             # this connection's lifetime — re-registering on each local
             # watcher would have the controller deliver duplicates
-            need_rpc = channel not in registered
-            registered.add(channel)
+            need_rpc = channel not in self._pubsub_registered
+            self._pubsub_registered.add(channel)
         if need_rpc:
             self.controller_call("subscribe", {"channel": channel})
 

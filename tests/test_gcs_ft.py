@@ -198,3 +198,77 @@ def test_pg_state_survives_controller_restart(tmp_path):
     finally:
         _kill_hard(head)
         _kill_hard(worker)
+
+
+def test_driver_reconnects_and_resubscribes_after_controller_restart(tmp_path):
+    """A driver attached to a WORKER node survives a head (controller)
+    restart: controller calls work again after reconnect and pubsub
+    subscriptions are re-established on the new controller (durable
+    resubscribe) — node-death events still flow post-restart."""
+    import queue as _q
+
+    port = _free_port()
+    head_dir = str(tmp_path / "head")
+    env = {"RT_CONTROLLER_PORT": str(port)}
+    head, _ = launch_noded(head_dir, head=True, num_cpus=2, num_workers=1,
+                           env_extra=env)
+    wdir = str(tmp_path / "w1")
+    worker, _ = launch_noded(
+        wdir, controller_addr=("127.0.0.1", port), num_cpus=2,
+        num_workers=1, env_extra=env,
+    )
+    try:
+        # the driver's LOCAL daemon is the worker: it outlives the head
+        rt.init(address=os.path.join(wdir, "ready.json"))
+        from ray_tpu.core.runtime import get_runtime
+
+        r = get_runtime()
+        sub = r.subscribe("cluster_events")
+        assert len(r.controller_call("get_nodes")) >= 2
+
+        _kill_hard(head)
+        head2, _ = launch_noded(head_dir, head=True, num_cpus=2,
+                                num_workers=1, env_extra=env)
+        try:
+            # reconnect loops (driver AND worker daemon) re-register
+            deadline = time.time() + 60
+            nodes = []
+            while time.time() < deadline:
+                try:
+                    nodes = [n for n in r.controller_call("get_nodes")
+                             if n["alive"]]
+                    if len(nodes) >= 2:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert len(nodes) >= 2, "driver never reconnected"
+            # the re-established subscription sees NEW events
+            from ray_tpu.util import events as ev_mod
+
+            deadline = time.time() + 30
+            got = None
+            while time.time() < deadline and got is None:
+                ev_mod.report_event("POST_RESTART", "hello again")
+                try:
+                    while True:
+                        ev = sub.next_message(timeout=2)
+                        if ev.get("event_type") == "POST_RESTART":
+                            got = ev
+                            break
+                except _q.Empty:
+                    pass
+            assert got is not None, (
+                "subscription did not survive the controller restart"
+            )
+            # the live driver's job re-registered as RUNNING (the
+            # restarted controller had marked the old incarnation DEAD)
+            jobs = {j["job_id"]: j for j in r.controller_call("list_jobs")}
+            me = jobs.get(r.job_id.hex())
+            assert me is not None and me["status"] == "RUNNING", jobs
+            rt.shutdown()
+        finally:
+            _kill_hard(head2)
+    finally:
+        _kill_hard(head)
+        _kill_hard(worker)
