@@ -71,12 +71,22 @@ async def update_builtin_metrics(ctl):
     g = _gauge("rt_serve_replicas", "serve replicas",
                ("app", "deployment", "kind"))
     g.clear()  # deleted apps/deployments must not export stale series
+    req = _gauge("rt_serve_requests_total",
+                 "completed serve requests (monotonic)",
+                 ("app", "deployment"))
+    lat = _gauge("rt_serve_latency_seconds_sum",
+                 "summed serve request latency (monotonic)",
+                 ("app", "deployment"))
+    req.clear()
+    lat.clear()
     for app, deployments in (status or {}).items():
         for dep, info in deployments.items():
             tags = {"app": app, "deployment": dep}
             g.set(float(info.get("running", 0)), {**tags, "kind": "running"})
             g.set(float(info.get("target_replicas", 0)),
                   {**tags, "kind": "target"})
+            req.set(float(info.get("completed", 0.0)), tags)
+            lat.set(float(info.get("latency_sum_s", 0.0)), tags)
 
 
 # -- dashboard generation -----------------------------------------------
@@ -111,6 +121,14 @@ DEFAULT_PANELS: List[Panel] = [
                           "{{app}}/{{deployment}} target")],
           description="running < target sustained = replicas failing "
                       "to start"),
+    Panel("Serve request rate", unit="reqps",
+          targets=[Target("rate(rt_serve_requests_total[1m])",
+                          "{{app}}/{{deployment}}")]),
+    Panel("Serve mean latency", unit="s",
+          targets=[Target(
+              "rate(rt_serve_latency_seconds_sum[5m]) / "
+              "rate(rt_serve_requests_total[5m])",
+              "{{app}}/{{deployment}}")]),
 ]
 
 

@@ -48,6 +48,13 @@ class _DeploymentState:
 
     def __init__(self, app_name: str, name: str, callable_def, init_args,
                  init_kwargs, config: DeploymentConfig, resources: Dict[str, float]):
+        import uuid as _uuid
+
+        # identity of THIS deploy of this deployment name: request
+        # counters are keyed by it so a surviving client router's
+        # lifetime-cumulative stats can never credit a redeployed app
+        # with the previous incarnation's traffic
+        self.incarnation = _uuid.uuid4().hex[:12]
         self.app_name = app_name
         self.name = name
         self.callable_def = callable_def
@@ -66,6 +73,7 @@ class _DeploymentState:
     def routing_table(self) -> Dict[str, Any]:
         return {
             "version": self.version,
+            "incarnation": self.incarnation,
             "replicas": {
                 r.replica_id: (r.handle, r.max_ongoing)
                 for r in self.replicas.values()
@@ -88,6 +96,10 @@ class ServeController:
         self._routes: Dict[str, str] = {}  # route prefix -> app name
         # pushed handle metrics: (app, dep) -> router_id -> (ts, {rid: n})
         self._handle_metrics: Dict[tuple, Dict[str, tuple]] = {}
+        # cumulative request stats: per-router last report + per-
+        # deployment monotonic totals (delta-folded)
+        self._router_stats: Dict[tuple, Dict[str, Dict[str, float]]] = {}
+        self._deployment_stats: Dict[tuple, Dict[str, float]] = {}
         self._stop = threading.Event()
         self._recover()
         self._thread = threading.Thread(
@@ -117,6 +129,7 @@ class ServeController:
                         "resources": dict(ds.resources),
                         "target_replicas": ds.target_replicas,
                         "version": ds.version,
+                        "incarnation": ds.incarnation,
                         "next_replica_idx": ds.next_replica_idx,
                         "replica_ids": list(ds.replicas),
                     }
@@ -127,6 +140,17 @@ class ServeController:
                 "ingress": dict(self._ingress),
                 "ingress_streaming": dict(self._ingress_streaming),
                 "routes": dict(self._routes),
+                # monotonic request totals survive a controller crash:
+                # a reset would make Prometheus rate() see a counter
+                # reset + a spurious re-report spike
+                "deployment_stats": {
+                    f"{k[0]}::{k[1]}": dict(v)
+                    for k, v in self._deployment_stats.items()
+                },
+                "router_stats": {
+                    f"{k[0]}::{k[1]}": dict(v)
+                    for k, v in self._router_stats.items()
+                },
             }
         return cloudpickle.dumps(state)
 
@@ -174,6 +198,9 @@ class ServeController:
                         # table); the STARTING->RUNNING promotion bumps
                         # the version and triggers the refetch
                         ds.version = d["version"]
+                        ds.incarnation = d.get(
+                            "incarnation", ds.incarnation
+                        )
                         ds.next_replica_idx = d["next_replica_idx"]
                         for rid in d["replica_ids"]:
                             try:
@@ -193,6 +220,13 @@ class ServeController:
                     state.get("ingress_streaming", {})
                 )
                 self._routes = dict(state.get("routes", {}))
+                for attr, key in (("_deployment_stats", "deployment_stats"),
+                                  ("_router_stats", "router_stats")):
+                    loaded = {}
+                    for flat, v in state.get(key, {}).items():
+                        app, _, dep = flat.partition("::")
+                        loaded[(app, dep)] = v
+                    setattr(self, attr, loaded)
         except Exception:
             # a poisoned/old-schema snapshot must not crash-loop the
             # controller through its (effectively infinite) restarts:
@@ -238,6 +272,10 @@ class ServeController:
             for key in [k for k in self._handle_metrics
                         if k[0] == app_name and k[1] not in deployments]:
                 del self._handle_metrics[key]
+            for store in (self._router_stats, self._deployment_stats):
+                for key in [k for k in store
+                            if k[0] == app_name and k[1] not in deployments]:
+                    del store[key]
             self._ingress[app_name] = app_config.get(
                 "ingress", app_config["deployments"][-1]["name"]
             )
@@ -263,6 +301,9 @@ class ServeController:
             self._routes = {k: v for k, v in self._routes.items() if v != app_name}
             for key in [k for k in self._handle_metrics if k[0] == app_name]:
                 del self._handle_metrics[key]
+            for store in (self._router_stats, self._deployment_stats):
+                for key in [k for k in store if k[0] == app_name]:
+                    del store[key]
             victims: List[tuple] = []
             for ds in deployments.values():
                 ds.deleted = True  # reconcile snapshots may still hold ds
@@ -286,15 +327,45 @@ class ServeController:
     # -- routing ------------------------------------------------------
     def get_routing_table(self, app_name: str, deployment_name: str,
                           router_id: Optional[str] = None,
-                          handle_metrics: Optional[Dict[str, int]] = None):
+                          handle_metrics: Optional[Dict[str, int]] = None,
+                          handle_stats: Optional[Dict[str, float]] = None):
         """Routers poll this; they piggyback their per-replica in-flight
-        counts (reference: handles PUSH metrics to the controller,
-        `autoscaling_state.py` — one RPC serves both directions instead
-        of the controller fanning out per-replica metric polls)."""
+        counts and cumulative request stats (reference: handles PUSH
+        metrics to the controller, `autoscaling_state.py` — one RPC
+        serves both directions instead of the controller fanning out
+        per-replica metric polls)."""
         with self._lock:
             ds = self._apps.get(app_name, {}).get(deployment_name)
             if ds is None:
                 return {"version": -1, "replicas": {}}
+            if (
+                router_id is not None
+                and handle_stats is not None
+                and handle_stats.get("incarnation") == ds.incarnation
+            ):
+                # routers report CUMULATIVE counters; the controller
+                # folds per-router deltas into monotonic deployment
+                # totals so router restarts never decrease the series.
+                # Reports against a different incarnation (stale router
+                # across a delete+redeploy) are ignored entirely.
+                now_mono = time.monotonic()
+                key = (app_name, deployment_name)
+                last = self._router_stats.setdefault(key, {})
+                prev = last.get(router_id, (0.0, {"completed": 0.0,
+                                                  "latency_sum_s": 0.0}))[1]
+                totals = self._deployment_stats.setdefault(
+                    key, {"completed": 0.0, "latency_sum_s": 0.0}
+                )
+                for field_ in ("completed", "latency_sum_s"):
+                    delta = handle_stats.get(field_, 0.0) - prev[field_]
+                    if delta > 0:
+                        totals[field_] += delta
+                last[router_id] = (now_mono, dict(handle_stats))
+                # dead routers leave permanent per-process entries
+                # otherwise (ids are unique per process)
+                for rid_, (ts_, _st) in list(last.items()):
+                    if now_mono - ts_ > 600.0:
+                        del last[rid_]
             if router_id is not None and handle_metrics is not None:
                 now = time.monotonic()
                 per_router = self._handle_metrics.setdefault(
@@ -341,6 +412,10 @@ class ServeController:
                             1 for r in ds.replicas.values() if r.state == RUNNING
                         ),
                         "version": ds.version,
+                        **self._deployment_stats.get(
+                            (app_name, name),
+                            {"completed": 0.0, "latency_sum_s": 0.0},
+                        ),
                     }
                     for name, ds in deployments.items()
                 }

@@ -51,10 +51,26 @@ class Router:
         import uuid as _uuid
 
         self._router_id = f"{_os.getpid()}-{_uuid.uuid4().hex[:6]}"
+        # cumulative request accounting, pushed to the controller with
+        # the in-flight piggyback (reference: handles push autoscaling
+        # AND observability metrics, `serve/_private/router.py` metrics
+        # pusher) — drives the rt_serve_* Prometheus series
+        self._completed_total = 0
+        self._latency_sum_s = 0.0
+        self._stats_push_pending = False
+        self._incarnation = None  # deployment identity from the table
 
     # -- routing table maintenance ------------------------------------
     def _install_table(self, table):
         with self._lock:
+            incarnation = table.get("incarnation")
+            if incarnation != self._incarnation:
+                # a redeploy under the same name: lifetime counters
+                # belong to the PREVIOUS incarnation and must not fold
+                # into the fresh deployment's totals
+                self._incarnation = incarnation
+                self._completed_total = 0
+                self._latency_sum_s = 0.0
             if table["version"] != self._version:
                 # surviving replicas keep their _ReplicaInfo identity:
                 # completion callbacks hold references to these objects,
@@ -86,6 +102,14 @@ class Router:
                 rid: r.local_inflight for rid, r in self._replicas.items()
             }
 
+    def _handle_stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "completed": self._completed_total,
+                "latency_sum_s": self._latency_sum_s,
+                "incarnation": self._incarnation,
+            }
+
     def _refresh(self, force: bool = False):
         if not self._needs_refresh(force):
             return
@@ -98,6 +122,7 @@ class Router:
                     self._app, self._deployment,
                     router_id=self._router_id,
                     handle_metrics=self._handle_metrics(),
+                    handle_stats=self._handle_stats(),
                 ),
                 timeout=10,
             )
@@ -113,6 +138,17 @@ class Router:
             raise
         self._install_table(table)
 
+    async def _deferred_stats_push(self):
+        """Trailing-edge stats delivery: ride the normal refresh (which
+        also installs the fetched table) after the burst settles."""
+        await asyncio.sleep(1.1)
+        with self._lock:
+            self._stats_push_pending = False
+        try:
+            await self._refresh_async(force=True)
+        except Exception:
+            pass  # stats are advisory; the next refresh re-reports
+
     async def _refresh_async(self, force: bool = False):
         if not self._needs_refresh(force):
             return
@@ -125,6 +161,7 @@ class Router:
                 self._app, self._deployment,
                 router_id=self._router_id,
                 handle_metrics=self._handle_metrics(),
+                handle_stats=self._handle_stats(),
             )
             # bounded like the sync path: calls to a RESTARTING actor
             # queue until it comes back, which could be a long outage
@@ -179,9 +216,22 @@ class Router:
         else:
             out = info.handle.handle_request.remote(method_name, *args, **kwargs)
 
+        t0 = time.monotonic()
+
         def _done():
+            now = time.monotonic()
             with self._lock:
                 info.local_inflight = max(0, info.local_inflight - 1)
+                self._completed_total += 1
+                self._latency_sum_s += now - t0
+                # steady traffic delivers stats via the 0.25s refresh
+                # piggyback; a burst's FINAL completions need this
+                # trailing-edge push or they never reach the controller
+                deferred = not self._stats_push_pending
+                if deferred:
+                    self._stats_push_pending = True
+            if deferred:
+                asyncio.ensure_future(self._deferred_stats_push())
 
         # capacity frees when the replica replies, not when the caller
         # resolves the response (reference: the router decrements its

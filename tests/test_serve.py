@@ -617,3 +617,73 @@ def test_schema_overrides_applied_e2e(serve_instance, tmp_path):
     finally:
         serve.delete("schemaapp")
         sys.modules.pop("schema_app_mod", None)
+
+
+def test_request_stats_flow_to_status(serve_instance):
+    """Router-piggybacked cumulative request stats fold into monotonic
+    per-deployment totals the status (and the Prometheus series) read
+    (reference: handle metrics pusher feeding serve observability)."""
+    @serve.deployment
+    class Stats:
+        def __call__(self, request):
+            return "ok"
+
+    serve.run(Stats.bind(), name="statsapp", route_prefix="/stats")
+    try:
+        host, port = serve.http_address()
+        for _ in range(5):
+            _http_get(f"http://{host}:{port}/stats")
+        deadline = time.time() + 15
+        completed = 0
+        while time.time() < deadline:
+            info = serve.status()["statsapp"]["Stats"]
+            completed = info.get("completed", 0)
+            if completed >= 5:
+                break
+            time.sleep(0.3)
+        assert completed >= 5, info
+        assert info["latency_sum_s"] > 0
+        # monotonic: more traffic only increases it
+        for _ in range(3):
+            _http_get(f"http://{host}:{port}/stats")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            info2 = serve.status()["statsapp"]["Stats"]
+            if info2.get("completed", 0) >= completed + 3:
+                break
+            time.sleep(0.3)
+        assert info2["completed"] >= completed + 3
+    finally:
+        serve.delete("statsapp")
+
+
+def test_request_stats_reset_on_redeploy(serve_instance):
+    """A surviving handle's lifetime counters must not credit a
+    redeployed app with the previous incarnation's traffic."""
+    @serve.deployment
+    class V:
+        def __call__(self, _x=None):
+            return "v"
+
+    h = serve.run(V.bind(), name="redep", route_prefix="/redep")
+    for _ in range(4):
+        h.remote().result(timeout_s=10)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if serve.status()["redep"]["V"].get("completed", 0) >= 4:
+            break
+        time.sleep(0.3)
+    assert serve.status()["redep"]["V"]["completed"] >= 4
+
+    # redeploy the SAME app/deployment names
+    h2 = serve.run(V.bind(), name="redep", route_prefix="/redep")
+    h2.remote().result(timeout_s=10)
+    deadline = time.time() + 15
+    completed = None
+    while time.time() < deadline:
+        completed = serve.status()["redep"]["V"].get("completed", 0)
+        if completed >= 1:
+            break
+        time.sleep(0.3)
+    # fresh incarnation: counts start over (NOT >= 5 from old traffic)
+    assert 1 <= completed < 4, completed
