@@ -95,6 +95,7 @@ class GcpTpuNodeProvider(NodeProvider):
         self.network = network
         self._transport = transport or default_transport
         self._parent = f"projects/{project}/locations/{zone}"
+        self._node_states: Dict[str, str] = {}  # id -> last-seen state
 
     # -- REST helpers --------------------------------------------------
     def _url(self, path: str) -> str:
@@ -111,11 +112,23 @@ class GcpTpuNodeProvider(NodeProvider):
             "labels": {
                 "rt-cluster": self.cluster_name,
                 "rt-node-type": node_config.get("node_type", "worker"),
+                # only the autoscaler-generated keys (values are safe
+                # lowercase [a-z0-9-]) ride the TPU API labels — GCP
+                # rejects arbitrary user label keys/values, and the busy
+                # fold reads labels from noded registration (fed by the
+                # rt-labels metadata below), not from here
+                **{k: str(v)
+                   for k, v in node_config.get("labels", {}).items()
+                   if k in ("rt-launch", "tpu-slice")},
             },
             "metadata": {
                 "startup-script": node_config.get(
                     "startup_script", self.startup_script
                 ),
+                # the default worker_startup_script reads this off the
+                # metadata server and hands it to noded via --labels so
+                # runtime registration carries the same identity
+                "rt-labels": json.dumps(node_config.get("labels", {})),
             },
         }
         if self.network:
@@ -178,12 +191,19 @@ class GcpTpuNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[str]:
         return [n["id"] for n in self.list_cluster_nodes()]
 
+    def node_is_ready(self, provider_id: str) -> bool:
+        # states cached by the list_cluster_nodes() the reconcile tick
+        # just made — no extra API call per node
+        return self._node_states.get(provider_id) == "READY"
+
     def list_cluster_nodes(self) -> List[Dict[str, Any]]:
         """Live cluster members from ONE list call: id, type label, and
         per-host resources (avoids the 1+N listing pattern a per-node
         `node_resources` loop would produce)."""
         out = []
+        states: Dict[str, str] = {}
         for n in self._list():
+            states[n["name"].rsplit("/", 1)[-1]] = n.get("state", "")
             if n.get("state") not in _LIVE_STATES:
                 continue
             at = n.get("acceleratorType", self.accelerator_type)
@@ -195,6 +215,7 @@ class GcpTpuNodeProvider(NodeProvider):
                     "TPU": float(chips_for_accelerator_type(at))
                 },
             })
+        self._node_states = states
         return out
 
     def node_resources(self, provider_id: str) -> Dict[str, float]:
@@ -216,8 +237,18 @@ def worker_startup_script(controller_host: str, controller_port: int,
         "set -e",
         f"python3 -m pip install -q {pip_package} || true",
         "mkdir -p /tmp/ray_tpu/node",
+        # node identity labels (rt-launch, tpu-slice) stamped by the
+        # autoscaler into instance metadata; forwarding them to noded
+        # lets the busy fold and STRICT_PACK placement see this node
+        # -f: a 404 (attribute absent) must exit non-zero so the '{}'
+        # fallback engages instead of capturing the error body
+        "RT_LABELS=$(curl -sf -H 'Metadata-Flavor: Google' "
+        "'http://metadata.google.internal/computeMetadata/v1/instance/"
+        "attributes/rt-labels' || echo '{}')",
+        '[ -n "$RT_LABELS" ] || RT_LABELS=\'{}\'',
         "nohup python3 -m ray_tpu.core.noded "
         "--session-dir /tmp/ray_tpu/node "
         f"--controller {controller_host}:{controller_port}{nw} "
+        '--labels "$RT_LABELS" '
         ">> /tmp/ray_tpu/node/noded.out 2>&1 &",
     ])

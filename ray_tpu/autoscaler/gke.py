@@ -73,6 +73,7 @@ class GkeNodeProvider(NodeProvider):
         self.tpu_accelerator = tpu_accelerator
         self.tpu_topology = tpu_topology
         self._transport = transport or default_transport
+        self._pod_phases: Dict[str, str] = {}  # pod name -> last phase
 
     # -- pod construction ---------------------------------------------
     def _pods_url(self, name: str = "") -> str:
@@ -150,11 +151,20 @@ class GkeNodeProvider(NodeProvider):
             None,
         )
         out = []
+        phases: Dict[str, str] = {}
         for item in reply.get("items", []):
             phase = item.get("status", {}).get("phase", "Pending")
+            phases[item["metadata"]["name"]] = phase
             if phase in ("Pending", "Running"):
                 out.append(item["metadata"]["name"])
+        self._pod_phases = phases
         return out
+
+    def node_is_ready(self, provider_id: str) -> bool:
+        # phases cached by the non_terminated_nodes() call the reconcile
+        # tick just made — a Pending pod is NOT ready, so the autoscaler
+        # keeps it REQUESTED (spare inbound capacity + reapable)
+        return self._pod_phases.get(provider_id) == "Running"
 
     def node_resources(self, provider_id: str) -> Dict[str, float]:
         reply = self._transport("GET", self._pods_url(provider_id), None)

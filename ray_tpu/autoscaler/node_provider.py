@@ -47,6 +47,16 @@ class NodeProvider:
     def non_terminated_nodes(self) -> List[str]:
         raise NotImplementedError
 
+    def node_is_ready(self, provider_id: str) -> bool:
+        """True when the node has actually booted (GCP TPU state READY,
+        GKE pod phase Running).  The v2 autoscaler gates REQUESTED ->
+        RUNNING promotion on this for providers that cannot map provider
+        ids to runtime nodes — without it a Pending pod/VM would be
+        promoted on sight, disabling the slice ready-timeout reaper and
+        double-launching slices while one is still booting.  Default
+        True: providers whose listing already implies liveness."""
+        return True
+
     def node_resources(self, provider_id: str) -> Dict[str, float]:
         raise NotImplementedError
 

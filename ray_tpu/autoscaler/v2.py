@@ -59,6 +59,11 @@ class Instance:
     provider_id: Optional[str] = None
     runtime_node_id: Optional[str] = None  # controller's node id
     slice_id: Optional[str] = None  # set for every host of a gang slice
+    # label stamped into node_config at launch; runtime nodes booted by
+    # the provider carry it back through noded registration, letting
+    # busy state be folded onto instances for providers that cannot map
+    # provider ids to runtime node ids
+    launch_id: Optional[str] = None
     # hosts this instance represents: 1 for per-host providers; N when
     # the provider allocates a whole N-host slice as ONE provider node
     # (GCP multi-host TPU VM)
@@ -136,7 +141,12 @@ class AutoscalerV2Config:
     idle_timeout_s: float = 30.0
     # a REQUESTED slice whose hosts have not all registered by then is
     # rolled back whole
-    slice_ready_timeout_s: float = 120.0
+    # generous default: promotion is gated on REAL readiness (GCP state
+    # READY / GKE pod Running), and cloud provisioning routinely takes
+    # minutes (TPU-VM CREATE 2-5 min, GKE TPU node-pool scale-up up to
+    # ~10) — a tighter timeout would reap+relaunch healthy boots in an
+    # endless churn loop
+    slice_ready_timeout_s: float = 900.0
 
 
 @dataclass
@@ -344,6 +354,22 @@ class AutoscalerV2:
             n["node_id"]: n for n in state.get("nodes", []) if n["alive"]
         }
         rt_id = getattr(self.provider, "runtime_node_id", None)
+        # providers without an id mapping fold busy state via the
+        # rt-launch label each booted node registered with; a busy
+        # worker that carries NO launch label (e.g. a TPU-VM bootstrap
+        # that predates labels) conservatively refreshes every cloud
+        # instance — slower scale-down beats terminating a busy slice
+        busy_launches: set = set()
+        unlabeled_busy = False
+        if rt_id is None:
+            for n in alive_nodes.values():
+                if not n.get("busy") or n.get("is_head"):
+                    continue
+                lid = (n.get("labels") or {}).get("rt-launch")
+                if lid:
+                    busy_launches.add(lid)
+                else:
+                    unlabeled_busy = True
         for inst in self.im.instances(REQUESTED, RUNNING, TERMINATING):
             if inst.provider_id not in live_provider:
                 self.im.update_status(inst.instance_id, TERMINATED)
@@ -358,11 +384,20 @@ class AutoscalerV2:
                 self.im.update_status(inst.instance_id, RUNNING)
             elif inst.status == REQUESTED and rt_id is None:
                 # provider cannot map its ids to runtime nodes (cloud
-                # slices boot daemons via startup script): provider
-                # liveness is the promotion signal, so a healthy slice
-                # is not reaped at the ready timeout
-                self.im.update_status(inst.instance_id, RUNNING)
+                # slices boot daemons via startup script): REAL readiness
+                # (GCP state READY / GKE pod phase Running) is the
+                # promotion signal — a merely-listed Pending pod/VM must
+                # stay REQUESTED so it keeps absorbing its gang as
+                # inbound capacity and stays reapable at the ready
+                # timeout instead of triggering a duplicate slice launch
+                # every reconcile tick
+                if self.provider.node_is_ready(inst.provider_id):
+                    self.im.update_status(inst.instance_id, RUNNING)
             if node is not None and node.get("busy"):
+                inst.last_busy_at = now
+            elif rt_id is None and (
+                inst.launch_id in busy_launches or unlabeled_busy
+            ):
                 inst.last_busy_at = now
         # demand pending means nothing should look idle (matches v1)
         if state.get("pending_demands") or state.get("pending_gangs"):
@@ -377,16 +412,23 @@ class AutoscalerV2:
         slice_id = (
             f"slice-{uuid.uuid4().hex[:8]}" if launch.hosts > 1 else None
         )
+        launch_id = slice_id or f"launch-{uuid.uuid4().hex[:8]}"
         node_config = {
             "num_cpus": cfg.num_cpus,
             "resources": dict(cfg.resources),
             "num_workers": cfg.num_workers,
             **cfg.provider_config,
         }
+        # the launch label rides node_config -> provider -> noded
+        # registration so _sync_provider can fold busy state back onto
+        # these instances even without a provider id mapping
+        node_config["labels"] = {
+            **node_config.get("labels", {}), "rt-launch": launch_id,
+        }
         if slice_id is not None:
             # every host of the slice shares one ICI-domain label so
             # STRICT_PACK placement sees them as a gang target
-            node_config["labels"] = {"tpu-slice": slice_id}
+            node_config["labels"]["tpu-slice"] = slice_id
         try:
             pids = self.provider.create_slice(node_config, launch.hosts)
         except Exception:
@@ -405,6 +447,7 @@ class AutoscalerV2:
                 status=QUEUED,
                 provider_id=pid,
                 slice_id=slice_id,
+                launch_id=launch_id,
                 hosts=hosts_each,
                 requested_at=now,
                 last_busy_at=now,
@@ -414,11 +457,18 @@ class AutoscalerV2:
 
     def _reap_stuck_slices(self, now: float):
         """A slice partially registered past the ready timeout is torn
-        down WHOLE — half a slice can never serve its gang demand."""
+        down WHOLE — half a slice can never serve its gang demand.
+        Non-slice nodes stuck REQUESTED (a Pending pod that never
+        schedules) age out the same way, singly: without this they'd
+        absorb their demand as inbound capacity forever."""
         by_slice: Dict[str, List[Instance]] = {}
         for inst in self.im.instances(REQUESTED, RUNNING):
             if inst.slice_id is not None:
                 by_slice.setdefault(inst.slice_id, []).append(inst)
+            elif (inst.status == REQUESTED
+                  and now - inst.requested_at
+                  > self.config.slice_ready_timeout_s):
+                self._terminate([inst.instance_id])
         for members in by_slice.values():
             waiting = [m for m in members if m.status == REQUESTED]
             if not waiting:

@@ -260,6 +260,12 @@ class Controller:
         subs[:] = [c for c in subs if not c.closed]
         return {"ok": True}
 
+    async def handle_unsubscribe(self, payload, conn):
+        subs = self._subscribers.get(payload["channel"], [])
+        if conn in subs:
+            subs.remove(conn)
+        return {"ok": True}
+
     # ---- nodes -------------------------------------------------------
     async def handle_register_node(self, payload, conn):
         node = NodeInfo(
@@ -780,6 +786,7 @@ class Controller:
                     "resources": n.resources,
                     "alive": n.alive,
                     "is_head": n.is_head,
+                    "labels": dict(getattr(n, "labels", {}) or {}),
                     "busy": bool(
                         getattr(n, "load", None)
                         and n.load.get("busy")

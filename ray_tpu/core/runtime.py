@@ -276,6 +276,13 @@ class Runtime:
         # registered with the controller (re-sent after a reconnect)
         self._pubsub_queues: Dict[str, list] = {}
         self._pubsub_registered: set = set()
+        # channels whose last (un)subscribe RPC outcome is unknown
+        # (timeout / cancelled mid-RPC); resolved by the reconciler
+        self._pubsub_uncertain: set = set()
+        # single-writer reconciler serializes all (un)subscribe RPCs on
+        # the io loop (see _pubsub_reconcile); binds to the loop on
+        # first acquisition
+        self._pubsub_async_lock = asyncio.Lock()
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -368,17 +375,16 @@ class Runtime:
                 except Exception:
                     logger.exception("job re-registration failed")
             # durable resubscribe: the restarted controller has no
-            # memory of this connection's pubsub registrations
+            # memory of this connection's pubsub registrations — reset
+            # the registered view and let the reconciler re-drive it
+            # from desired state (serialized with any concurrent
+            # subscribe/close, so a just-closed channel can't be
+            # resurrected here)
             with self._state_lock:
-                channels = list(self._pubsub_registered)
-            for channel in channels:
-                try:
-                    await conn.call("subscribe", {"channel": channel})
-                except Exception:
-                    logger.exception(
-                        "resubscribe failed for channel %r; live "
-                        "delivery on it will not resume", channel,
-                    )
+                self._pubsub_registered.clear()
+                self._pubsub_uncertain.clear()
+            task = asyncio.ensure_future(self._pubsub_reconcile())
+            task.add_done_callback(lambda t: t.cancelled() or t.exception())
             logger.info("driver reconnected to controller")
             return
         if not self._shutdown:
@@ -1876,13 +1882,40 @@ class Runtime:
         q = _q.Queue()
         with self._state_lock:
             self._pubsub_queues.setdefault(channel, []).append(q)
-            # register with the controller AT MOST once per channel for
-            # this connection's lifetime — re-registering on each local
-            # watcher would have the controller deliver duplicates
-            need_rpc = channel not in self._pubsub_registered
-            self._pubsub_registered.add(channel)
-        if need_rpc:
-            self.controller_call("subscribe", {"channel": channel})
+        cancelled = None
+        try:
+            self._run(self._pubsub_reconcile(), timeout=30)
+        except asyncio.CancelledError as e:
+            # loop shutdown racing this subscribe: still run the
+            # cleanup below (the queue must not stay 'desired'), then
+            # surface the cancellation
+            cancelled = e
+        except Exception:
+            pass  # judged below by whether registration actually landed
+        with self._state_lock:
+            registered = (
+                cancelled is None and channel in self._pubsub_registered
+            )
+        if not registered:
+            with self._state_lock:
+                lst = self._pubsub_queues.get(channel, [])
+                if q in lst:
+                    lst.remove(q)
+                if not lst:
+                    self._pubsub_queues.pop(channel, None)
+            # the RPC may have landed despite the failure (uncertain):
+            # a follow-up reconcile unsubscribes anything undesired
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self._pubsub_reconcile(), self.loop
+                )
+            except Exception:
+                pass
+            if cancelled is not None:
+                raise cancelled
+            raise RuntimeError(
+                f"pubsub subscribe failed for channel {channel!r}"
+            )
 
         class _Subscription:
             def __init__(self, runtime):
@@ -1896,8 +1929,102 @@ class Runtime:
                     lst = self._rt._pubsub_queues.get(channel, [])
                     if q in lst:
                         lst.remove(q)
+                    if not lst:
+                        # last local watcher gone: desired state no
+                        # longer includes the channel; the reconciler
+                        # unregisters it at the controller
+                        self._rt._pubsub_queues.pop(channel, None)
+                # fire-and-forget: close() must not block on a wedged
+                # controller, and the reconciler serializes against any
+                # concurrent subscribe()
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._rt._pubsub_reconcile(), self._rt.loop
+                    )
+                except Exception:
+                    pass
 
         return _Subscription(self)
+
+    async def _pubsub_reconcile(self) -> bool:
+        """Single-writer pubsub registration reconciler: drives the
+        controller-side registration set toward the desired state
+        (channels with live local queues).  Every (un)subscribe RPC in
+        the process flows through here, serialized by one asyncio lock
+        on the io loop — so a close()'s trailing unsubscribe can never
+        sever a concurrent subscribe(), and the reconnect path's durable
+        resubscribe can't resurrect a channel whose last watcher closed
+        (reference: `GcsSubscriber` keeps one registration per channel
+        per connection).
+
+        A channel whose RPC outcome is unknown (timeout, or this task
+        cancelled mid-RPC — the frame may already be at the controller)
+        goes into `_pubsub_uncertain`; the next pass resolves it by
+        re-subscribing (idempotent at the controller) when desired or
+        unsubscribing (harmless no-op) when not, so a cancelled
+        subscribe() can't leave an orphan server-side registration
+        pushing into a queueless connection forever.  Failures are
+        per-channel: one bad channel never blocks the others.  Returns
+        False if any subscribe RPC failed this pass."""
+        async with self._pubsub_async_lock:
+            failed: set = set()
+            while True:
+                with self._state_lock:
+                    desired = set(self._pubsub_queues)
+                    registered = set(self._pubsub_registered)
+                    uncertain = set(self._pubsub_uncertain)
+                to_add = desired - registered - failed
+                to_del = (registered | uncertain) - desired - failed
+                if not to_add and not to_del:
+                    return not failed
+                for ch in sorted(to_add):
+                    try:
+                        await asyncio.wait_for(
+                            self.controller.call(
+                                "subscribe", {"channel": ch}
+                            ),
+                            10,
+                        )
+                    except asyncio.CancelledError:
+                        with self._state_lock:
+                            self._pubsub_uncertain.add(ch)
+                        raise
+                    except Exception:
+                        logger.warning(
+                            "pubsub subscribe RPC failed for %r", ch,
+                            exc_info=True,
+                        )
+                        with self._state_lock:
+                            self._pubsub_uncertain.add(ch)
+                        failed.add(ch)
+                        continue
+                    with self._state_lock:
+                        self._pubsub_registered.add(ch)
+                        self._pubsub_uncertain.discard(ch)
+                for ch in sorted(to_del):
+                    # deregister locally FIRST: if a subscribe() lands
+                    # mid-RPC the next loop pass re-subscribes, and the
+                    # same-connection RPC ordering keeps it after this
+                    with self._state_lock:
+                        self._pubsub_registered.discard(ch)
+                    try:
+                        await asyncio.wait_for(
+                            self.controller.call(
+                                "unsubscribe", {"channel": ch}
+                            ),
+                            10,
+                        )
+                    except asyncio.CancelledError:
+                        with self._state_lock:
+                            self._pubsub_uncertain.add(ch)
+                        raise
+                    except Exception:
+                        pass  # best-effort; closed conns get pruned
+                    # one attempt resolves the uncertainty either way:
+                    # a failed unsubscribe on a live conn is rare, and
+                    # retrying it forever would spin this pass
+                    with self._state_lock:
+                        self._pubsub_uncertain.discard(ch)
 
     async def _h_task_result(self, payload, conn):
         """A task we own finished on a worker (direct push reply) or was

@@ -103,11 +103,17 @@ def read_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
             if len(header) != 8:
                 raise ValueError(f"truncated TFRecord header in {path}")
             (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            hcrc_raw = f.read(4)
+            if len(hcrc_raw) != 4:
+                raise ValueError(f"truncated TFRecord header crc in {path}")
+            (hcrc,) = struct.unpack("<I", hcrc_raw)
             data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
             if len(data) != length:
                 raise ValueError(f"truncated TFRecord data in {path}")
+            dcrc_raw = f.read(4)
+            if len(dcrc_raw) != 4:
+                raise ValueError(f"truncated TFRecord data crc in {path}")
+            (dcrc,) = struct.unpack("<I", dcrc_raw)
             if verify:
                 if _masked_crc(header) != hcrc:
                     raise ValueError(f"TFRecord length crc mismatch in {path}")

@@ -163,8 +163,7 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
                 _state.clear()
                 from ray_tpu.serve import handle as _handle_mod
 
-                with _handle_mod._routers_lock:
-                    _handle_mod._routers.clear()
+                _handle_mod._close_routers()
         if "controller" not in _state:
             try:
                 controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
@@ -431,8 +430,7 @@ def shutdown():
         _state.pop("grpc_address", None)
     from ray_tpu.serve import handle as _handle_mod
 
-    with _handle_mod._routers_lock:
-        _handle_mod._routers.clear()
+    _handle_mod._close_routers()
     # the control plane may have been started by ANOTHER process (REST
     # deploy via the dashboard): resolve the named actors so shutdown
     # tears them down from anywhere
@@ -493,5 +491,4 @@ def shutdown():
         pass
     from ray_tpu.serve import handle as _h
 
-    with _h._routers_lock:
-        _h._routers.clear()
+    _h._close_routers()

@@ -263,6 +263,11 @@ class ServeController:
                     prev.replicas = {}
                     ds.version = prev.version + 1
                     ds.next_replica_idx = prev.next_replica_idx
+                    # request counters belong to the PREVIOUS incarnation:
+                    # drop its totals and per-router prev entries so the
+                    # new incarnation's first delta-fold starts from zero
+                    self._router_stats.pop((app_name, d["name"]), None)
+                    self._deployment_stats.pop((app_name, d["name"]), None)
                 deployments[d["name"]] = ds
             for prev in old.values():  # deployments dropped by the update
                 prev.deleted = True

@@ -27,6 +27,15 @@ _routers: Dict[tuple, Router] = {}
 _routers_lock = threading.Lock()
 
 
+def _close_routers():
+    """Close and forget all cached routers (serve shutdown / reset)."""
+    with _routers_lock:
+        routers = list(_routers.values())
+        _routers.clear()
+    for r in routers:
+        r.close()
+
+
 def _on_runtime_loop() -> bool:
     """True when running on the runtime's io-loop thread, where blocking
     runtime calls would deadlock."""

@@ -58,7 +58,25 @@ class Router:
         self._completed_total = 0
         self._latency_sum_s = 0.0
         self._stats_push_pending = False
+        self._deferred_task = None  # pending trailing-edge push, if any
+        self._closed = False
         self._incarnation = None  # deployment identity from the table
+
+    def close(self):
+        """Cancel the trailing-edge stats push (if pending) so a serve
+        shutdown doesn't leave an orphaned sleeping task on the runtime
+        io loop ('Task was destroyed but it is pending!')."""
+        with self._lock:
+            self._closed = True
+            task = self._deferred_task
+            self._deferred_task = None
+        if task is not None:
+            try:
+                from ray_tpu.core.runtime import get_runtime
+
+                get_runtime().loop.call_soon_threadsafe(task.cancel)
+            except Exception:
+                pass
 
     # -- routing table maintenance ------------------------------------
     def _install_table(self, table):
@@ -141,9 +159,14 @@ class Router:
     async def _deferred_stats_push(self):
         """Trailing-edge stats delivery: ride the normal refresh (which
         also installs the fetched table) after the burst settles."""
-        await asyncio.sleep(1.1)
-        with self._lock:
-            self._stats_push_pending = False
+        try:
+            await asyncio.sleep(1.1)
+        finally:
+            with self._lock:
+                self._stats_push_pending = False
+                self._deferred_task = None
+        if self._closed:
+            return
         try:
             await self._refresh_async(force=True)
         except Exception:
@@ -231,7 +254,12 @@ class Router:
                 if deferred:
                     self._stats_push_pending = True
             if deferred:
-                asyncio.ensure_future(self._deferred_stats_push())
+                t = asyncio.ensure_future(self._deferred_stats_push())
+                with self._lock:
+                    if self._closed:
+                        t.cancel()
+                    else:
+                        self._deferred_task = t
 
         # capacity frees when the replica replies, not when the caller
         # resolves the response (reference: the router decrements its

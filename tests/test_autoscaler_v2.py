@@ -264,6 +264,123 @@ def test_gang_absorbed_by_inbound_slice_no_relaunch():
     assert len(provider.non_terminated_nodes()) == 4  # ONE slice only
 
 
+class FakeCloudProvider(NodeProvider):
+    """Provider WITHOUT runtime_node_id (cloud pods/VMs boot daemons via
+    startup script) and with a Pending->Ready phase per node."""
+
+    def __init__(self):
+        self._live = {}  # pid -> ready: bool
+        self._next = 0
+        self.terminated = []
+
+    def create_node(self, node_config, count=1):
+        out = []
+        for _ in range(count):
+            pid = f"pod-{self._next}"
+            self._next += 1
+            self._live[pid] = False
+            out.append(pid)
+        return out
+
+    def terminate_node(self, provider_id):
+        self._live.pop(provider_id, None)
+        self.terminated.append(provider_id)
+
+    def non_terminated_nodes(self):
+        return list(self._live)
+
+    def node_is_ready(self, provider_id):
+        return self._live.get(provider_id, False)
+
+    def mark_ready(self, provider_id):
+        self._live[provider_id] = True
+
+
+def test_pending_cloud_node_not_promoted_until_ready():
+    """A listed-but-Pending pod/VM must stay REQUESTED: promoting it on
+    sight would both disable the slice ready-timeout reaper and remove
+    it from inbound spare capacity (duplicate slice launch per tick)."""
+    provider = FakeCloudProvider()
+    cfg = _config(hosts_per_slice=2, max_hosts=64)
+    cfg.node_types["tpu_host"].max_slices = 16
+    state = _state(gangs=[{"pg_id": "g", "bundles": [{"TPU": 4}] * 2}])
+    a = AutoscalerV2(provider, cfg, cluster_state_fn=lambda: state)
+    for _ in range(4):  # ticks while the pods sit Pending
+        a.update()
+    # still REQUESTED (not promoted), and no duplicate slice launched
+    assert len(a.im.instances(REQUESTED)) == 2
+    assert a.im.instances(RUNNING) == []
+    assert len(provider.non_terminated_nodes()) == 2
+    # pods go Running -> promotion happens
+    for pid in provider.non_terminated_nodes():
+        provider.mark_ready(pid)
+    a.update()
+    assert len(a.im.instances(RUNNING)) == 2
+
+
+def test_pending_cloud_slice_reaped_at_ready_timeout():
+    """Ready-timeout reaping applies to never-ready cloud slices: the
+    REQUESTED members age out and the slice is torn down whole."""
+    provider = FakeCloudProvider()
+    cfg = _config(hosts_per_slice=2, slice_ready_timeout_s=0.0)
+    state = _state(gangs=[{"pg_id": "g", "bundles": [{"TPU": 4}] * 2}])
+    a = AutoscalerV2(provider, cfg, cluster_state_fn=lambda: state)
+    a.update()
+    time.sleep(0.01)
+    a._cluster_state_fn = lambda: _state()
+    a.update()
+    assert a.im.instances(REQUESTED, RUNNING) == []
+    assert len(provider.terminated) == 2
+
+
+def test_cloud_busy_folds_via_launch_label():
+    """Providers without runtime_node_id fold busy state through the
+    rt-launch label the booted nodes registered with — an actively busy
+    cloud slice must never be idle-reaped."""
+    provider = FakeCloudProvider()
+    cfg = _config(hosts_per_slice=1, idle_timeout_s=0.0)
+    state = _state(demands=[{"TPU": 4}])
+    a = AutoscalerV2(provider, cfg, cluster_state_fn=lambda: state)
+    a.update()
+    (inst,) = a.im.instances(REQUESTED)
+    assert inst.launch_id is not None
+    provider.mark_ready(inst.provider_id)
+    # node registered with the launch label, reporting busy; demand gone
+    busy_state = _state(nodes=[{
+        "node_id": "n-1", "alive": True, "busy": True,
+        "labels": {"rt-launch": inst.launch_id},
+    }])
+    a._cluster_state_fn = lambda: busy_state
+    for _ in range(3):
+        a.update()
+        time.sleep(0.01)
+    assert len(a.im.instances(RUNNING)) == 1  # busy: not idle-reaped
+    # node goes idle -> with idle_timeout 0 the instance is terminated
+    idle_state = _state(nodes=[{
+        "node_id": "n-1", "alive": True, "busy": False,
+        "labels": {"rt-launch": inst.launch_id},
+    }])
+    a._cluster_state_fn = lambda: idle_state
+    time.sleep(0.02)
+    a.update()
+    assert a.im.instances(RUNNING) == []
+
+
+def test_pending_single_node_reaped_at_ready_timeout():
+    """Non-slice nodes stuck REQUESTED age out too — a never-scheduling
+    Pending pod must not absorb its demand as inbound capacity forever."""
+    provider = FakeCloudProvider()
+    cfg = _config(hosts_per_slice=1, slice_ready_timeout_s=0.0)
+    state = _state(demands=[{"TPU": 4}])
+    a = AutoscalerV2(provider, cfg, cluster_state_fn=lambda: state)
+    a.update()  # launches one host (REQUESTED, stays Pending)
+    time.sleep(0.01)
+    a._cluster_state_fn = lambda: _state()
+    a.update()
+    assert a.im.instances(REQUESTED, RUNNING) == []
+    assert len(provider.terminated) == 1
+
+
 def test_gang_launch_requires_real_bin_pack():
     """An aggregate-fitting but unpackable gang must NOT launch: bundles
     [3,3,2] CPUs sum to 8 <= 2x4 but no host assignment works; without
