@@ -11,16 +11,25 @@ from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algor
 from ray_tpu.rllib.connectors import (
     ConnectorPipeline,
     ConnectorV2,
+    FrameStack,
+    ImagePreprocess,
     MeanStdObsFilter,
     ObsClip,
     RewardClip,
+    wrap_atari_connectors,
 )
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
+from ray_tpu.rllib.core.rl_module import CNNModule, make_default_module
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
     EnvRunner,
     EnvRunnerGroup,
     VectorEnv,
+)
+from ray_tpu.rllib.env.envs import (
+    CatchPixelEnv,
+    ContinuousTargetEnv,
+    PendulumVectorEnv,
 )
 
 __all__ = [
@@ -40,6 +49,14 @@ __all__ = [
     "CQL",
     "CQLConfig",
     "CartPoleVectorEnv",
+    "CatchPixelEnv",
+    "CNNModule",
+    "ContinuousTargetEnv",
+    "FrameStack",
+    "ImagePreprocess",
+    "PendulumVectorEnv",
+    "make_default_module",
+    "wrap_atari_connectors",
     "DQN",
     "DQNConfig",
     "Dreamer",

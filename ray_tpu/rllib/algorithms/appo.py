@@ -23,7 +23,7 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import make_default_module
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 
@@ -138,10 +138,7 @@ class APPO(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
-        self.module = MLPModule(
-            spec["observation_size"], spec["num_actions"],
-            hidden=tuple(cfg.model.get("hidden", (64, 64))),
-        )
+        self.module = make_default_module(spec, cfg.model)
         loss = make_appo_loss(
             cfg.clip_param, cfg.vf_loss_coeff, cfg.entropy_coeff
         )
@@ -157,7 +154,7 @@ class APPO(Algorithm):
         """Current-policy logits/values over a [T, B, obs] rollout —
         numpy MLP math, same fast path the runners use."""
         T, B = obs_tb.shape[:2]
-        flat = obs_tb.reshape(T * B, -1)
+        flat = obs_tb.reshape(T * B, *obs_tb.shape[2:])
         logits, values = self.module.forward_numpy(weights, flat)
         return (
             logits.reshape(T, B, -1),
@@ -191,7 +188,7 @@ class APPO(Algorithm):
                 clip_c=cfg.vtrace_clip_c_threshold,
             )
             T, B = s["actions"].shape
-            obs_l.append(s["obs"].reshape(T * B, -1))
+            obs_l.append(s["obs"].reshape(T * B, *s["obs"].shape[2:]))
             act_l.append(s["actions"].reshape(-1))
             blogp_l.append(s["logp"].reshape(-1))
             adv_l.append(pg_adv.reshape(-1))

@@ -25,7 +25,9 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import (
+    MLPModule, require_discrete_actions, require_flat_obs,
+)
 
 
 class BCConfig(AlgorithmConfig):
@@ -113,6 +115,8 @@ class BC(Algorithm):
                 connector=cfg.env_to_module_connector,
             )
             spec = self.env_runner_group.env_spec()
+            require_flat_obs(spec, "BC/MARWIL")
+            require_discrete_actions(spec, "BC/MARWIL")
             obs_dim = spec["observation_size"]
             num_actions = max(num_actions, spec["num_actions"])
         self.module = MLPModule(
@@ -155,16 +159,26 @@ class BC(Algorithm):
         return result
 
     def get_state(self) -> Dict[str, Any]:
-        return {
+        state = {
             "learner": self.learner_group.get_state(),
             "rng": self._rng,
             "iteration": self.iteration,
         }
+        if self.env_runner_group is not None:
+            # a restored offline run must keep its obs-filter statistics
+            # (MeanStdObsFilter): losing them silently changes the
+            # policy's effective inputs at evaluation time
+            state["connector"] = self.env_runner_group.connector_state()
+        return state
 
     def set_state(self, state: Dict[str, Any]):
         self.learner_group.set_state(state["learner"])
         if "rng" in state:
             self._rng = state["rng"]
+        if self.env_runner_group is not None:
+            self.env_runner_group.restore_connector_state(
+                state.get("connector")
+            )
         self.iteration = state.get("iteration", self.iteration)
 
     def stop(self):

@@ -21,7 +21,9 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import (
+    MLPModule, require_discrete_actions, require_flat_obs,
+)
 
 
 class QMLPModule(MLPModule):
@@ -87,11 +89,12 @@ class ReplayBuffer:
     """Uniform ring buffer of transitions (reference:
     `rllib/utils/replay_buffers/`)."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, *,
+                 action_shape: tuple = (), action_dtype=np.int32):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.terminated = np.zeros(capacity, np.bool_)
         self._next = 0
@@ -128,7 +131,7 @@ def _transitions(sample: Dict[str, np.ndarray]):
     in auto-reset still carry terminated correctly (s' unused when
     terminal).  Truncated steps are treated as terminal (standard DQN
     simplification; the Q bootstrap error is bounded by gamma*Qmax)."""
-    T, B = sample["actions"].shape
+    T, B = sample["actions"].shape[:2]  # [T,B] or [T,B,A] (continuous)
     obs = sample["obs"]
     next_obs = np.concatenate(
         [obs[1:], sample["final_obs"][None]], axis=0
@@ -155,6 +158,8 @@ class DQN(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
+        require_flat_obs(spec, "DQN")
+        require_discrete_actions(spec, "DQN")
         self.module = QMLPModule(
             spec["observation_size"], spec["num_actions"],
             hidden=tuple(cfg.model.get("hidden", (64, 64))),

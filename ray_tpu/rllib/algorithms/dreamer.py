@@ -4,8 +4,8 @@ actor-critic trained purely in imagination.
 Reference: `rllib/algorithms/dreamerv3/` (`dreamerv3.py`,
 `torch/dreamerv3_torch_learner.py`, `utils/summaries.py`) — the
 DreamerV3 recipe (Hafner et al. 2023).  This is a faithful-but-compact
-jax implementation of its core mechanics, sized for vector-observation
-envs:
+jax implementation of its core mechanics, supporting both vector and
+pixel observations (conv encoder + deconv decoder, see DreamerModel):
 
 - **RSSM**: deterministic GRU core + categorical stochastic latent
   (straight-through gradients), posterior from (h, obs embedding),
@@ -20,9 +20,9 @@ envs:
   lambda-returns with an EMA target critic.
 
 Deliberate reductions vs the full reference stack (documented, not
-hidden): MLP encoder/decoder instead of CNNs (vector envs), reinforce
-actor gradient only (no dynamics backprop mixing), percentile return
-normalization reduced to EMA std scaling, no twohot critic bins.
+hidden): reinforce actor gradient only (no dynamics backprop mixing),
+percentile return normalization reduced to EMA std scaling, no twohot
+critic bins.
 """
 
 from __future__ import annotations
@@ -63,6 +63,9 @@ class DreamerConfig(AlgorithmConfig):
         self.stoch_classes = 8
         self.embed_hidden = (128,)
         self.head_hidden = (128,)
+        # pixel-obs mode (image envs): conv encoder + deconv decoder
+        # (reference: dreamerv3 CNN encoder/decoder for Atari/DMC)
+        self.conv_filters = ((16, 4, 2), (32, 4, 2))
         # world-model training
         self.batch_length = 16
         self.batch_segments = 16
@@ -112,16 +115,78 @@ def _mlp(layers, x, act_last=False):
 
 
 class DreamerModel:
-    """Pure-function world model + actor + critic (params as pytrees)."""
+    """Pure-function world model + actor + critic (params as pytrees).
 
-    def __init__(self, cfg: DreamerConfig, obs_dim: int, num_actions: int):
+    `obs_shape` of length 3 switches to pixel mode: conv encoder +
+    deconv decoder (reference: dreamerv3's CNN encoder/decoder for
+    Atari/DMC); otherwise MLP encoder/decoder over flat vectors."""
+
+    def __init__(self, cfg: DreamerConfig, obs_dim: int, num_actions: int,
+                 obs_shape: Optional[Tuple[int, ...]] = None):
         self.cfg = cfg
         self.obs_dim = obs_dim
+        self.obs_shape = tuple(obs_shape or (obs_dim,))
+        self.pixel = len(self.obs_shape) == 3
         self.num_actions = num_actions
         self.stoch_size = cfg.stoch_groups * cfg.stoch_classes
         self.feat_size = cfg.deter_size + self.stoch_size
+        if self.pixel:
+            from ray_tpu.rllib.core.rl_module import conv_out_dims
+
+            # per-conv-layer output spatial dims (SAME padding, ceil)
+            self.conv_dims = conv_out_dims(
+                self.obs_shape[0], self.obs_shape[1], cfg.conv_filters
+            )
+            h, w = self.conv_dims[-1]
+            self._conv_flat = h * w * cfg.conv_filters[-1][0]
 
     # -- init ----------------------------------------------------------
+    def _init_conv_encoder(self, rng):
+        import jax
+
+        from ray_tpu.rllib.core.rl_module import conv_stack_init
+
+        cfg = self.cfg
+        rng, k_conv, k_dense = jax.random.split(rng, 3)
+        return {
+            "conv": conv_stack_init(
+                k_conv, self.obs_shape[-1], cfg.conv_filters
+            ),
+            "dense": _mlp_init(
+                k_dense, [self._conv_flat, *cfg.embed_hidden]
+            ),
+        }
+
+    def _init_deconv_decoder(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        h0, w0 = self.conv_dims[-1]
+        c0 = cfg.conv_filters[-1][0]
+        rng, key = jax.random.split(rng)
+        dense = _mlp_init(key, [self.feat_size, h0 * w0 * c0])
+        deconv = []
+        # mirror the encoder stack in reverse; the last deconv emits
+        # the obs channels with a small-scale linear output
+        chans = [c for c, _k, _s in cfg.conv_filters]
+        in_chans = chans[::-1]
+        out_chans = chans[-2::-1] + [self.obs_shape[-1]]
+        kernels = [k for _c, k, _s in cfg.conv_filters][::-1]
+        strides = [s for _c, _k, s in cfg.conv_filters][::-1]
+        for i, (ci, co, k, _s) in enumerate(
+            zip(in_chans, out_chans, kernels, strides)
+        ):
+            rng, key = jax.random.split(rng)
+            last = i == len(in_chans) - 1
+            scale = 0.01 if last else float(np.sqrt(2.0 / (k * k * ci)))
+            deconv.append({
+                "w": jax.random.normal(key, (k, k, ci, co), jnp.float32)
+                * scale,
+                "b": jnp.zeros((co,), jnp.float32),
+            })
+        return {"dense": dense, "deconv": deconv}
+
     def init_params(self, rng):
         import jax
 
@@ -129,17 +194,72 @@ class DreamerModel:
         ks = list(jax.random.split(rng, 10))
         D, S, A = cfg.deter_size, self.stoch_size, self.num_actions
         E = cfg.embed_hidden[-1]
+        if self.pixel:
+            encoder = self._init_conv_encoder(ks[0])
+            decoder = self._init_deconv_decoder(ks[4])
+        else:
+            encoder = _mlp_init(ks[0], [self.obs_dim, *cfg.embed_hidden])
+            decoder = _mlp_init(ks[4], [self.feat_size, *cfg.head_hidden,
+                                        self.obs_dim])
         return {
-            "encoder": _mlp_init(ks[0], [self.obs_dim, *cfg.embed_hidden]),
+            "encoder": encoder,
             # GRU: input = [stoch + action_onehot] -> 3 gates over deter
             "gru": _mlp_init(ks[1], [S + A + D, 3 * D]),
             "prior": _mlp_init(ks[2], [D, *cfg.head_hidden, S]),
             "posterior": _mlp_init(ks[3], [D + E, *cfg.head_hidden, S]),
-            "decoder": _mlp_init(ks[4], [self.feat_size, *cfg.head_hidden,
-                                         self.obs_dim]),
+            "decoder": decoder,
             "reward": _mlp_init(ks[5], [self.feat_size, *cfg.head_hidden, 1]),
             "cont": _mlp_init(ks[6], [self.feat_size, *cfg.head_hidden, 1]),
         }
+
+    # -- encoder/decoder (pixel or vector) -----------------------------
+    def encode(self, params, obs_seq):
+        """obs_seq [L, B, *obs_shape] -> embeddings [L, B, E]."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.pixel:
+            return _mlp(params["encoder"], symlog(obs_seq), act_last=True)
+        from ray_tpu.rllib.core.rl_module import conv_stack_apply
+
+        enc = params["encoder"]
+        L, B = obs_seq.shape[:2]
+        x = obs_seq.reshape(L * B, *self.obs_shape)
+        x = conv_stack_apply(
+            x=x, conv_params=enc["conv"],
+            conv_filters=self.cfg.conv_filters, activation=jax.nn.silu,
+        )
+        x = x.reshape(L * B, -1)
+        x = _mlp(enc["dense"], x, act_last=True)
+        return x.reshape(L, B, -1)
+
+    def decode(self, params, feats):
+        """feats [L, B, F] -> reconstruction [L, B, *obs_shape] (pixel)
+        or [L, B, obs_dim] symlog-space (vector)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.pixel:
+            return _mlp(params["decoder"], feats)
+        dec = params["decoder"]
+        L, B = feats.shape[:2]
+        h0, w0 = self.conv_dims[-1]
+        c0 = self.cfg.conv_filters[-1][0]
+        x = _mlp(dec["dense"], feats.reshape(L * B, -1), act_last=True)
+        x = x.reshape(L * B, h0, w0, c0)
+        strides = [s for _c, _k, s in self.cfg.conv_filters][::-1]
+        targets = self.conv_dims[-2::-1]  # spatial dims to restore
+        for i, (lyr, s, (th, tw)) in enumerate(
+            zip(dec["deconv"], strides, targets)
+        ):
+            x = jax.lax.conv_transpose(
+                x, lyr["w"], strides=(s, s), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + lyr["b"]
+            x = x[:, :th, :tw, :]  # crop ceil-division overshoot
+            if i < len(dec["deconv"]) - 1:
+                x = jax.nn.silu(x)
+        return x.reshape(L, B, *self.obs_shape)
 
     def init_actor_critic(self, rng):
         import jax
@@ -181,7 +301,7 @@ class DreamerModel:
 
         cfg = self.cfg
         L, B = action_seq.shape
-        embed = _mlp(params["encoder"], symlog(obs_seq), act_last=True)
+        embed = self.encode(params, obs_seq)
         a_onehot = jax.nn.one_hot(action_seq, self.num_actions)
         h0 = (
             first_h if first_h is not None
@@ -234,10 +354,18 @@ class DreamerModel:
         feats, priors, posts, hs = self.rssm_observe(
             params, rng, obs, actions
         )
-        recon = _mlp(params["decoder"], feats)
-        recon_loss = jnp.mean(jnp.sum(
-            (recon - symlog(obs)) ** 2, axis=-1
-        ))
+        recon = self.decode(params, feats)
+        if self.pixel:
+            # pixel decoder is a unit-variance Gaussian on [0,1] frames
+            # (reference: dreamerv3 MSE image loss, summed over pixels)
+            recon_loss = jnp.mean(jnp.sum(
+                (recon - obs) ** 2,
+                axis=tuple(range(2, recon.ndim)),
+            ))
+        else:
+            recon_loss = jnp.mean(jnp.sum(
+                (recon - symlog(obs)) ** 2, axis=-1
+            ))
         rew_pred = _mlp(params["reward"], feats)[..., 0]
         reward_loss = jnp.mean((rew_pred - symlog(rewards)) ** 2)
         cont_logit = _mlp(params["cont"], feats)[..., 0]
@@ -347,8 +475,12 @@ class Dreamer(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
+        from ray_tpu.rllib.core.rl_module import require_discrete_actions
+
+        require_discrete_actions(spec, "DreamerV3")
         self.model = DreamerModel(
-            cfg, spec["observation_size"], spec["num_actions"]
+            cfg, spec["observation_size"], spec["num_actions"],
+            obs_shape=spec.get("observation_shape"),
         )
         rng = jax.random.PRNGKey(cfg.seed)
         k_wm, k_ac, self._rng_key = jax.random.split(rng, 3)
@@ -620,6 +752,8 @@ class _DreamerPolicy:
             algo.model.cfg.stoch_classes,
         )
         self._num_actions = algo.model.num_actions
+        self._pixel = algo.model.pixel
+        self._conv_filters = tuple(algo.model.cfg.conv_filters)
 
     @staticmethod
     def _np_mlp(layers, x, act_last=False):
@@ -629,11 +763,24 @@ class _DreamerPolicy:
                 x = x * (1.0 / (1.0 + np.exp(-x)))  # silu
         return x
 
+    def _np_encode(self, enc, obs):
+        if not self._pixel:
+            x = np.sign(obs) * np.log1p(np.abs(obs))
+            return self._np_mlp(enc, x, act_last=True)
+        from ray_tpu.rllib.core.rl_module import _conv2d_numpy
+
+        x = np.asarray(obs, np.float32)
+        for lyr, (_c, k, s) in zip(enc["conv"], self._conv_filters):
+            x = _conv2d_numpy(x, np.asarray(lyr["w"]),
+                              np.asarray(lyr["b"]), k, s)
+            x = x * (1.0 / (1.0 + np.exp(-x)))  # silu
+        return self._np_mlp(enc["dense"], x.reshape(x.shape[0], -1),
+                            act_last=True)
+
     def forward_numpy(self, params, obs):
         D, G, C = self._cfg_sizes
         wm, actor = params["wm"], params["actor"]
-        x = np.sign(obs) * np.log1p(np.abs(obs))
-        emb = self._np_mlp(wm["encoder"], x, act_last=True)
+        emb = self._np_encode(wm["encoder"], obs)
         B = obs.shape[0]
         h = np.zeros((B, D), np.float32)
         post = self._np_mlp(

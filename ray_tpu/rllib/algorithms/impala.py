@@ -24,7 +24,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import compute_vtrace, _logsumexp
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import make_default_module
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 
@@ -85,10 +85,7 @@ class IMPALA(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
-        self.module = MLPModule(
-            spec["observation_size"], spec["num_actions"],
-            hidden=tuple(cfg.model.get("hidden", (64, 64))),
-        )
+        self.module = make_default_module(spec, cfg.model)
         loss = make_impala_loss(cfg.vf_loss_coeff, cfg.entropy_coeff)
         self.learner_group = LearnerGroup(
             self.module, loss, num_learners=cfg.num_learners,
@@ -104,7 +101,7 @@ class IMPALA(Algorithm):
         obs_l, act_l, adv_l, tgt_l = [], [], [], []
         for s in samples:
             T, B = s["actions"].shape
-            flat = s["obs"].reshape(T * B, -1)
+            flat = s["obs"].reshape(T * B, *s["obs"].shape[2:])
             logits, values = self.module.forward_numpy(weights, flat)
             logits = logits.reshape(T, B, -1)
             values = values.reshape(T, B).astype(np.float32)
@@ -126,7 +123,7 @@ class IMPALA(Algorithm):
                 clip_rho=self.config.vtrace_clip_rho_threshold,
                 clip_c=self.config.vtrace_clip_c_threshold,
             )
-            obs_l.append(s["obs"].reshape(T * B, -1))
+            obs_l.append(s["obs"].reshape(T * B, *s["obs"].shape[2:]))
             act_l.append(s["actions"].reshape(-1))
             adv_l.append(pg_adv.reshape(-1))
             tgt_l.append(vs.reshape(-1))

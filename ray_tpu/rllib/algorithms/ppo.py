@@ -17,7 +17,7 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import make_default_module
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 
 
@@ -119,10 +119,8 @@ class PPO(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
-        self.module = MLPModule(
-            spec["observation_size"], spec["num_actions"],
-            hidden=tuple(cfg.model.get("hidden", (64, 64))),
-        )
+        # conv encoder for image obs, fcnet otherwise
+        self.module = make_default_module(spec, cfg.model)
         if cfg.num_epochs < 1:
             raise ValueError("num_epochs must be >= 1")
         loss = make_ppo_loss(
@@ -146,7 +144,7 @@ class PPO(Algorithm):
         for s in samples:
             a, tg = compute_gae(s, cfg.gamma, cfg.lambda_)
             T, B = s["actions"].shape
-            obs.append(s["obs"].reshape(T * B, -1))
+            obs.append(s["obs"].reshape(T * B, *s["obs"].shape[2:]))
             actions.append(s["actions"].reshape(-1))
             logp.append(s["logp"].reshape(-1))
             adv_l.append(a.reshape(-1))

@@ -1,13 +1,14 @@
-"""SAC for discrete action spaces (new API stack).
+"""SAC for discrete AND continuous action spaces (new API stack).
 
-Reference: `rllib/algorithms/sac/` (`sac.py`, `sac_learner.py` —
-continuous there; this is the standard discrete-SAC variant: expected
-Q under the full softmax policy replaces the reparameterized sample).
-Components: twin Q networks with a polyak-free periodic target sync
-(as the reference's discrete path does), softmax actor, and
-automatically-tuned entropy temperature (log_alpha is a learned
-parameter in the same pytree, so the single compiled learner update
-covers actor + critics + alpha).
+Reference: `rllib/algorithms/sac/` (`sac.py`, `sac_learner.py`).
+Both variants share the recipe: twin Q networks with a polyak-free
+periodic target sync, automatically-tuned entropy temperature
+(log_alpha is a learned parameter in the same pytree, so the single
+compiled learner update covers actor + critics + alpha).  Discrete
+envs get the standard discrete-SAC variant (expected Q under the full
+softmax policy); continuous envs (`VectorEnv.continuous`) get the
+original SAC: tanh-squashed reparameterized Gaussian actor and
+Q(s, a) critics (`ContinuousSACModule`).
 
 TD targets are computed OUTSIDE the learner with jitted target-network
 forwards (the DQN pattern here): the compiled update depends only on
@@ -24,7 +25,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import ReplayBuffer, _transitions
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.core.rl_module import MLPModule
+from ray_tpu.rllib.core.rl_module import MLPModule, require_flat_obs
 
 
 class SACModule(MLPModule):
@@ -60,6 +61,155 @@ class SACModule(MLPModule):
 
         return (tower_numpy(params_np["pi"], obs),
                 np.zeros(obs.shape[0], np.float32))
+
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+class ContinuousSACModule(MLPModule):
+    """Squashed-Gaussian actor + twin state-action critics (reference:
+    `rllib/algorithms/sac/sac_learner.py` continuous path, matching the
+    original SAC: tanh-squashed reparameterized policy, Q(s, a) MLPs).
+
+    Actions live in [-1, 1]^A at the module boundary; the EnvRunner
+    rescales to the env's bounds.  The pi tower outputs (mu, log_std);
+    q towers take concat(obs, action).
+    """
+
+    def __init__(self, observation_size: int, action_dim: int,
+                 hidden=(64, 64)):
+        # num_actions doubles as the pi tower's output size (mu+logstd)
+        super().__init__(observation_size, 2 * action_dim, hidden=hidden)
+        self.action_dim = action_dim
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        q_in = self.observation_size + self.action_dim
+        q_tower = MLPModule(q_in, 1, hidden=self.hidden)
+        return {
+            "pi": self.init_tower(k_pi, 2 * self.action_dim),
+            "q1": q_tower.init_tower(k_q1, 1),
+            "q2": q_tower.init_tower(k_q2, 1),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    # -- jax -----------------------------------------------------------
+    def actor(self, params, obs):
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core.rl_module import tower_jax
+
+        out = tower_jax(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def q_values(self, params, obs, actions):
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core.rl_module import tower_jax
+
+        sa = jnp.concatenate([obs, actions], axis=-1)
+        return (tower_jax(params["q1"], sa)[..., 0],
+                tower_jax(params["q2"], sa)[..., 0])
+
+    def sample_squashed(self, params, obs, noise):
+        """Reparameterized tanh-Gaussian sample + its log-prob (the
+        noise is standard normal, drawn OUTSIDE the jitted loss so the
+        compiled update stays a pure function of the batch)."""
+        import jax.numpy as jnp
+
+        mu, log_std = self.actor(params, obs)
+        std = jnp.exp(log_std)
+        pre = mu + std * noise
+        a = jnp.tanh(pre)
+        logp = jnp.sum(
+            -0.5 * noise**2 - log_std - 0.5 * jnp.log(2 * jnp.pi)
+            - jnp.log(1.0 - a**2 + 1e-6),
+            axis=-1,
+        )
+        return a, logp
+
+    def forward_train(self, params, obs):
+        import jax.numpy as jnp
+
+        mu, _ = self.actor(params, obs)
+        return mu, jnp.zeros(obs.shape[0])
+
+    # -- numpy (env runners) ------------------------------------------
+    def select_actions_numpy(self, params_np, obs, rng, explore):
+        from ray_tpu.rllib.core.rl_module import tower_numpy
+
+        out = tower_numpy(params_np["pi"], obs)
+        mu, log_std = np.split(out, 2, axis=-1)
+        if explore is False:
+            a = np.tanh(mu)
+            logp = np.zeros(a.shape[0], np.float32)
+        else:
+            log_std = np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+            std = np.exp(log_std)
+            noise = rng.standard_normal(mu.shape).astype(np.float32)
+            pre = mu + std * noise
+            a = np.tanh(pre)
+            logp = np.sum(
+                -0.5 * noise**2 - log_std - 0.5 * np.log(2 * np.pi)
+                - np.log(1.0 - a**2 + 1e-6),
+                axis=-1,
+            ).astype(np.float32)
+        return (a.astype(np.float32), logp,
+                np.zeros(a.shape[0], np.float32))
+
+    def forward_numpy(self, params_np, obs: np.ndarray):
+        from ray_tpu.rllib.core.rl_module import tower_numpy
+
+        out = tower_numpy(params_np["pi"], obs)
+        mu, _ = np.split(out, 2, axis=-1)
+        return mu, np.zeros(obs.shape[0], np.float32)
+
+
+def make_continuous_sac_loss(target_entropy: float):
+    """Joint actor + twin-critic + temperature loss, continuous SAC.
+    `batch["noise"]` carries the reparameterization draw."""
+
+    def sac_loss(module, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs = batch["obs"]
+        alpha = jnp.exp(params["log_alpha"])
+
+        # critics toward externally computed TD targets
+        q1_a, q2_a = module.q_values(params, obs, batch["actions"])
+        y = batch["td_target"]
+        critic_loss = jnp.mean((q1_a - y) ** 2) + jnp.mean((q2_a - y) ** 2)
+
+        # actor: reparameterized sample, critics detached
+        a_pi, logp = module.sample_squashed(params, obs, batch["noise"])
+        q1_pi, q2_pi = module.q_values(
+            jax.lax.stop_gradient(params), obs, a_pi
+        )
+        min_q = jnp.minimum(q1_pi, q2_pi)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp - min_q
+        )
+
+        # temperature toward the entropy target (policy detached)
+        logp_sg = jax.lax.stop_gradient(logp)
+        alpha_loss = jnp.mean(
+            params["log_alpha"] * (-logp_sg - target_entropy)
+        )
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha": alpha,
+            "entropy": -jnp.mean(logp_sg),
+        }
+
+    return sac_loss
 
 
 class SACConfig(AlgorithmConfig):
@@ -140,31 +290,64 @@ class SAC(Algorithm):
             connector=cfg.env_to_module_connector,
         )
         spec = self.env_runner_group.env_spec()
-        self.module = SACModule(
-            spec["observation_size"], spec["num_actions"],
-            hidden=tuple(cfg.model.get("hidden", (64, 64))),
-        )
-        if cfg.target_entropy is None:
-            cfg.target_entropy = 0.5 * float(np.log(spec["num_actions"]))
+        require_flat_obs(spec, "SAC")
+        self._continuous = spec["continuous"]
+        hidden = tuple(cfg.model.get("hidden", (64, 64)))
+        if self._continuous:
+            action_dim = spec["action_dim"]
+            self.module = ContinuousSACModule(
+                spec["observation_size"], action_dim, hidden=hidden
+            )
+            if cfg.target_entropy is None:
+                # the continuous-SAC convention: -|A|
+                cfg.target_entropy = -float(action_dim)
+            loss = make_continuous_sac_loss(cfg.target_entropy)
+            self.buffer = ReplayBuffer(
+                cfg.buffer_size, spec["observation_size"],
+                action_shape=(action_dim,), action_dtype=np.float32,
+            )
+        else:
+            self.module = SACModule(
+                spec["observation_size"], spec["num_actions"],
+                hidden=hidden,
+            )
+            if cfg.target_entropy is None:
+                cfg.target_entropy = 0.5 * float(
+                    np.log(spec["num_actions"])
+                )
+            loss = make_sac_loss(cfg.target_entropy)
+            self.buffer = ReplayBuffer(
+                cfg.buffer_size, spec["observation_size"]
+            )
         self.learner_group = LearnerGroup(
-            self.module, make_sac_loss(cfg.target_entropy),
+            self.module, loss,
             num_learners=cfg.num_learners, lr=cfg.lr,
             grad_clip=cfg.grad_clip, seed=cfg.seed, mesh=cfg.mesh,
         )
-        self.buffer = ReplayBuffer(cfg.buffer_size, spec["observation_size"])
         self.target_params = self.learner_group.get_weights_numpy()
         self._rng = np.random.default_rng(cfg.seed)
 
-        def _target_terms(target_p, online_p, next_obs):
-            import jax.numpy as jnp
+        if self._continuous:
+            def _target_terms(target_p, online_p, next_obs, noise):
+                import jax.numpy as jnp
 
-            logits, _ = self.module.forward_train(online_p, next_obs)
-            logp_all = jax.nn.log_softmax(logits, axis=-1)
-            probs = jnp.exp(logp_all)
-            tq1, tq2 = self.module.q_values(target_p, next_obs)
-            min_q = jnp.minimum(tq1, tq2)
-            alpha = jnp.exp(online_p["log_alpha"])
-            return jnp.sum(probs * (min_q - alpha * logp_all), axis=-1)
+                a2, logp2 = self.module.sample_squashed(
+                    online_p, next_obs, noise
+                )
+                tq1, tq2 = self.module.q_values(target_p, next_obs, a2)
+                alpha = jnp.exp(online_p["log_alpha"])
+                return jnp.minimum(tq1, tq2) - alpha * logp2
+        else:
+            def _target_terms(target_p, online_p, next_obs):
+                import jax.numpy as jnp
+
+                logits, _ = self.module.forward_train(online_p, next_obs)
+                logp_all = jax.nn.log_softmax(logits, axis=-1)
+                probs = jnp.exp(logp_all)
+                tq1, tq2 = self.module.q_values(target_p, next_obs)
+                min_q = jnp.minimum(tq1, tq2)
+                alpha = jnp.exp(online_p["log_alpha"])
+                return jnp.sum(probs * (min_q - alpha * logp_all), axis=-1)
 
         self._target_terms = jax.jit(_target_terms)
         self.env_runner_group.sync_weights(
@@ -173,9 +356,17 @@ class SAC(Algorithm):
 
     def _td_targets(self, replay, online) -> np.ndarray:
         cfg = self.config
-        v_next = np.asarray(self._target_terms(
-            self.target_params, online, replay["next_obs"]
-        ))
+        if self._continuous:
+            noise = self._rng.standard_normal(
+                replay["actions"].shape
+            ).astype(np.float32)
+            v_next = np.asarray(self._target_terms(
+                self.target_params, online, replay["next_obs"], noise
+            ))
+        else:
+            v_next = np.asarray(self._target_terms(
+                self.target_params, online, replay["next_obs"]
+            ))
         nonterminal = 1.0 - replay["terminated"].astype(np.float32)
         return (replay["rewards"] + cfg.gamma * v_next * nonterminal).astype(
             np.float32
@@ -200,6 +391,10 @@ class SAC(Algorithm):
                     "actions": replay["actions"],
                     "td_target": self._td_targets(replay, online),
                 }
+                if self._continuous:
+                    batch["noise"] = self._rng.standard_normal(
+                        replay["actions"].shape
+                    ).astype(np.float32)
                 metrics_acc.append(self.learner_group.update_minibatch(batch))
         if (self.iteration + 1) % cfg.target_update_freq == 0:
             self.target_params = self.learner_group.get_weights_numpy()

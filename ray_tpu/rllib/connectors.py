@@ -41,6 +41,30 @@ class ConnectorV2:
     def on_rewards(self, rewards: np.ndarray) -> np.ndarray:
         return rewards
 
+    def transformed_observation_shape(
+        self, shape: Sequence[int],
+    ) -> Sequence[int]:
+        """Static shape mapping of `on_observations` (no state touched):
+        lets module construction know the post-connector obs shape
+        without running a sample (reference: connectors recompute the
+        observation space for the module spec)."""
+        return tuple(shape)
+
+    def on_episode_boundaries(self, done_mask: np.ndarray) -> None:
+        """Called by the EnvRunner after env.step with the per-sub-env
+        done mask, so temporal connectors (frame stacking) reset their
+        per-env state at episode boundaries."""
+        pass
+
+    def on_final_observations(self, obs: np.ndarray,
+                              env_indices: np.ndarray) -> np.ndarray:
+        """Transform final/bootstrap observations of a SUBSET of
+        sub-envs (truncation value bootstrap).  Temporal connectors
+        override this to read their per-env state without advancing
+        it; stateless/statistical connectors treat it as a normal
+        observation batch."""
+        return self.on_observations(obs)
+
     def get_state(self) -> Dict[str, Any]:
         """Report-and-reset: return the state accumulated since the
         last call (stateful connectors POP their delta here — see
@@ -82,6 +106,20 @@ class ConnectorPipeline(ConnectorV2):
         for c in self.connectors:
             rewards = c.on_rewards(rewards)
         return rewards
+
+    def transformed_observation_shape(self, shape):
+        for c in self.connectors:
+            shape = c.transformed_observation_shape(shape)
+        return tuple(shape)
+
+    def on_episode_boundaries(self, done_mask):
+        for c in self.connectors:
+            c.on_episode_boundaries(done_mask)
+
+    def on_final_observations(self, obs, env_indices):
+        for c in self.connectors:
+            obs = c.on_final_observations(obs, env_indices)
+        return obs
 
     def get_state(self):
         return {str(i): c.get_state() for i, c in enumerate(self.connectors)}
@@ -224,3 +262,115 @@ class ObsClip(ConnectorV2):
 
     def on_observations(self, obs):
         return np.clip(obs, -self.bound, self.bound)
+
+
+class ImagePreprocess(ConnectorV2):
+    """Atari-style image pipeline: grayscale + nearest-neighbor resize
+    + scale to [0, 1] (reference: `atari_wrappers.py` WarpFrame /
+    `wrap_atari_for_new_api_stack:324`), in vectorized numpy on
+    [B, H, W, C] frames."""
+
+    def __init__(self, size: int = 84, grayscale: bool = True,
+                 scale: float = 1.0 / 255.0):
+        self.size = size
+        self.grayscale = grayscale
+        self.scale = scale
+
+    def transformed_observation_shape(self, shape):
+        h, w, c = shape
+        return (self.size, self.size, 1 if self.grayscale else c)
+
+    def on_observations(self, obs):
+        obs = np.asarray(obs, np.float32)
+        if self.grayscale and obs.shape[-1] != 1:
+            if obs.shape[-1] == 3:
+                # ITU-R 601 luma (what cv2.cvtColor uses in the ref)
+                obs = (obs @ np.array([0.299, 0.587, 0.114],
+                                      np.float32))[..., None]
+            else:
+                # keep the 1-channel shape contract for any input
+                # channel count (e.g. RGBA renders): plain mean
+                obs = obs.mean(axis=-1, keepdims=True)
+        h, w = obs.shape[1], obs.shape[2]
+        if (h, w) != (self.size, self.size):
+            ri = (np.arange(self.size) * h // self.size).clip(0, h - 1)
+            ci = (np.arange(self.size) * w // self.size).clip(0, w - 1)
+            obs = obs[:, ri[:, None], ci[None, :], :]
+        if self.scale != 1.0:
+            obs = obs * self.scale
+        return obs.astype(np.float32)
+
+
+class FrameStack(ConnectorV2):
+    """Stack the last `k` frames along the channel axis (reference:
+    `atari_wrappers.py` FrameStackEnv / the frame-stacking connector in
+    `wrap_atari_for_new_api_stack`).  Per-sub-env buffers reset at
+    episode boundaries via `on_episode_boundaries`; bootstrap/final
+    observations (recognized by batch size != num live buffers only
+    when the runner passes a subset) are stacked against the current
+    buffers WITHOUT advancing them."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._frames = None  # [B, H, W, C*k] rolling buffer
+        self._pending_reset = None  # done mask applied on next obs
+
+    def transformed_observation_shape(self, shape):
+        h, w, c = shape
+        return (h, w, c * self.k)
+
+    def on_observations(self, obs):
+        obs = np.asarray(obs, np.float32)
+        b, h, w, c = obs.shape
+        if self._frames is None or self._frames.shape[0] != b:
+            # first batch (or a bootstrap subset before any full batch):
+            # initialize by repeating the frame k times
+            stacked = np.tile(obs, (1, 1, 1, self.k))
+            if self._frames is None and b > 0:
+                self._frames = stacked.copy()
+            return stacked
+        if self._pending_reset is not None:
+            # sub-envs that finished last step start a fresh stack with
+            # their reset frame repeated
+            m = self._pending_reset
+            self._frames[m] = np.tile(obs[m], (1, 1, 1, self.k))
+            self._pending_reset = None
+            keep = ~m
+        else:
+            keep = np.ones(b, np.bool_)
+        # shift one frame: drop oldest channels, append the new frame
+        self._frames[keep] = np.concatenate(
+            [self._frames[keep][..., c:], obs[keep]], axis=-1
+        )
+        return self._frames.copy()
+
+    def on_final_observations(self, final_obs: np.ndarray,
+                              env_indices: np.ndarray) -> np.ndarray:
+        """Stack final/bootstrap observations against the CURRENT
+        per-env buffers without advancing them."""
+        final_obs = np.asarray(final_obs, np.float32)
+        c = final_obs.shape[-1]
+        if self._frames is None:
+            return np.tile(final_obs, (1, 1, 1, self.k))
+        cur = self._frames[env_indices]
+        return np.concatenate([cur[..., c:], final_obs], axis=-1)
+
+    def on_episode_boundaries(self, done_mask):
+        done_mask = np.asarray(done_mask, np.bool_)
+        if done_mask.any():
+            self._pending_reset = done_mask.copy()
+
+
+def wrap_atari_connectors(size: int = 84, grayscale: bool = True,
+                          frame_stack: int = 4,
+                          clip_rewards: bool = True) -> ConnectorPipeline:
+    """The standard Atari pixel pipeline as one connector stack
+    (reference: `atari_wrappers.py:324` wrap_atari_for_new_api_stack:
+    warp + scale + frame-stack + reward clip)."""
+    stages: List[ConnectorV2] = [
+        ImagePreprocess(size=size, grayscale=grayscale),
+        FrameStack(frame_stack),
+    ]
+    if clip_rewards:
+        stages.append(RewardClip(1.0))
+    return ConnectorPipeline(stages)

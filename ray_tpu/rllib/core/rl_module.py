@@ -81,6 +81,176 @@ class MLPModule(RLModule):
         return logits, value
 
 
+def conv_out_dims(h: int, w: int,
+                  conv_filters) -> List[Tuple[int, int]]:
+    """Per-layer output spatial dims of a SAME-padded strided conv
+    stack (XLA's ceil-division semantics), input dims first."""
+    dims = [(h, w)]
+    for _c, _k, s in conv_filters:
+        h, w = -(-h // s), -(-w // s)
+        dims.append((h, w))
+    return dims
+
+
+def conv_stack_init(rng, in_channels: int, conv_filters):
+    """He-initialized HWIO conv weights for one stack (shared by every
+    conv-using module family so layout changes happen exactly once)."""
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    c_in = in_channels
+    for c_out, k, _s in conv_filters:
+        rng, key = jax.random.split(rng)
+        layers.append({
+            "w": jax.random.normal(key, (k, k, c_in, c_out), jnp.float32)
+            * float(np.sqrt(2.0 / (k * k * c_in))),
+            "b": jnp.zeros((c_out,), jnp.float32),
+        })
+        c_in = c_out
+    return layers
+
+
+def conv_stack_apply(conv_params, x, conv_filters, activation):
+    """SAME-padded strided conv stack, NHWC (XLA tiles it on the MXU);
+    `activation` applied after every layer."""
+    import jax
+
+    for lyr, (_c, _k, s) in zip(conv_params, conv_filters):
+        x = jax.lax.conv_general_dilated(
+            x, lyr["w"], window_strides=(s, s), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + lyr["b"]
+        x = activation(x)
+    return x
+
+
+class CNNModule(RLModule):
+    """Conv encoder + MLP heads for image observations.
+
+    Reference: `rllib/core/models/configs.py:653` (`CNNEncoderConfig`)
+    and `rllib/core/models/torch/encoder.py:107` (`TorchCNNEncoder`) —
+    a conv stack shared by the pi and vf heads.  TPU-native split: the
+    jax path uses `lax.conv_general_dilated` in NHWC (XLA lowers it
+    onto the MXU); the numpy mirror (env runners) uses im2col +
+    one matmul per layer so CPU rollouts stay vectorized.
+
+    `conv_filters`: sequence of (out_channels, kernel, stride) — the
+    reference's default_model_config conv_filters shape.
+    """
+
+    def __init__(self, observation_shape: Tuple[int, int, int],
+                 num_actions: int,
+                 conv_filters: Tuple[Tuple[int, int, int], ...] = (
+                     (16, 4, 2), (32, 4, 2), (64, 3, 2),
+                 ),
+                 hidden: Tuple[int, ...] = (256,)):
+        if len(observation_shape) != 3:
+            raise ValueError(
+                f"CNNModule needs (H, W, C) observations, got "
+                f"{observation_shape}"
+            )
+        self.observation_shape = tuple(observation_shape)
+        self.num_actions = num_actions
+        self.conv_filters = tuple(tuple(f) for f in conv_filters)
+        self.hidden = tuple(hidden)
+        h, w = conv_out_dims(observation_shape[0], observation_shape[1],
+                             self.conv_filters)[-1]
+        self._flat = h * w * self.conv_filters[-1][0]
+
+    # -- init ----------------------------------------------------------
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        rng, k_conv = jax.random.split(rng)
+        params: Dict[str, Any] = {
+            "conv": conv_stack_init(
+                k_conv, self.observation_shape[-1], self.conv_filters
+            ),
+            "dense": [],
+        }
+        dims = [self._flat, *self.hidden]
+        for m, n in zip(dims[:-1], dims[1:]):
+            rng, key = jax.random.split(rng)
+            params["dense"].append({
+                "w": jax.random.normal(key, (m, n), jnp.float32)
+                * float(np.sqrt(2.0 / m)),
+                "b": jnp.zeros((n,), jnp.float32),
+            })
+        feat = dims[-1]
+        rng, k_pi, k_vf = jax.random.split(rng, 3)
+        params["pi"] = {
+            "w": jax.random.normal(k_pi, (feat, self.num_actions),
+                                   jnp.float32) * 0.01,
+            "b": jnp.zeros((self.num_actions,), jnp.float32),
+        }
+        params["vf"] = {
+            "w": jax.random.normal(k_vf, (feat, 1), jnp.float32) * 0.01,
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+        return params
+
+    # -- forward -------------------------------------------------------
+    def _encode_jax(self, params, x):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, jnp.float32)
+        x = conv_stack_apply(
+            params["conv"], x, self.conv_filters,
+            lambda y: jnp.maximum(y, 0.0),
+        )
+        x = x.reshape(x.shape[0], -1)
+        for lyr in params["dense"]:
+            x = jnp.maximum(x @ lyr["w"] + lyr["b"], 0.0)
+        return x
+
+    def forward_train(self, params, obs):
+        feat = self._encode_jax(params, obs)
+        logits = feat @ params["pi"]["w"] + params["pi"]["b"]
+        value = (feat @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    def _encode_numpy(self, params_np, x):
+        x = np.asarray(x, np.float32)
+        for lyr, (_c, k, s) in zip(params_np["conv"], self.conv_filters):
+            x = _conv2d_numpy(x, lyr["w"], lyr["b"], k, s)
+            np.maximum(x, 0.0, out=x)
+        x = x.reshape(x.shape[0], -1)
+        for lyr in params_np["dense"]:
+            x = np.maximum(x @ lyr["w"] + lyr["b"], 0.0)
+        return x
+
+    def forward_numpy(self, params_np, obs: np.ndarray):
+        feat = self._encode_numpy(params_np, obs)
+        logits = feat @ params_np["pi"]["w"] + params_np["pi"]["b"]
+        value = (feat @ params_np["vf"]["w"] + params_np["vf"]["b"])[..., 0]
+        return logits, value
+
+
+def _conv2d_numpy(x: np.ndarray, w: np.ndarray, b: np.ndarray,
+                  k: int, s: int) -> np.ndarray:
+    """SAME-padded strided conv, NHWC x HWIO -> NHWC, via im2col +
+    one matmul (the numpy mirror of the jax path above)."""
+    n, h, win, c_in = x.shape
+    h_out = -(-h // s)
+    w_out = -(-win // s)
+    # SAME padding totals (mirrors XLA's computation)
+    pad_h = max((h_out - 1) * s + k - h, 0)
+    pad_w = max((w_out - 1) * s + k - win, 0)
+    x = np.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                   (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    sN, sH, sW, sC = x.strides
+    cols = np.lib.stride_tricks.as_strided(
+        x,
+        shape=(n, h_out, w_out, k, k, c_in),
+        strides=(sN, sH * s, sW * s, sH, sW, sC),
+        writeable=False,
+    ).reshape(n * h_out * w_out, k * k * c_in)
+    out = cols @ w.reshape(k * k * c_in, -1) + b
+    return out.reshape(n, h_out, w_out, -1)
+
+
 def tower_jax(layers, x):
     """The MLP tower forward — ONE definition for jax (and mirrored in
     tower_numpy); matmul+tanh layout changes happen here only."""
@@ -99,6 +269,62 @@ def tower_numpy(layers, x):
         if i < len(layers) - 1:
             x = np.tanh(x)
     return x
+
+
+def make_default_module(spec: Dict[str, Any],
+                        model_cfg: Dict[str, Any]) -> RLModule:
+    """Pick the default architecture from the env spec (reference:
+    `rllib/core/rl_module/default_model_config.py` — conv encoder for
+    image spaces, fcnet otherwise).  `spec` is an EnvRunner env_spec;
+    `model_cfg` is AlgorithmConfig.model."""
+    require_discrete_actions(spec, "the default policy-gradient module")
+    obs_shape = tuple(
+        spec.get("observation_shape", (spec["observation_size"],))
+    )
+    if len(obs_shape) == 3 or "conv_filters" in model_cfg:
+        return CNNModule(
+            obs_shape, spec["num_actions"],
+            conv_filters=tuple(
+                model_cfg.get(
+                    "conv_filters", ((16, 4, 2), (32, 4, 2), (64, 3, 2))
+                )
+            ),
+            hidden=tuple(model_cfg.get("hidden", (256,))),
+        )
+    return MLPModule(
+        spec["observation_size"], spec["num_actions"],
+        hidden=tuple(model_cfg.get("hidden", (64, 64))),
+    )
+
+
+def require_flat_obs(spec: Dict[str, Any], algo_name: str) -> None:
+    """Fail fast (at setup, with a clear message) for algorithms whose
+    module/replay path is MLP-only: without this, an image env dies
+    with an opaque matmul shape error inside a runner actor that the
+    fault-tolerant sample loop then masks as 'all env runners
+    failed'."""
+    shape = tuple(spec.get("observation_shape",
+                           (spec["observation_size"],)))
+    if len(shape) != 1:
+        raise ValueError(
+            f"{algo_name} supports flat observations only (got "
+            f"observation_shape={shape}); for pixel envs use "
+            "PPO/APPO/IMPALA (CNN encoder) or DreamerV3 (conv world "
+            "model), or flatten with a connector"
+        )
+
+
+def require_discrete_actions(spec: Dict[str, Any],
+                             algo_name: str) -> None:
+    """Fail fast for discrete-only algorithms on continuous-action
+    envs: without this, num_actions=0 builds a zero-width policy head
+    that dies with an opaque reduction error inside a runner actor."""
+    if spec.get("continuous"):
+        raise ValueError(
+            f"{algo_name} supports discrete action spaces only (env "
+            f"reports continuous action_dim={spec.get('action_dim')}); "
+            "use SAC for continuous control"
+        )
 
 
 def params_to_numpy(params) -> Any:

@@ -57,20 +57,43 @@ class EnvRunner:
     def get_weights_version(self) -> int:
         return self._weights_version
 
-    def env_spec(self) -> Dict[str, int]:
+    def env_spec(self) -> Dict[str, Any]:
+        raw_shape = self._env.observation_shape
+        # what the MODULE sees: the connector's static shape mapping
+        # applied to the raw env shape (reference: connectors recompute
+        # the module spec's observation space)
+        shape = (
+            tuple(self._connector.transformed_observation_shape(raw_shape))
+            if self._connector is not None else tuple(raw_shape)
+        )
         return {
-            "observation_size": self._env.observation_size,
+            "observation_size": int(np.prod(shape)),
+            "observation_shape": shape,
+            "raw_observation_shape": tuple(raw_shape),
             "num_actions": self._env.num_actions,
             "num_envs": self._env.num_envs,
+            "continuous": bool(getattr(self._env, "continuous", False)),
+            "action_dim": int(getattr(self._env, "action_dim", 0)),
+            "action_low": float(getattr(self._env, "action_low", -1.0)),
+            "action_high": float(getattr(self._env, "action_high", 1.0)),
         }
 
     # -- sampling (HOT LOOP of the RL stack) --------------------------
     def sample(self, module_def, explore=None) -> Dict[str, np.ndarray]:
         assert self._params is not None, "set_weights before sample"
         T, B = self._T, self._env.num_envs
-        D = self._env.observation_size
-        obs_buf = np.empty((T, B, D), np.float32)
-        act_buf = np.empty((T, B), np.int32)
+        spec = self.env_spec()
+        shape = spec["observation_shape"]
+        continuous = spec["continuous"]
+        obs_buf = np.empty((T, B, *shape), np.float32)
+        # continuous actions are [-1, 1]^A module outputs, rescaled to
+        # the env's bounds only at the step boundary — the learner
+        # trains on exactly what the policy emitted
+        act_buf = (
+            np.empty((T, B, spec["action_dim"]), np.float32)
+            if continuous else np.empty((T, B), np.int32)
+        )
+        lo, hi = spec["action_low"], spec["action_high"]
         logp_buf = np.empty((T, B), np.float32)
         val_buf = np.empty((T, B), np.float32)
         rew_buf = np.empty((T, B), np.float32)
@@ -98,11 +121,15 @@ class EnvRunner:
                 else:
                     obs = conn.on_observations(obs)
             if select is not None:
-                # module-defined exploration (e.g. epsilon-greedy DQN)
+                # module-defined exploration (epsilon-greedy DQN,
+                # squashed-Gaussian sampling for continuous SAC)
                 actions, logp, value = select(
                     self._params, obs, self._rng, explore
                 )
-                actions = actions.astype(np.int32)
+                actions = (
+                    actions.astype(np.float32) if continuous
+                    else actions.astype(np.int32)
+                )
             else:
                 logits, value = module_def.forward_numpy(self._params, obs)
                 probs = _softmax(logits)
@@ -114,6 +141,9 @@ class EnvRunner:
             env_actions = (
                 conn.on_actions(actions) if conn is not None else actions
             )
+            if continuous:
+                # linear map [-1, 1] -> [low, high]
+                env_actions = lo + (env_actions + 1.0) * 0.5 * (hi - lo)
             next_obs, rewards, terminated, truncated, info = self._env.step(
                 env_actions
             )
@@ -129,9 +159,15 @@ class EnvRunner:
             if truncated.any():
                 final = info["final_observation"][truncated]
                 if conn is not None:
-                    final = conn.on_observations(final)
+                    # subset path: temporal connectors (frame stack)
+                    # read their per-env state without advancing it
+                    final = conn.on_final_observations(
+                        final, np.flatnonzero(truncated)
+                    )
                 _, fv = module_def.forward_numpy(self._params, final)
                 boot_buf[t, truncated] = fv
+            if conn is not None and done.any():
+                conn.on_episode_boundaries(done)
             # episode metrics
             self._ep_return += rewards
             self._ep_len += 1

@@ -918,3 +918,347 @@ def test_dreamer_trains_on_cartpole(cluster):
         assert last["wm_loss"] < results[0]["wm_loss"], results
     finally:
         algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# pixel observations: CNN modules, image connectors, pixel learning
+# (reference: rllib/core/models/configs.py CNNEncoderConfig +
+#  rllib/env/wrappers/atari_wrappers.py wrap_atari_for_new_api_stack)
+# ---------------------------------------------------------------------------
+def test_catch_pixel_env():
+    from ray_tpu.rllib.env.envs import CatchPixelEnv
+
+    env = CatchPixelEnv(num_envs=4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 10, 5, 1) and obs.dtype == np.float32
+    assert env.observation_shape == (10, 5, 1)
+    total_reward = 0.0
+    for _ in range(27):  # 3 episodes of 9 steps
+        obs, rew, term, trunc, info = env.step(np.ones(4, np.int64))
+        # exactly a ball and a paddle pixel per frame (may overlap)
+        on = obs.reshape(4, -1).sum(axis=1)
+        assert ((on == 2.0) | (on == 1.0)).all()
+        if term.any():
+            assert "final_observation" in info
+            total_reward += rew[term].sum()
+    assert total_reward != 0.0  # catches/misses actually scored
+
+
+def test_cnn_module_jax_numpy_parity():
+    import jax
+
+    from ray_tpu.rllib.core.rl_module import CNNModule
+
+    m = CNNModule((10, 5, 1), 3, conv_filters=((8, 3, 2), (16, 3, 2)),
+                  hidden=(32,))
+    params = m.init_params(jax.random.PRNGKey(0))
+    obs = np.random.default_rng(0).random((6, 10, 5, 1), dtype=np.float32)
+    lj, vj = m.forward_train(params, obs)
+    pn = params_to_numpy(params)
+    ln, vn = m.forward_numpy(pn, obs)
+    np.testing.assert_allclose(np.asarray(lj), ln, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(vj), vn, atol=1e-4)
+
+
+def test_make_default_module_picks_cnn_for_images():
+    from ray_tpu.rllib.core.rl_module import (
+        CNNModule, MLPModule, make_default_module,
+    )
+
+    cnn = make_default_module(
+        {"observation_size": 50, "observation_shape": (10, 5, 1),
+         "num_actions": 3}, {})
+    assert isinstance(cnn, CNNModule)
+    mlp = make_default_module(
+        {"observation_size": 4, "observation_shape": (4,),
+         "num_actions": 2}, {})
+    assert isinstance(mlp, MLPModule)
+
+
+def test_image_preprocess_connector():
+    from ray_tpu.rllib.connectors import ImagePreprocess
+
+    c = ImagePreprocess(size=8, grayscale=True)
+    assert c.transformed_observation_shape((21, 16, 3)) == (8, 8, 1)
+    frames = np.full((2, 21, 16, 3), 255.0, np.float32)
+    out = c.on_observations(frames)
+    assert out.shape == (2, 8, 8, 1)
+    np.testing.assert_allclose(out, 1.0, atol=1e-5)  # 255 -> 1.0 gray
+
+
+def test_frame_stack_connector_semantics():
+    from ray_tpu.rllib.connectors import FrameStack
+
+    fs = FrameStack(3)
+    assert fs.transformed_observation_shape((4, 4, 1)) == (4, 4, 3)
+    f = lambda v: np.full((2, 4, 4, 1), float(v), np.float32)
+    # first obs repeats into all k slots
+    s1 = fs.on_observations(f(1))
+    np.testing.assert_array_equal(s1[..., 0], f(1)[..., 0])
+    np.testing.assert_array_equal(s1[..., 2], f(1)[..., 0])
+    # second obs shifts: [1, 1, 2]
+    s2 = fs.on_observations(f(2))
+    assert s2[0, 0, 0, 1] == 1.0 and s2[0, 0, 0, 2] == 2.0
+    # bootstrap/final path stacks WITHOUT advancing state
+    fin = fs.on_final_observations(f(9)[:1], np.array([0]))
+    assert fin[0, 0, 0, 2] == 9.0
+    s3 = fs.on_observations(f(3))
+    assert s3[0, 0, 0, 2] == 3.0 and s3[0, 0, 0, 1] == 2.0
+    assert (s3[..., 0] == 1.0).all()  # the 9 never entered the buffer
+    # episode boundary: env 0 resets, env 1 keeps its stack
+    fs.on_episode_boundaries(np.array([True, False]))
+    s4 = fs.on_observations(f(4))
+    assert (s4[0, ..., 0] == 4.0).all()  # fresh stack = repeat
+    assert s4[1, 0, 0, 0] == 2.0  # old history retained
+
+
+def test_frame_stack_multichannel_layout():
+    """Stacks are whole-frame blocks [f1|f2|f3], never per-channel
+    interleaving — a regression guard for multi-channel (RGB) frames."""
+    from ray_tpu.rllib.connectors import FrameStack
+
+    fs = FrameStack(2)
+    f1 = np.zeros((1, 2, 2, 2), np.float32)
+    f1[..., 0], f1[..., 1] = 1.0, 2.0  # frame1 channels (a=1, b=2)
+    f2 = np.zeros((1, 2, 2, 2), np.float32)
+    f2[..., 0], f2[..., 1] = 3.0, 4.0
+    s1 = fs.on_observations(f1)
+    np.testing.assert_array_equal(s1[0, 0, 0], [1, 2, 1, 2])  # [f1|f1]
+    s2 = fs.on_observations(f2)
+    np.testing.assert_array_equal(s2[0, 0, 0], [1, 2, 3, 4])  # [f1|f2]
+    # reset path keeps block layout too
+    fs.on_episode_boundaries(np.array([True]))
+    s3 = fs.on_observations(f1)
+    np.testing.assert_array_equal(s3[0, 0, 0], [1, 2, 1, 2])
+
+
+def test_mlp_only_algos_fail_fast_on_pixels(cluster):
+    """DQN/SAC replay+module paths are flat-obs-only: image envs must
+    fail at setup with a clear message, not an opaque runner crash."""
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+    cfg = (DQNConfig().environment("Catch-v0")
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                        rollout_fragment_length=8))
+    with pytest.raises(ValueError, match="flat observations"):
+        cfg.build()
+
+
+def test_ppo_learns_pixel_catch(cluster):
+    """BASELINE config #3 analog: PPO with the CNN encoder learns a
+    pixel env end-to-end (ALE isn't installable here; Catch is the
+    procedural stand-in)."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    cfg = (PPOConfig()
+           .environment("Catch-v0")
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=16,
+                        rollout_fragment_length=32)
+           .training(lr=1e-3, minibatch_size=256, num_epochs=4,
+                     model={"conv_filters": ((16, 3, 2), (32, 3, 2)),
+                            "hidden": (128,)})
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        from ray_tpu.rllib.core.rl_module import CNNModule
+
+        assert isinstance(algo.module, CNNModule)
+        best = -1.0
+        for _ in range(45):
+            r = algo.train()
+            ret = r.get("episode_return_mean")
+            if ret is not None and np.isfinite(ret):
+                best = max(best, ret)
+            if best > 0.6:
+                break
+        # random play scores about -0.6; a learned paddle catches most
+        assert best > 0.4, best
+    finally:
+        algo.stop()
+
+
+def test_frame_stacked_ppo_runs(cluster):
+    """The full Atari-style connector pipeline (preprocess + stack +
+    reward clip) rides through remote runners and the learner trains on
+    stacked frames."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.connectors import wrap_atari_connectors
+
+    def conn():
+        return wrap_atari_connectors(size=10, grayscale=False,
+                                     frame_stack=2, clip_rewards=True)
+
+    cfg = (PPOConfig()
+           .environment("Catch-v0")
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                        rollout_fragment_length=16,
+                        env_to_module_connector=conn)
+           .training(lr=1e-3, minibatch_size=128, num_epochs=2,
+                     model={"conv_filters": ((8, 3, 2),), "hidden": (64,)})
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        spec = algo.env_runner_group.env_spec()
+        assert spec["observation_shape"] == (10, 10, 2)
+        r = algo.train()
+        assert np.isfinite(r["total_loss"])
+    finally:
+        algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# continuous actions (reference: rllib/algorithms/sac/ continuous path)
+# ---------------------------------------------------------------------------
+def test_pendulum_vector_env():
+    from ray_tpu.rllib.env.envs import PendulumVectorEnv
+
+    env = PendulumVectorEnv(num_envs=4, seed=0)
+    assert env.continuous and env.action_dim == 1
+    obs = env.reset()
+    assert obs.shape == (4, 3)
+    for _ in range(200):
+        obs, rew, term, trunc, info = env.step(
+            np.zeros((4, 1), np.float32)
+        )
+        assert (rew <= 0).all()  # Pendulum cost is always >= 0
+        assert np.isfinite(obs).all()
+    assert trunc.all() and "final_observation" in info  # 200-step limit
+
+
+def test_continuous_sac_learns_target_env(cluster):
+    from ray_tpu.rllib.algorithms.sac import (
+        ContinuousSACModule, SACConfig,
+    )
+    from ray_tpu.rllib.env.envs import ContinuousTargetEnv
+
+    cfg = (SACConfig()
+           .environment(lambda num_envs, seed, **kw: ContinuousTargetEnv(
+               num_envs=num_envs, seed=seed))
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=16,
+                        rollout_fragment_length=8)
+           .debugging(seed=0))
+    cfg.lr = 3e-3
+    cfg.num_updates_per_iter = 64
+    algo = cfg.build()
+    try:
+        assert isinstance(algo.module, ContinuousSACModule)
+        best = -10.0
+        for _ in range(30):
+            r = algo.train()
+            ret = r.get("episode_return_mean")
+            if ret is not None and np.isfinite(ret):
+                best = max(best, ret)
+            if best > -0.05:
+                break
+        # optimal return is 0 (a == x); random actions score ~ -1.3
+        assert best > -0.15, best
+        assert r["alpha"] < 0.9  # temperature auto-tuned downward
+    finally:
+        algo.stop()
+
+
+def test_continuous_sac_checkpoint_roundtrip(cluster):
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+    from ray_tpu.rllib.env.envs import ContinuousTargetEnv
+
+    cfg = (SACConfig()
+           .environment(lambda num_envs, seed, **kw: ContinuousTargetEnv(
+               num_envs=num_envs, seed=seed))
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                        rollout_fragment_length=4)
+           .debugging(seed=0))
+    cfg.num_updates_per_iter = 4
+    cfg.learn_batch_size = 32  # one rollout (8 envs x 4) fills it
+    algo = cfg.build()
+    try:
+        algo.train()
+        state = algo.get_state()
+        algo.set_state(state)  # roundtrips (shapes/dtypes consistent)
+        r = algo.train()
+        assert np.isfinite(r["critic_loss"])
+    finally:
+        algo.stop()
+
+
+def test_dreamer_pixel_world_model(cluster):
+    """DreamerV3 pixel mode: conv encoder + deconv decoder learn the
+    frames (recon falls) and the imagination policy beats random."""
+    from ray_tpu.rllib.algorithms.dreamer import DreamerConfig
+
+    cfg = DreamerConfig()
+    cfg.environment("Catch-v0")
+    cfg.env_runners(num_env_runners=1, num_envs_per_env_runner=16,
+                    rollout_fragment_length=16)
+    cfg.debugging(seed=0)
+    cfg.conv_filters = ((8, 3, 2), (16, 3, 2))
+    cfg.deter_size = 64
+    cfg.lr = 1e-3
+    cfg.batch_length = 9
+    cfg.batch_segments = 16
+    cfg.num_updates_per_iter = 16
+    algo = cfg.build()
+    try:
+        assert algo.model.pixel
+        first = algo.train()
+        best = -1.0
+        for _ in range(19):
+            r = algo.train()
+            ret = r.get("episode_return_mean")
+            if ret is not None and np.isfinite(ret):
+                best = max(best, ret)
+        # all-zero prediction scores ~2.0; the decoder must clearly
+        # beat it, and the policy must beat random (~ -0.6)
+        assert r["recon_loss"] < first["recon_loss"] * 0.95
+        assert r["recon_loss"] < 1.9, r["recon_loss"]
+        assert best > -0.55, best
+    finally:
+        algo.stop()
+
+
+def test_bc_checkpoint_keeps_connector_state(cluster):
+    """A restored offline run keeps MeanStdObsFilter statistics
+    (previously dropped: get_state returned only the learner)."""
+    from ray_tpu.rllib.algorithms.bc import BCConfig
+    from ray_tpu.rllib.connectors import ConnectorPipeline, MeanStdObsFilter
+
+    rng = np.random.default_rng(0)
+    dataset = {
+        "obs": rng.normal(size=(256, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, 256).astype(np.int32),
+    }
+
+    def conn():
+        return ConnectorPipeline([MeanStdObsFilter()])
+
+    cfg = (BCConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                        rollout_fragment_length=8,
+                        env_to_module_connector=conn)
+           .debugging(seed=0))
+    cfg.offline_data(input_=dataset)
+    cfg.evaluation_interval = 1
+    algo = cfg.build()
+    try:
+        algo.train()  # evaluation rollout populates filter stats
+        state = algo.get_state()
+        assert state.get("connector"), state.keys()
+        merged = state["connector"]["0"]
+        assert merged.get("count", 0) > 0
+        cfg2 = (BCConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                             rollout_fragment_length=8,
+                             env_to_module_connector=conn)
+                .debugging(seed=1))
+        cfg2.offline_data(input_=dataset)
+        cfg2.evaluation_interval = 1
+        algo2 = cfg2.build()
+        try:
+            algo2.set_state(state)
+            restored = algo2.env_runner_group.connector_state()
+            assert restored["0"]["count"] == merged["count"]
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
