@@ -24,6 +24,7 @@ from ray_tpu.api import (
     is_initialized,
     is_started,
     kill,
+    method,
     nodes,
     put,
     remote,
@@ -58,6 +59,7 @@ __all__ = [
     "is_initialized",
     "is_started",
     "kill",
+    "method",
     "nodes",
     "put",
     "remote",

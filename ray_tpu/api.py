@@ -328,7 +328,8 @@ class ActorHandle:
 
     def __init__(self, actor_id: ActorID, address, class_name: str,
                  max_task_retries: int = 0,
-                 streaming_methods: Tuple[str, ...] = ()):
+                 streaming_methods: Tuple[str, ...] = (),
+                 method_groups: Optional[Dict[str, str]] = None):
         self._actor_id = actor_id
         self._address = address  # (node_id, worker_id)
         self._class_name = class_name
@@ -336,11 +337,14 @@ class ActorHandle:
         # method names defined as (async) generators: their calls
         # stream by default, like generator remote functions
         self._streaming_methods = tuple(streaming_methods)
+        # @method(concurrency_group=...) defaults (reference: method
+        # metadata in the GCS actor table)
+        self._method_groups = dict(method_groups or {})
 
-    def _next_seq(self) -> int:
+    def _next_seq(self, group: Optional[str] = None) -> int:
         from ray_tpu.core.runtime import next_actor_seq
 
-        return next_actor_seq(self._actor_id.binary())
+        return next_actor_seq(self._actor_id.binary(), group)
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -356,6 +360,7 @@ class ActorHandle:
                 self._class_name,
                 self._max_task_retries,
                 self._streaming_methods,
+                self._method_groups,
             ),
         )
 
@@ -364,9 +369,9 @@ class ActorHandle:
 
 
 def _rebuild_handle(aid_bytes, address, class_name, max_task_retries,
-                    streaming_methods=()):
+                    streaming_methods=(), method_groups=None):
     return ActorHandle(ActorID(aid_bytes), address, class_name,
-                       max_task_retries, streaming_methods)
+                       max_task_retries, streaming_methods, method_groups)
 
 
 class ActorClass:
@@ -379,8 +384,10 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         # streaming-method discovery lives in create_actor (recorded in
         # the spec so get_actor-rebuilt handles agree with this one)
-        actor_id, address, streaming = get_runtime().create_actor(
-            self._cls, list(args), kwargs, **self._options
+        actor_id, address, streaming, method_groups = (
+            get_runtime().create_actor(
+                self._cls, list(args), kwargs, **self._options
+            )
         )
         return ActorHandle(
             actor_id,
@@ -388,6 +395,7 @@ class ActorClass:
             self._cls.__name__,
             self._options.get("max_task_retries", 0),
             streaming,
+            method_groups,
         )
 
     def options(self, **opts) -> "ActorClass":
@@ -397,6 +405,28 @@ class ActorClass:
 
     def __call__(self, *a, **k):
         raise TypeError("Actor class cannot be instantiated directly; use .remote()")
+
+
+def method(**options):
+    """@method decorator for actor methods (reference: `ray.method`):
+    records per-method defaults — currently `concurrency_group` — that
+    calls inherit unless overridden via `.options(...)`.
+
+    @rt.remote(concurrency_groups={"io": 2})
+    class A:
+        @rt.method(concurrency_group="io")
+        def fetch(self): ...
+    """
+    allowed = {"concurrency_group"}
+    unknown = set(options) - allowed
+    if unknown:
+        raise TypeError(f"@method got unknown options {sorted(unknown)}")
+
+    def _wrap(fn):
+        fn.__rt_method_options__ = dict(options)
+        return fn
+
+    return _wrap
 
 
 def remote(*args, **options):
@@ -430,6 +460,7 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
         name,
         info.get("max_task_retries", 0),
         tuple(info.get("streaming_methods", ())),
+        info.get("method_groups"),
     )
 
 

@@ -550,6 +550,9 @@ class Controller:
             "streaming_methods": tuple(
                 getattr(info.spec, "streaming_methods", ()) or ()
             ),
+            "method_groups": dict(
+                getattr(info.spec, "method_groups", None) or {}
+            ),
             "death_cause": info.death_cause,
         }
 

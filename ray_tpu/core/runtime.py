@@ -151,14 +151,19 @@ class _PendingTask:
 # Process-wide per-actor sequence numbers: every caller path (handles,
 # lineage reconstruction) draws from the same counter so the executor's
 # in-order delivery sees one consistent stream per caller process.
-_actor_seq_counters: Dict[bytes, int] = {}
+_actor_seq_counters: Dict[Tuple[bytes, Optional[str]], int] = {}
 _actor_seq_lock = threading.Lock()
 
 
-def next_actor_seq(aid: bytes) -> int:
+def next_actor_seq(aid: bytes, group: Optional[str] = None) -> int:
+    """Per-(actor, concurrency-group) sequence counter: each group is
+    its own ordered stream, so a gap in one lane never stalls another
+    (reference: per-group scheduling queues in
+    `concurrency_group_manager.h`)."""
     with _actor_seq_lock:
-        n = _actor_seq_counters.get(aid, 0)
-        _actor_seq_counters[aid] = n + 1
+        key = (aid, group)
+        n = _actor_seq_counters.get(key, 0)
+        _actor_seq_counters[key] = n + 1
         return n
 
 
@@ -245,8 +250,10 @@ class Runtime:
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._actor_aspec: Optional[ActorCreationSpec] = None
-        self._actor_seq_expect: Dict[str, int] = {}
-        self._actor_seq_buffer: Dict[str, Dict[int, TaskSpec]] = {}
+        # keyed by (caller_worker_id, concurrency_group): one ordered
+        # delivery stream per lane
+        self._actor_seq_expect: Dict[tuple, int] = {}
+        self._actor_seq_buffer: Dict[tuple, Dict[int, TaskSpec]] = {}
         self._actor_drain_lock: Optional[asyncio.Lock] = None
         self._put_counter = 0
         self._task_local = threading.local()
@@ -433,6 +440,8 @@ class Runtime:
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._io_thread.join(timeout=5)
         self._exec_pool.shutdown(wait=False)
+        for pool in getattr(self, "_group_pools", {}).values():
+            pool.shutdown(wait=False)
         if self.store:
             for id_bytes in self._held_pins:
                 try:
@@ -1148,6 +1157,28 @@ class Runtime:
             and (_inspect.isgeneratorfunction(getattr(cls, m, None))
                  or _inspect.isasyncgenfunction(getattr(cls, m, None)))
         )
+        # @rt.method(concurrency_group=...) defaults, recorded in the
+        # spec so get_actor-rebuilt handles route the same lanes
+        method_groups = {
+            m: getattr(cls, m).__rt_method_options__["concurrency_group"]
+            for m in dir(cls)
+            if not m.startswith("_")
+            and getattr(getattr(cls, m, None),
+                        "__rt_method_options__", {}).get("concurrency_group")
+        }
+        concurrency_groups = dict(options.get("concurrency_groups") or {})
+        for name, limit in concurrency_groups.items():
+            if not isinstance(limit, int) or limit < 1:
+                raise ValueError(
+                    f"concurrency_groups[{name!r}] must be a positive "
+                    f"int, got {limit!r}"
+                )
+        for m, g in method_groups.items():
+            if g not in concurrency_groups:
+                raise ValueError(
+                    f"@method(concurrency_group={g!r}) on {m!r} names an "
+                    f"undeclared group; declare it in concurrency_groups"
+                )
         init_transit: list = []
         spec = ActorCreationSpec(
             actor_id=actor_id,
@@ -1163,13 +1194,21 @@ class Runtime:
             max_restarts=options.get("max_restarts", self.cfg.actor_max_restarts),
             max_task_retries=options.get("max_task_retries", 0),
             max_concurrency=options.get("max_concurrency", 1),
-            is_async=is_async or options.get("max_concurrency", 1) > 1,
+            # groups imply concurrent lanes -> event-loop dispatch
+            is_async=(is_async or options.get("max_concurrency", 1) > 1
+                      or bool(concurrency_groups)),
             name=options.get("name"),
             namespace=options.get("namespace", "default"),
             streaming_methods=streaming_methods,
             strategy=_strategy_from_options(options),
             lifetime=options.get("lifetime"),
             runtime_env=options.get("runtime_env"),
+            concurrency_groups=concurrency_groups or None,
+            method_groups=method_groups or None,
+            allow_out_of_order=bool(
+                options.get("allow_out_of_order_execution", False)
+            ),
+            has_async_methods=is_async,
         )
         try:
             reply = await self.controller.call("create_actor", spec)
@@ -1182,7 +1221,7 @@ class Runtime:
         if not reply.get("ok"):
             raise exc.RayTpuError(reply.get("error", "actor creation failed"))
         self._actor_addr[actor_id.binary()] = tuple(reply["address"])
-        return actor_id, reply["address"], streaming_methods
+        return actor_id, reply["address"], streaming_methods, method_groups
 
     def submit_actor_task(self, handle, method_name, args, kwargs, **options):
         aid = handle._actor_id.binary()
@@ -1193,6 +1232,16 @@ class Runtime:
         transit: list = []
         resolved, kwargs = self._resolve_args_kwargs(args, kwargs, transit)
         kwargs["__rt_method__"] = method_name
+        # per-call lane, or the @rt.method default recorded on the
+        # handle; rides a reserved kwarg so the TaskSpec wire schema
+        # stays unchanged.  An EXPLICIT concurrency_group=None routes
+        # to the default lane even when the method declares a default.
+        if "concurrency_group" in options:
+            group = options["concurrency_group"]
+        else:
+            group = getattr(handle, "_method_groups", {}).get(method_name)
+        if group is not None:
+            kwargs["__rt_group__"] = group
         spec = TaskSpec(
             task_id=task_id,
             function_id=b"",
@@ -1206,7 +1255,7 @@ class Runtime:
             strategy=SchedulingStrategy(),
             name=f"{handle._class_name}.{method_name}",
             actor_id=handle._actor_id,
-            seq_no=handle._next_seq(),
+            seq_no=handle._next_seq(group),
         )
         from ray_tpu.util import tracing as _tracing
 
@@ -1634,7 +1683,9 @@ class Runtime:
             # the ordered actor queue with a fresh sequence number (the
             # original seq was consumed; replaying it would wedge the
             # executor's in-order delivery)
-            spec.seq_no = next_actor_seq(spec.actor_id.binary())
+            spec.seq_no = next_actor_seq(
+                spec.actor_id.binary(), spec.kwargs.get("__rt_group__")
+            )
             self._push_actor_task(spec.actor_id.binary(), spec)
         else:
             self._push_or_queue(spec)
@@ -2422,8 +2473,31 @@ class Runtime:
         cls = ser.loads(aspec.class_blob)
         self.actor_id = aspec.actor_id
         self._actor_aspec = aspec
+        groups = dict(aspec.concurrency_groups or {})
+        # per-group execution lanes (reference:
+        # `concurrency_group_manager.h`): each named group gets its OWN
+        # thread pool (sync methods) and a concurrency cap enforced by
+        # a single-consumer lane queue — dedicated pools mean a flooded
+        # default lane can never starve a group lane's threads, and the
+        # one-acquirer-per-lane queue gives FIFO start order without
+        # depending on asyncio.Semaphore waiter fairness.
+        self._group_limits: Dict[Optional[str], int] = dict(groups)
+        self._group_pools = {
+            g: ThreadPoolExecutor(max_workers=n) for g, n in groups.items()
+        }
+        # default-lane limit: SYNC actors keep max_concurrency even in
+        # out-of-order mode (order relaxed, concurrency kept).  Truly
+        # async actors keep the historical unbounded default lane —
+        # capping it at max_concurrency=1 would introduce exactly the
+        # head-of-line blocking these modes exist to remove.
+        if (groups or aspec.allow_out_of_order) \
+                and not aspec.has_async_methods:
+            self._group_limits[None] = aspec.max_concurrency
+        self._lane_queues: Dict[Optional[str], asyncio.Queue] = {}
         if aspec.max_concurrency > 1:
-            self._exec_pool = ThreadPoolExecutor(max_workers=aspec.max_concurrency)
+            self._exec_pool = ThreadPoolExecutor(
+                max_workers=aspec.max_concurrency
+            )
         args = [await self._materialize_arg(a) for a in aspec.init_args]
         kwargs = {
             k: await self._materialize_arg(v) for k, v in aspec.init_kwargs.items()
@@ -2444,30 +2518,85 @@ class Runtime:
         return {"ok": True}
 
     async def _exec_actor_ordered(self, spec: TaskSpec, conn):
+        group = spec.kwargs.get("__rt_group__")
+        limits = getattr(self, "_group_limits", None) or {}
+        if group is not None and group not in limits:
+            envelope = ser.serialize_to_bytes(
+                ValueError(
+                    f"actor declares no concurrency group {group!r}"
+                ),
+                tag=ser.TAG_ERROR,
+            )
+            conn.send("task_result", {
+                "result": TaskResult(task_id=spec.task_id, status="error",
+                                     error=envelope),
+                "owner": spec.owner,
+            })
+            return
+        aspec = self._actor_aspec
+        if aspec is not None and aspec.allow_out_of_order:
+            # opt-in unordered mode (reference:
+            # `out_of_order_actor_scheduling_queue.h:37`): execute as
+            # delivered — no seq buffer, so a slow earlier call can
+            # never delay a later one
+            self._lane_dispatch(group, spec, conn)
+            return
+        # per-(caller, group) ordered streams: each group is its own
+        # sequence lane, so a blocked "io" call never stalls "compute"
         caller = spec.owner[1]
+        key = (caller, group)
         # First contact from a caller sets the baseline: after an actor
         # restart the caller's counter keeps running, and a fresh
         # incarnation must not wait for sequence numbers that were
         # consumed by the previous one.
-        expect = self._actor_seq_expect.setdefault(caller, spec.seq_no)
+        expect = self._actor_seq_expect.setdefault(key, spec.seq_no)
         if spec.seq_no < expect:
             # late retry of an already-superseded sequence number:
             # execute out-of-band (restart relaxes exactly-once ordering,
             # same as the reference with max_task_retries > 0)
-            await self._exec_task(spec, conn)
+            self._lane_dispatch(group, spec, conn)
             return
-        buf = self._actor_seq_buffer.setdefault(caller, {})
+        buf = self._actor_seq_buffer.setdefault(key, {})
         buf[spec.seq_no] = (spec, conn)
         if self._actor_drain_lock is None:
             self._actor_drain_lock = asyncio.Lock()
         async with self._actor_drain_lock:
-            while self._actor_seq_expect[caller] in buf:
-                s, c = buf.pop(self._actor_seq_expect[caller])
-                self._actor_seq_expect[caller] += 1
-                if self._actor_aspec is not None and self._actor_aspec.is_async:
-                    asyncio.ensure_future(self._exec_task(s, c))
+            while self._actor_seq_expect[key] in buf:
+                s, c = buf.pop(self._actor_seq_expect[key])
+                self._actor_seq_expect[key] += 1
+                if aspec is not None and aspec.is_async:
+                    self._lane_dispatch(group, s, c)
                 else:
                     await self._exec_task(s, c)
+
+    def _lane_dispatch(self, group: Optional[str], spec: TaskSpec, conn):
+        """Enqueue one actor task on its lane.  Each lane has a single
+        consumer coroutine, so starts are FIFO in enqueue order and the
+        lane's concurrency cap needs no fair semaphore.  A lane with no
+        limit (the async default lane) dispatches straight through —
+        the historical unbounded path."""
+        limits = getattr(self, "_group_limits", None) or {}
+        limit = limits.get(group)
+        if limit is None:
+            asyncio.ensure_future(self._exec_task(spec, conn))
+            return
+        q = self._lane_queues.get(group)
+        if q is None:
+            q = self._lane_queues[group] = asyncio.Queue()
+            asyncio.ensure_future(self._lane_worker(group, q, limit))
+        q.put_nowait((spec, conn))
+
+    async def _lane_worker(self, group: Optional[str], q: asyncio.Queue,
+                           limit: int):
+        """Single consumer of one lane's queue: admits up to `limit`
+        concurrent tasks, in FIFO order."""
+        slots = asyncio.Semaphore(limit)
+        while True:
+            spec, conn = await q.get()
+            # only this coroutine acquires, so no barging is possible
+            await slots.acquire()
+            task = asyncio.ensure_future(self._exec_task(spec, conn))
+            task.add_done_callback(lambda _t: slots.release())
 
     async def _adopt_driver_sys_path(self) -> bool:
         """Extend sys.path from the KV-published driver path (set by
@@ -2605,7 +2734,13 @@ class Runtime:
                                 pass
                             log_ctx_var.reset(_log_tok)
 
-                    value = await loop.run_in_executor(self._exec_pool, _call_method)
+                    # sync methods of a named group run on that group's
+                    # dedicated pool: a flooded default lane can never
+                    # hold a group lane's threads
+                    _pool = getattr(self, "_group_pools", {}).get(
+                        spec.kwargs.get("__rt_group__"), self._exec_pool
+                    )
+                    value = await loop.run_in_executor(_pool, _call_method)
             else:
 
                 def _call():
@@ -2775,13 +2910,18 @@ class Runtime:
                 except StopIteration:
                     return _END
 
+            # a grouped streaming method iterates on its group's pool
+            # (same isolation rule as _exec_task's sync-method path)
+            _pool = getattr(self, "_group_pools", {}).get(
+                spec.kwargs.get("__rt_group__"), self._exec_pool
+            )
             while True:
-                item = await loop.run_in_executor(self._exec_pool, _next)
+                item = await loop.run_in_executor(_pool, _next)
                 if item is _END:
                     break
                 await _send(item)
                 if _abandoned():
-                    await loop.run_in_executor(self._exec_pool, value.close)
+                    await loop.run_in_executor(_pool, value.close)
                     break
         else:
             await _send(value)

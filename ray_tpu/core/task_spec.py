@@ -189,6 +189,19 @@ class ActorCreationSpec:
     # rebuilt via get_actor stream them too (reference: method metadata
     # in the GCS actor table)
     streaming_methods: Tuple[str, ...] = ()
+    # named execution lanes with per-group concurrency limits
+    # (reference: `core_worker/transport/concurrency_group_manager.h`);
+    # calls pick a lane via `.options(concurrency_group=...)` or the
+    # @rt.method default recorded in method_groups
+    concurrency_groups: Optional[Dict[str, int]] = None
+    method_groups: Optional[Dict[str, str]] = None
+    # computed once at the driver (the raw predicate before it folds
+    # into is_async): the executor's default-lane policy depends on it
+    has_async_methods: bool = False
+    # opt-out of per-caller in-order delivery (reference:
+    # `out_of_order_actor_scheduling_queue.h:37`): tasks execute as
+    # they arrive, so a slow earlier call never delays a later one
+    allow_out_of_order: bool = False
     strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     lifetime: Optional[str] = None  # "detached" keeps it past driver exit
     # {"env_vars": {...}, "working_dir": path} applied in the actor's

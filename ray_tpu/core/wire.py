@@ -331,6 +331,8 @@ def register_core_schemas():
         "owner", "resources", "max_restarts", "max_task_retries",
         "max_concurrency", "is_async", "name", "namespace",
         "streaming_methods", "strategy", "lifetime", "runtime_env",
+        "concurrency_groups", "method_groups", "allow_out_of_order",
+        "has_async_methods",
     ])
     registry.register(_ts.TaskResult, [
         "task_id", "status", "returns", "error", "execution_info",

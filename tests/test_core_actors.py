@@ -226,3 +226,242 @@ def test_runtime_env_py_modules(cluster, tmp_path):
     a = Uses.remote()
     assert rt.get(a.probe.remote(), timeout=60) == ("from-the-driver", 42)
     rt.kill(a)
+
+
+# ---------------------------------------------------------------------------
+# concurrency groups + out-of-order execution
+# (reference: core_worker/transport/concurrency_group_manager.h,
+#  out_of_order_actor_scheduling_queue.h)
+# ---------------------------------------------------------------------------
+def test_concurrency_group_isolation(cluster):
+    """A blocked 'io' call must not stall the default lane: each group
+    is its own execution lane with its own concurrency limit."""
+    import threading
+
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        def __init__(self):
+            self._ev = threading.Event()
+
+        @rt.method(concurrency_group="io")
+        def blocking_io(self):
+            # blocks until the default lane releases it
+            assert self._ev.wait(timeout=30)
+            return "io-done"
+
+        def compute(self):
+            return "fast"
+
+        def release(self):
+            self._ev.set()
+            return True
+
+    a = A.remote()
+    io_ref = a.blocking_io.remote()
+    # with io wedged, the default lane still serves calls
+    assert rt.get(a.compute.remote(), timeout=10) == "fast"
+    done, _ = rt.wait([io_ref], timeout=0.2)
+    assert not done  # io genuinely still blocked
+    assert rt.get(a.release.remote(), timeout=10) is True
+    assert rt.get(io_ref, timeout=10) == "io-done"
+
+
+def test_concurrency_group_per_group_ordering(cluster):
+    """Within one group, calls from one caller run in submit order."""
+    @rt.remote(concurrency_groups={"log": 1})
+    class A:
+        def __init__(self):
+            self.seen = []
+
+        @rt.method(concurrency_group="log")
+        def log(self, i):
+            self.seen.append(i)
+            return i
+
+        def result(self):
+            return list(self.seen)
+
+    a = A.remote()
+    refs = [a.log.remote(i) for i in range(20)]
+    rt.get(refs, timeout=30)
+    assert rt.get(a.result.remote(), timeout=10) == list(range(20))
+
+
+def test_concurrency_group_call_site_options(cluster):
+    """.options(concurrency_group=...) routes a call into a lane the
+    method didn't declare as its default."""
+    import threading
+
+    @rt.remote(concurrency_groups={"aux": 1})
+    class A:
+        def __init__(self):
+            self._ev = threading.Event()
+
+        def wait_for_release(self):
+            assert self._ev.wait(timeout=30)
+            return "released"
+
+        def release(self):
+            self._ev.set()
+            return True
+
+    a = A.remote()
+    # route the blocking call into "aux" so the default lane stays free
+    ref = a.wait_for_release.options(concurrency_group="aux").remote()
+    assert rt.get(a.release.remote(), timeout=10) is True
+    assert rt.get(ref, timeout=10) == "released"
+
+
+def test_unknown_concurrency_group_errors(cluster):
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert rt.get(a.ping.remote(), timeout=10) == "pong"
+    with pytest.raises(ValueError, match="concurrency group"):
+        rt.get(a.ping.options(concurrency_group="nope").remote(),
+               timeout=10)
+
+
+def test_undeclared_method_group_fails_at_creation(cluster):
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        @rt.method(concurrency_group="typo")
+        def f(self):
+            return 1
+
+    with pytest.raises(ValueError, match="undeclared group"):
+        A.remote()
+
+
+def test_out_of_order_execution_skips_seq_gaps(cluster):
+    """An ordered actor buffers behind a sequence gap; an out-of-order
+    actor executes whatever arrives (reference:
+    out_of_order_actor_scheduling_queue.h semantics)."""
+    @rt.remote
+    class Ordered:
+        def ping(self):
+            return "pong"
+
+    @rt.remote(allow_out_of_order_execution=True)
+    class Unordered:
+        def ping(self):
+            return "pong"
+
+    o = Ordered.remote()
+    assert rt.get(o.ping.remote(), timeout=10) == "pong"
+    o._next_seq(None)  # consume a seq number: delivery gap
+    gap_ref = o.ping.remote()
+    done, _ = rt.wait([gap_ref], timeout=1.0)
+    assert not done  # ordered executor waits for the missing seq
+    rt.kill(o)
+
+    u = Unordered.remote()
+    assert rt.get(u.ping.remote(), timeout=10) == "pong"
+    u._next_seq(None)  # same gap: must NOT stall
+    assert rt.get(u.ping.remote(), timeout=10) == "pong"
+    rt.kill(u)
+
+
+def test_out_of_order_actor_still_serializes(cluster):
+    """Out-of-order relaxes ordering, not concurrency: a
+    max_concurrency=1 actor still runs one method at a time."""
+    @rt.remote(allow_out_of_order_execution=True)
+    class A:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        def step(self):
+            import time as _t
+
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            _t.sleep(0.02)
+            self.active -= 1
+            return self.max_active
+
+    a = A.remote()
+    refs = [a.step.remote() for _ in range(8)]
+    out = rt.get(refs, timeout=30)
+    assert max(out) == 1
+    rt.kill(a)
+
+
+def test_async_actor_default_lane_stays_unbounded(cluster):
+    """Declaring groups (or out-of-order) on an ASYNC actor must not
+    cap the default lane at max_concurrency=1 — that would introduce
+    the head-of-line blocking these modes exist to remove."""
+    @rt.remote(concurrency_groups={"io": 1},
+               allow_out_of_order_execution=True)
+    class A:
+        async def slow(self):
+            await asyncio.sleep(30)
+            return "slow"
+
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    a.slow.remote()  # occupies the loop, NOT the default lane's budget
+    assert rt.get(a.ping.remote(), timeout=5) == "pong"
+    rt.kill(a)
+
+
+def test_explicit_none_group_overrides_method_default(cluster):
+    """.options(concurrency_group=None) escapes a method's declared
+    lane back to the default lane."""
+    import threading
+
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        def __init__(self):
+            self._ev = threading.Event()
+
+        @rt.method(concurrency_group="io")
+        def fetch(self, wait=True):
+            if wait:
+                assert self._ev.wait(timeout=30)
+            return "fetched"
+
+        def release(self):
+            self._ev.set()
+            return True
+
+    a = A.remote()
+    a.fetch.remote()  # wedges the io lane
+    # explicit None: runs on the default lane despite the io default
+    ref = a.fetch.options(concurrency_group=None).remote(wait=False)
+    assert rt.get(ref, timeout=5) == "fetched"
+    rt.get(a.release.remote(), timeout=10)
+    rt.kill(a)
+
+
+def test_concurrency_groups_survive_get_actor(cluster):
+    """Handles rebuilt via get_actor keep the @method group defaults
+    (recorded in the actor table)."""
+    import threading
+
+    @rt.remote(concurrency_groups={"io": 1}, name="cg-named")
+    class A:
+        def __init__(self):
+            self._ev = threading.Event()
+
+        @rt.method(concurrency_group="io")
+        def blocking_io(self):
+            assert self._ev.wait(timeout=30)
+            return "io"
+
+        def release(self):
+            self._ev.set()
+            return True
+
+    a = A.remote()
+    h = rt.get_actor("cg-named")
+    assert h._method_groups == {"blocking_io": "io"}
+    ref = h.blocking_io.remote()  # routed into "io" via the default
+    assert rt.get(h.release.remote(), timeout=10) is True
+    assert rt.get(ref, timeout=10) == "io"
+    rt.kill(a)
