@@ -266,6 +266,14 @@ class Controller:
             subs.remove(conn)
         return {"ok": True}
 
+    async def handle_publish(self, payload, conn):
+        """Generic pubsub publish: any process fans a message out to a
+        channel's subscribers (reference: `src/ray/pubsub/` — e.g. the
+        serve controller pushes routing-table change notifications so
+        routers don't poll)."""
+        self._publish(payload["channel"], payload.get("msg"))
+        return {"ok": True}
+
     # ---- nodes -------------------------------------------------------
     async def handle_register_node(self, payload, conn):
         node = NodeInfo(

@@ -87,6 +87,45 @@ async def update_builtin_metrics(ctl):
                   {**tags, "kind": "target"})
             req.set(float(info.get("completed", 0.0)), tags)
             lat.set(float(info.get("latency_sum_s", 0.0)), tags)
+    # per-replica series (reference: `serve/metrics.py` replica-tagged
+    # request counter / queue gauge / latency histogram) so autoscaling
+    # decisions are auditable from /metrics
+    try:
+        ref = controller.get_replica_metrics.remote()
+        per_replica = await get_runtime()._get_one(ref)
+    except Exception:
+        per_replica = {}
+    rep_tags = ("app", "deployment", "replica")
+    rr = _gauge("rt_serve_replica_requests_total",
+                "completed requests per replica (monotonic)", rep_tags)
+    rq = _gauge("rt_serve_replica_queue_depth",
+                "in-flight requests per replica", rep_tags)
+    rls = _gauge("rt_serve_replica_latency_seconds_sum",
+                 "summed request latency per replica", rep_tags)
+    rlb = _gauge("rt_serve_replica_latency_seconds_bucket",
+                 "request latency histogram per replica",
+                 rep_tags + ("le",))
+    for m in (rr, rq, rls, rlb):
+        m.clear()  # dead replicas must not export stale series
+    from ray_tpu.serve.replica import LATENCY_BOUNDARIES
+
+    for app, deployments in (per_replica or {}).items():
+        for dep, replicas in deployments.items():
+            for rid, m in replicas.items():
+                tags = {"app": app, "deployment": dep, "replica": rid}
+                # COMPLETED requests: the histogram count basis (the
+                # started-count would put phantom in-flight mass in the
+                # +Inf bucket and wreck histogram_quantile)
+                completed = float(m.get("completed", m.get("total", 0)))
+                rr.set(completed, tags)
+                rq.set(float(m.get("ongoing", 0)), tags)
+                rls.set(float(m.get("latency_sum_s", 0.0)), tags)
+                buckets = m.get("latency_buckets") or []
+                cum = 0.0
+                for bound, n in zip(LATENCY_BOUNDARIES, buckets):
+                    cum += n
+                    rlb.set(cum, {**tags, "le": str(bound)})
+                rlb.set(completed, {**tags, "le": "+Inf"})
 
 
 # -- dashboard generation -----------------------------------------------

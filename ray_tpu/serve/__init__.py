@@ -17,6 +17,7 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     grpc_address,
     http_address,
+    http_addresses,
     ingress,
     run,
     shutdown,
@@ -49,6 +50,7 @@ __all__ = [
     "get_deployment_handle",
     "grpc_address",
     "http_address",
+    "http_addresses",
     "ingress",
     "run",
     "shutdown",

@@ -184,31 +184,23 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
                 )
                 rt.get(controller.ping.remote())
             _state["controller"] = controller
-        if proxy and "proxy" not in _state:
-            from ray_tpu.serve.proxy import HTTPProxy
-
+        if proxy and "proxy_fleet" not in _state:
+            # per-node proxy fleet (reference: `proxy.py:1140` — one
+            # ProxyActor per node): the controller starts/adopts one
+            # HTTP proxy per cluster node and keeps the fleet matched
+            # to membership in its reconcile loop; addresses land in
+            # the KV (`serve:http_addresses`) for discovery
             opts = http_options or HTTPOptions(port=0)
-            p = (
-                rt.remote(HTTPProxy)
-                .options(
-                    name="SERVE_PROXY",
-                    namespace=CONTROLLER_NAMESPACE,
-                    max_concurrency=16,
-                    num_cpus=0,
-                )
-                .remote(opts.host, opts.port)
+            addrs = rt.get(
+                _state["controller"].ensure_proxies.remote(
+                    opts.host, opts.port
+                ),
+                timeout=60,
             )
-            port = rt.get(p.start.remote())
-            _state["proxy"] = p
-            _state["http_address"] = (opts.host, port)
-            # cluster-visible discovery; the whole rpc layer binds
-            # 127.0.0.1 today (single-host clusters), so loopback is
-            # valid from every process that can reach the KV
-            from ray_tpu.core.runtime import get_runtime
-
-            get_runtime().kv_put(
-                "serve:http_address", json.dumps([opts.host, port]).encode()
-            )
+            _state["proxy_fleet"] = True
+            if addrs:
+                first = sorted(addrs)[0]
+                _state["http_address"] = tuple(addrs[first])
         if grpc_options is not None and "grpc_proxy" not in _state:
             from ray_tpu.serve.config import GRPCOptions
             from ray_tpu.serve.grpc_proxy import GRPCProxy
@@ -301,6 +293,23 @@ def _discover_address(state_key: str, kv_key: str) -> Optional[tuple]:
 
 def http_address() -> Optional[tuple]:
     return _discover_address("http_address", "serve:http_address")
+
+
+def http_addresses() -> Dict[str, tuple]:
+    """All live proxy addresses, one per cluster node (reference:
+    per-node ProxyActors): {node_id: (host, port)}.  Uncached — the
+    fleet changes with cluster membership."""
+    from ray_tpu.core.runtime import get_runtime, is_initialized
+
+    if not is_initialized():
+        return {}
+    raw = get_runtime().kv_get("serve:http_addresses")
+    if not raw:
+        return {}
+    return {
+        nid: (host, int(port))
+        for nid, (host, port) in json.loads(raw).items()
+    }
 
 
 def grpc_address() -> Optional[tuple]:
@@ -426,6 +435,7 @@ def shutdown():
         controller = _state.pop("controller", None)
         proxy = _state.pop("proxy", None)
         grpc_proxy = _state.pop("grpc_proxy", None)
+        _state.pop("proxy_fleet", None)
         _state.pop("http_address", None)
         _state.pop("grpc_address", None)
     from ray_tpu.serve import handle as _handle_mod
@@ -439,11 +449,28 @@ def shutdown():
             controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
         except Exception:
             controller = None
+    fleet_proxies: List[Any] = []
     if proxy is None:
-        try:
+        try:  # legacy single-proxy deployments
             proxy = rt.get_actor("SERVE_PROXY", CONTROLLER_NAMESPACE)
         except Exception:
             proxy = None
+        # per-node fleet: resolvable from anywhere via the KV address
+        # map even when the controller itself is unreachable
+        try:
+            from ray_tpu.core.runtime import get_runtime, is_initialized
+
+            if is_initialized():
+                raw = get_runtime().kv_get("serve:http_addresses")
+                for nid in (json.loads(raw) if raw else {}):
+                    try:
+                        fleet_proxies.append(rt.get_actor(
+                            f"SERVE_PROXY::{nid}", CONTROLLER_NAMESPACE
+                        ))
+                    except Exception:
+                        pass
+        except Exception:
+            pass
     if grpc_proxy is None:
         try:
             grpc_proxy = rt.get_actor("SERVE_GRPC_PROXY",
@@ -455,10 +482,11 @@ def shutdown():
 
         if is_initialized():
             get_runtime().kv_del("serve:http_address")
+            get_runtime().kv_del("serve:http_addresses")
             get_runtime().kv_del("serve:grpc_address")
     except Exception:
         pass
-    for p in (proxy, grpc_proxy):
+    for p in (proxy, grpc_proxy, *fleet_proxies):
         if p is not None:
             try:
                 rt.get(p.stop.remote(), timeout=5)

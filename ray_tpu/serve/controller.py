@@ -40,6 +40,9 @@ class _ReplicaState:
         self.state = STARTING
         self.health_ref = None
         self.health_sent = 0.0
+        # latest metrics piggybacked on the health-check reply
+        # (requests total, queue depth, latency histogram)
+        self.metrics: Dict[str, Any] = {}
 
 
 class _DeploymentState:
@@ -100,12 +103,28 @@ class ServeController:
         # deployment monotonic totals (delta-folded)
         self._router_stats: Dict[tuple, Dict[str, Dict[str, float]]] = {}
         self._deployment_stats: Dict[tuple, Dict[str, float]] = {}
+        # per-node proxy fleet (reference: one ProxyActor per node,
+        # `serve/_private/proxy.py:1140`): node_id -> (handle, addr)
+        self._http_options: Optional[tuple] = None  # (host, port)
+        self._proxies: Dict[str, tuple] = {}
+        # serializes fleet reconciles (ensure_proxies on the actor
+        # thread vs the dedicated reconcile thread) — an unlocked
+        # read-copy-writeback would double-create named proxies
+        self._proxy_lock = threading.Lock()
         self._stop = threading.Event()
         self._recover()
         self._thread = threading.Thread(
             target=self._control_loop, daemon=True, name="serve-controller"
         )
         self._thread.start()
+        # proxy reconcile runs on its OWN thread: its health probes are
+        # blocking RPCs (a wedged proxy costs seconds), and the replica
+        # reconcile/autoscale loop must not stall behind them
+        self._proxy_thread = threading.Thread(
+            target=self._proxy_loop, daemon=True,
+            name="serve-proxy-reconcile",
+        )
+        self._proxy_thread.start()
 
     # -- fault tolerance ----------------------------------------------
     # Reference: the controller checkpoints every state change to the
@@ -137,6 +156,7 @@ class ServeController:
                 ]
             state = {
                 "apps": apps,
+                "http_options": self._http_options,
                 "ingress": dict(self._ingress),
                 "ingress_streaming": dict(self._ingress_streaming),
                 "routes": dict(self._routes),
@@ -216,6 +236,8 @@ class ServeController:
                         deployments[d["name"]] = ds
                     self._apps[app_name] = deployments
                 self._ingress = dict(state.get("ingress", {}))
+                opts = state.get("http_options")
+                self._http_options = tuple(opts) if opts else None
                 self._ingress_streaming = dict(
                     state.get("ingress_streaming", {})
                 )
@@ -296,6 +318,8 @@ class ServeController:
             self._stop_replica(r, timeout_s=5.0)
         self._reconcile_once()
         self._checkpoint()
+        for name, ds in deployments.items():
+            self._notify_routes(app_name, name, ds.version)
         return True
 
     def delete_application(self, app_name: str) -> bool:
@@ -320,12 +344,23 @@ class ServeController:
         for r, timeout_s in victims:
             self._stop_replica(r, timeout_s=timeout_s)
         self._checkpoint()
+        for name in list(deployments):
+            self._notify_routes(app_name, name, -1, deleted=True)
         return True
 
     def shutdown(self) -> bool:
         self._stop.set()
         for app in list(self._apps):
             self.delete_application(app)
+        with self._lock:
+            proxies = list(self._proxies.values())
+            self._proxies = {}
+            self._http_options = None
+        for handle, _addr in proxies:
+            try:
+                rt.kill(handle)
+            except Exception:
+                pass
         self._checkpoint()
         return True
 
@@ -430,6 +465,172 @@ class ServeController:
     def ping(self) -> bool:
         return True
 
+    def get_replica_metrics(self) -> Dict[str, Any]:
+        """Per-replica request metrics (reference: `serve/metrics.py`
+        replica-tagged series), refreshed on the health-check cadence;
+        exported as Prometheus series by the dashboard's /metrics."""
+        with self._lock:
+            return {
+                app_name: {
+                    name: {
+                        rid: dict(r.metrics)
+                        for rid, r in ds.replicas.items()
+                        if r.metrics
+                    }
+                    for name, ds in deployments.items()
+                }
+                for app_name, deployments in self._apps.items()
+            }
+
+    # -- routing-table push (reference: serve's long_poll.py) ---------
+    def _notify_routes(self, app_name: str, name: str, version: int,
+                       deleted: bool = False):
+        """Push a table-change notification on the cluster pubsub so
+        routers refetch immediately instead of waiting out their poll
+        period.  The notification carries only (app, deployment,
+        version) — routers fetch the table over the existing RPC, which
+        also keeps the metrics piggyback intact."""
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            get_runtime().controller_call("publish", {
+                "channel": "serve:routes",
+                "msg": {"app": app_name, "deployment": name,
+                        "version": version, "deleted": deleted},
+            })
+        except Exception:
+            pass  # routers still converge via their periodic refresh
+
+    # -- per-node proxy fleet -----------------------------------------
+    def ensure_proxies(self, host: str, port: int) -> Dict[str, tuple]:
+        """Start (or adopt) one HTTP proxy per cluster node (reference:
+        `proxy.py:1140` — a ProxyActor on every node).  Returns
+        {node_id: (host, port)}.  The reconcile loop keeps the fleet
+        matched to cluster membership afterwards."""
+        with self._lock:
+            self._http_options = (host, port)
+        self._reconcile_proxies()
+        self._checkpoint()
+        with self._lock:
+            return {nid: addr for nid, (_h, addr) in self._proxies.items()}
+
+    def get_proxy_addresses(self) -> Dict[str, tuple]:
+        with self._lock:
+            return {nid: addr for nid, (_h, addr) in self._proxies.items()}
+
+    def _proxy_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._reconcile_proxies()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                traceback.print_exc()
+            self._stop.wait(2.0)
+
+    def _reconcile_proxies(self):
+        with self._proxy_lock:
+            self._reconcile_proxies_locked()
+
+    def _reconcile_proxies_locked(self):
+        import json as _json
+
+        from ray_tpu.core.runtime import get_runtime
+
+        with self._lock:
+            opts = self._http_options
+        if opts is None:
+            return
+        host, port = opts
+        try:
+            nodes = get_runtime().controller_call("get_nodes")
+        except Exception:
+            return
+        alive = {n["node_id"] for n in nodes if n.get("alive", True)}
+        changed = False
+        with self._lock:
+            fleet = dict(self._proxies)
+        # drop proxies whose node died
+        for nid in set(fleet) - alive:
+            handle, _addr = fleet.pop(nid)
+            changed = True
+            try:
+                rt.kill(handle)
+            except Exception:
+                pass
+        # health-check the live fleet; a dead proxy actor is replaced
+        for nid, (handle, _addr) in list(fleet.items()):
+            try:
+                rt.get(handle.num_requests.remote(), timeout=10)
+            except Exception:
+                del fleet[nid]
+                changed = True
+                try:
+                    rt.kill(handle)
+                except Exception:
+                    pass
+        for nid in alive - set(fleet):
+            # the configured port goes to the FIRST proxy; the rest
+            # bind ephemeral ports (nodes share a host in test
+            # clusters; on real multi-host fleets every node could
+            # use the same fixed port)
+            want_port = port if not fleet else 0
+            proxy = self._start_proxy(nid, host, want_port)
+            if proxy is not None:
+                fleet[nid] = proxy
+                changed = True
+        with self._lock:
+            self._proxies = fleet
+        if changed:
+            addrs = {nid: list(addr) for nid, (_h, addr) in fleet.items()}
+            try:
+                kv = get_runtime()
+                kv.kv_put("serve:http_addresses",
+                          _json.dumps(addrs).encode())
+                if addrs:  # legacy single-address key: any live proxy
+                    first = sorted(addrs)[0]
+                    kv.kv_put("serve:http_address",
+                              _json.dumps(addrs[first]).encode())
+            except Exception:
+                pass
+
+    def _start_proxy(self, node_id: str, host: str, port: int):
+        from ray_tpu.serve.proxy import HTTPProxy
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        name = f"SERVE_PROXY::{node_id}"
+        try:
+            # controller restart: adopt the live proxy by name.  start()
+            # is idempotent — an adopted-but-never-started proxy (crash
+            # between create and start) binds here instead of having
+            # its unbound (host, 0) address published
+            handle = rt.get_actor(name, CONTROLLER_NAMESPACE)
+            bound = rt.get(handle.start.remote(), timeout=30)
+            return (handle, (host, bound))
+        except ValueError:
+            pass
+        except Exception:
+            return None
+        try:
+            handle = (
+                rt.remote(HTTPProxy)
+                .options(
+                    name=name,
+                    namespace=CONTROLLER_NAMESPACE,
+                    max_concurrency=16,
+                    num_cpus=0,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id
+                    ),
+                )
+                .remote(host, port)
+            )
+            bound = rt.get(handle.start.remote(), timeout=30)
+            return (handle, (host, bound))
+        except Exception:
+            traceback.print_exc()
+            return None
+
     # -- reconcile loop ----------------------------------------------
     def _control_loop(self):
         """Reference: the controller's run_control_loop — reconcile +
@@ -475,7 +676,9 @@ class ServeController:
                 done, _ = rt.wait([r.health_ref], timeout=0)
                 if done:
                     try:
-                        rt.get(r.health_ref)
+                        reply = rt.get(r.health_ref)
+                        if isinstance(reply, dict):
+                            r.metrics = reply
                         if r.state == STARTING:
                             r.state = RUNNING
                             changed = True
@@ -508,6 +711,7 @@ class ServeController:
             self._stop_replica(r, timeout_s=ds.config.graceful_shutdown_timeout_s)
         if changed:
             self._checkpoint()
+            self._notify_routes(ds.app_name, ds.name, ds.version)
 
     def _start_replica(self, ds: _DeploymentState):
         rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_idx}"

@@ -25,6 +25,11 @@ from ray_tpu.serve.router import Router
 
 _routers: Dict[tuple, Router] = {}
 _routers_lock = threading.Lock()
+# routing-table push watcher (reference: serve's long_poll.py — the
+# controller pushes table-change notifications instead of routers
+# polling): one subscription + daemon thread per process, fanning
+# refreshes out to the cached routers
+_route_watch: Dict[str, Any] = {"thread": None, "sub": None}
 
 
 def _close_routers():
@@ -32,8 +37,85 @@ def _close_routers():
     with _routers_lock:
         routers = list(_routers.values())
         _routers.clear()
+        sub = _route_watch.pop("sub", None)
+        _route_watch["thread"] = None
+        _route_watch["sub"] = None
     for r in routers:
         r.close()
+    if sub is not None:
+        try:
+            sub.close()
+        except Exception:
+            pass
+
+
+def _ensure_route_watcher():
+    """Start the per-process push listener (idempotent).  Failure to
+    subscribe is non-fatal: routers still converge via their periodic
+    refresh, pushes just make table changes take effect immediately.
+    The subscribe RPC runs INSIDE the watcher thread — callers may be
+    on the runtime's io loop (proxy dispatch), where a blocking
+    subscribe would deadlock the loop."""
+    with _routers_lock:
+        t = _route_watch.get("thread")
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=_route_watch_main, daemon=True,
+            name="serve-route-watch",
+        )
+        _route_watch["thread"] = t
+        t.start()
+
+
+def _route_watch_main():
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        sub = get_runtime().subscribe("serve:routes")
+    except Exception:
+        with _routers_lock:
+            if _route_watch.get("thread") is threading.current_thread():
+                _route_watch["thread"] = None
+        return
+    with _routers_lock:
+        if _route_watch.get("thread") is not threading.current_thread():
+            # closed while subscribing: drop the registration
+            sub_stale = sub
+        else:
+            _route_watch["sub"] = sub
+            sub_stale = None
+    if sub_stale is not None:
+        try:
+            sub_stale.close()
+        except Exception:
+            pass
+        return
+    _route_watch_loop(sub)
+
+
+def _route_watch_loop(sub):
+    import queue as _q
+
+    while _route_watch.get("sub") is sub:
+        try:
+            msg = sub.next_message(timeout=1.0)
+        except _q.Empty:
+            continue
+        except Exception:
+            return
+        if not isinstance(msg, dict):
+            continue
+        key = (msg.get("app"), msg.get("deployment"))
+        with _routers_lock:
+            r = _routers.get(key)
+        if r is None:
+            continue
+        try:
+            if msg.get("deleted") or msg.get("version", -1) > r._version:
+                r._refresh(force=True)
+        except Exception:
+            pass  # next push or periodic refresh retries
 
 
 def _on_runtime_loop() -> bool:
@@ -68,10 +150,13 @@ def _router_for(app_name: str, deployment_name: str) -> Router:
     key = (app_name, deployment_name)
     with _routers_lock:
         r = _routers.get(key)
-        if r is None:
+        created = r is None
+        if created:
             r = Router(deployment_name, app_name)
             _routers[key] = r
-        return r
+    if created:
+        _ensure_route_watcher()
+    return r
 
 
 class DeploymentResponse:

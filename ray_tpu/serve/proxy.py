@@ -53,6 +53,8 @@ class HTTPProxy:
         self._num_requests = 0
 
     async def start(self) -> int:
+        if self._server is not None:  # idempotent (fleet re-adoption)
+            return self._port
         self._server = await asyncio.start_server(
             self._handle_conn, self._host, self._port
         )

@@ -19,6 +19,13 @@ async def _ensure_coro(awaitable):
     return await awaitable
 
 
+# histogram boundaries for per-replica request latency (the classic
+# Prometheus latency ladder; the last +Inf bucket is implicit)
+LATENCY_BOUNDARIES = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
 class Replica:
     """Created with `max_concurrency > 1` so requests interleave on the
     actor's event loop, the same execution model as the reference's
@@ -39,6 +46,12 @@ class Replica:
         self._max_ongoing = max_ongoing_requests
         self._ongoing = 0
         self._total = 0
+        # per-replica Prometheus series (reference: `serve/metrics.py`
+        # replica-tagged request counter/latency): collected by the
+        # controller on the health-check cadence, exported at /metrics
+        self._latency_sum_s = 0.0
+        self._latency_buckets = [0] * len(LATENCY_BOUNDARIES)
+        self._completed = 0  # finished requests (histogram count basis)
         if isinstance(callable_def, type):
             self._callable = callable_def(*init_args, **init_kwargs)
         else:
@@ -71,6 +84,7 @@ class Replica:
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
         self._ongoing += 1
         self._total += 1
+        t0 = time.monotonic()
         try:
             if self._is_function:
                 target = self._callable
@@ -95,6 +109,7 @@ class Replica:
             return out
         finally:
             self._ongoing -= 1
+            self._observe_latency(time.monotonic() - t0)
 
     async def handle_request_streaming(self, method_name: str, *args, **kwargs):
         """Streaming request path (reference: `replica.py:463-492`
@@ -108,6 +123,7 @@ class Replica:
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
         self._ongoing += 1
         self._total += 1
+        t0 = time.monotonic()
         try:
             if self._is_function:
                 target = self._callable
@@ -159,21 +175,37 @@ class Replica:
                 yield out
         finally:
             self._ongoing -= 1
+            self._observe_latency(time.monotonic() - t0)
 
     # -- control plane ------------------------------------------------
+    def _observe_latency(self, seconds: float):
+        self._completed += 1
+        self._latency_sum_s += seconds
+        for i, bound in enumerate(LATENCY_BOUNDARIES):
+            if seconds <= bound:
+                self._latency_buckets[i] += 1
+                break
+
     def get_metrics(self) -> Dict[str, Any]:
         return {
             "replica_id": self._replica_id,
             "ongoing": self._ongoing,
-            "total": self._total,
+            "total": self._total,  # started (includes in-flight)
+            "completed": self._completed,  # histogram count basis
+            "latency_sum_s": self._latency_sum_s,
+            "latency_buckets": list(self._latency_buckets),
         }
 
     def get_queue_len(self) -> int:
         return self._ongoing
 
-    def check_health(self) -> bool:
+    def check_health(self) -> Dict[str, Any]:
         """Runs on the worker thread pool (sync method); async user
-        health checks are driven to completion on the actor's loop."""
+        health checks are driven to completion on the actor's loop.
+        The reply piggybacks per-replica metrics so the controller's
+        health cadence doubles as the metrics collection cadence
+        (reference: `serve/metrics.py` replica series) — a failing
+        user health check raises so the controller's error path fires."""
         hc = getattr(self._callable, "check_health", None)
         if hc is not None:
             out = hc()
@@ -183,8 +215,11 @@ class Replica:
                 out = asyncio.run_coroutine_threadsafe(
                     _ensure_coro(out), get_runtime().loop
                 ).result(10)
-            return bool(out) if out is not None else True
-        return True
+            if out is not None and not bool(out):
+                raise RuntimeError(
+                    f"user health check failed on {self._replica_id}"
+                )
+        return self.get_metrics()
 
     def reconfigure(self, user_config) -> bool:
         self._apply_user_config(user_config)

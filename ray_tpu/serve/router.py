@@ -38,7 +38,10 @@ class _ReplicaInfo:
 class Router:
     """One per process per deployment (handles share it)."""
 
-    REFRESH_PERIOD_S = 0.25
+    # table CHANGES arrive pushed (serve:routes pubsub, handle.py's
+    # route watcher); this period is the metrics-piggyback cadence and
+    # the fallback for missed pushes
+    REFRESH_PERIOD_S = 1.0
 
     def __init__(self, deployment_name: str, app_name: str = "default"):
         self._deployment = deployment_name

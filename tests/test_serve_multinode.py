@@ -1,0 +1,78 @@
+"""Multi-node serve data plane: one proxy per node + failover
+(reference: `serve/_private/proxy.py:1140` per-node ProxyActors).
+
+Own module: these tests build their own multi-node Cluster and must not
+share a process-wide runtime with the single-node serve fixtures.
+"""
+
+import time
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+def test_proxy_fleet_one_per_node_and_failover():
+    """One HTTP proxy per cluster node; killing a proxy leaves the app
+    reachable via another node's proxy, and the controller's reconcile
+    replaces the dead one."""
+    import urllib.request as _url
+
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 4, "num_workers": 2})
+    c.connect()
+    try:
+        c.add_node(num_cpus=4, num_workers=2)
+        c.wait_for_nodes()
+
+        @serve.deployment
+        class F:
+            def __call__(self, _=None):
+                return {"ok": True}
+
+        serve.run(F.bind(), name="fleet", route_prefix="/fleet")
+        deadline = time.time() + 30
+        addrs = {}
+        while time.time() < deadline:
+            addrs = serve.http_addresses()
+            if len(addrs) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(addrs) >= 2, addrs  # one proxy per node
+        # every proxy serves the app
+        for nid, (host, port) in addrs.items():
+            with _url.urlopen(f"http://{host}:{port}/fleet",
+                              timeout=10) as r:
+                assert r.status == 200
+        # kill one proxy: the app stays reachable via the others
+        victim_nid, survivor_nid = sorted(addrs)[0], sorted(addrs)[1]
+        victim = rt.get_actor(f"SERVE_PROXY::{victim_nid}", "serve")
+        rt.kill(victim)
+        host, port = addrs[survivor_nid]
+        with _url.urlopen(f"http://{host}:{port}/fleet", timeout=10) as r:
+            assert r.status == 200
+        # reconcile replaces the dead proxy (possibly on a new port)
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            cur = serve.http_addresses()
+            if victim_nid in cur:
+                try:
+                    h2, p2 = cur[victim_nid]
+                    with _url.urlopen(f"http://{h2}:{p2}/fleet",
+                                      timeout=5) as r:
+                        ok = r.status == 200
+                        if ok:
+                            break
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        assert ok, "killed proxy was not replaced"
+        serve.shutdown()
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
