@@ -9,8 +9,11 @@ workflow advances, and `resume()` replays only what never finished.
 """
 
 from ray_tpu.workflow.api import (
+    Continuation,
     WorkflowStatus,
+    continuation,
     delete,
+    get_metadata,
     get_output,
     get_status,
     init_storage,
@@ -18,11 +21,18 @@ from ray_tpu.workflow.api import (
     resume,
     run,
     run_async,
+    send_event,
+    wait_for_event,
 )
 
 __all__ = [
+    "Continuation",
     "WorkflowStatus",
+    "continuation",
     "delete",
+    "get_metadata",
+    "send_event",
+    "wait_for_event",
     "get_output",
     "get_status",
     "init_storage",

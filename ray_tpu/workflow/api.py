@@ -35,6 +35,63 @@ class WorkflowStatus:
     RESUMABLE = "RESUMABLE"
 
 
+class Continuation:
+    """Returned BY a workflow task to dynamically extend the workflow
+    (reference: `workflow.continuation` — a task returning a DAG makes
+    the executor run it durably and use its output as the task's own
+    result).  Continuations nest: a continuation task may itself return
+    another Continuation."""
+
+    def __init__(self, dag: "FunctionNode"):
+        if not isinstance(dag, FunctionNode):
+            raise TypeError(
+                "workflow.continuation expects fn.bind(...) "
+                "(a FunctionNode)"
+            )
+        self.dag = dag
+
+
+def continuation(dag: "FunctionNode") -> Continuation:
+    """Wrap a bound DAG as a task's dynamic continuation."""
+    return Continuation(dag)
+
+
+class EventNode(FunctionNode):
+    """A durable wait-point in the DAG (reference:
+    `workflow.wait_for_event` + the event listener protocol): the
+    executor blocks this step until `send_event(workflow_id, name)`
+    writes the payload into storage; once written, the event is durable
+    — resumes see it immediately."""
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None):
+        def _event_placeholder():  # pragma: no cover — never executed
+            raise RuntimeError("EventNode executes via the event path")
+
+        _event_placeholder.__name__ = f"event_{name}"
+        super().__init__(_event_placeholder, (), {})
+        self.event_name = name
+        self.timeout_s = timeout_s
+
+
+def wait_for_event(name: str,
+                   timeout_s: Optional[float] = None) -> EventNode:
+    """A DAG node resolving to the payload of a named workflow event."""
+    return EventNode(name, timeout_s)
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None):
+    """Deliver an event to a (possibly running, possibly interrupted)
+    workflow; durable once written.  Raises for an unknown workflow id
+    so a typo'd id can't silently swallow the event."""
+    wf = _wf_dir(workflow_id)
+    if not os.path.isdir(wf):
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    events = os.path.join(wf, "events")
+    os.makedirs(events, exist_ok=True)
+    _atomic_write(os.path.join(events, f"{name}.pkl"),
+                  cloudpickle.dumps(payload))
+
+
 def init_storage(path: str):
     """Set the workflow store root (reference: `workflow.init`)."""
     global _storage_dir
@@ -96,9 +153,50 @@ def _task_key(idx: int, node: FunctionNode) -> str:
     return f"{idx:04d}_{name}"
 
 
-def _execute_dag(workflow_id: str, root: FunctionNode) -> Any:
+def _write_meta(tasks_dir: str, key: str, **fields):
+    """Per-step durable metadata (reference: workflow step metadata in
+    storage — `workflow.get_metadata`): merged, atomic."""
+    path = os.path.join(tasks_dir, key + ".meta.json")
+    meta = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except Exception:
+            meta = {}
+    meta.update(fields)
+    _atomic_write(path, json.dumps(meta).encode())
+
+
+def _wait_event(workflow_id: str, node: EventNode) -> Any:
+    events_dir = os.path.join(_wf_dir(workflow_id), "events")
+    path = os.path.join(events_dir, f"{node.event_name}.pkl")
+    deadline = (
+        time.monotonic() + node.timeout_s
+        if node.timeout_s is not None else None
+    )
+    while not os.path.exists(path):
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"workflow event {node.event_name!r} not delivered "
+                f"within {node.timeout_s}s"
+            )
+        time.sleep(0.05)
+    with open(path, "rb") as f:
+        return cloudpickle.load(f)
+
+
+def _execute_dag(workflow_id: str, root: FunctionNode,
+                 tasks_dir: Optional[str] = None) -> Any:
+    """Topological durable execution.  Dynamic workflows: a task that
+    returns `workflow.continuation(dag)` extends the run — the
+    continuation DAG is persisted BEFORE it executes (a kill-restart
+    resumes the continuation without re-running the task that produced
+    it) and runs in its own nested task directory; its output becomes
+    the task's result.  EventNodes block durably on `send_event`."""
     wf = _wf_dir(workflow_id)
-    tasks_dir = os.path.join(wf, "tasks")
+    if tasks_dir is None:
+        tasks_dir = os.path.join(wf, "tasks")
     os.makedirs(tasks_dir, exist_ok=True)
     order = _topo(root)
     keys = {n._id: _task_key(i, n) for i, n in enumerate(order)}
@@ -116,14 +214,64 @@ def _execute_dag(workflow_id: str, root: FunctionNode) -> Any:
             return results[v._id]
         return v
 
+    def run_continuation(key: str, cont_dag: FunctionNode) -> Any:
+        sub_dir = os.path.join(tasks_dir, key + "_cont")
+        value = _execute_dag(workflow_id, cont_dag, tasks_dir=sub_dir)
+        if isinstance(value, Continuation):
+            # the continuation's own root returned a continuation: the
+            # nested DAG was persisted by the recursive call; unwrap
+            # happens there, so this is unreachable — guard anyway
+            raise RuntimeError("unresolved nested continuation")
+        return value
+
     for n in order:
         if n._id in results:
             continue  # durably completed in a previous run
-        args = [resolve(a) for a in n.args]
-        kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
-        value = rt.get(n.remote_fn.remote(*args, **kwargs))
+        key = keys[n._id]
+        cont_path = os.path.join(tasks_dir, key + ".cont.pkl")
+        if os.path.exists(cont_path):
+            # interrupted mid-continuation: resume the persisted
+            # continuation DAG, do NOT re-run the producing task
+            with open(cont_path, "rb") as f:
+                cont_dag = cloudpickle.load(f)
+            value = run_continuation(key, cont_dag)
+            _write_meta(tasks_dir, key, end_ts=time.time(),
+                        status="SUCCESSFUL")
+        elif isinstance(n, EventNode):
+            _write_meta(tasks_dir, key, name=n.event_name, kind="event",
+                        start_ts=time.time(), status="WAITING")
+            try:
+                value = _wait_event(workflow_id, n)
+            except BaseException as e:
+                _write_meta(tasks_dir, key, end_ts=time.time(),
+                            status="FAILED", error=repr(e))
+                raise
+            _write_meta(tasks_dir, key, end_ts=time.time(),
+                        status="SUCCESSFUL")
+        else:
+            args = [resolve(a) for a in n.args]
+            kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
+            _write_meta(
+                tasks_dir, key,
+                name=getattr(n.remote_fn, "__name__", "task"),
+                kind="task", start_ts=time.time(), status="RUNNING",
+            )
+            try:
+                value = rt.get(n.remote_fn.remote(*args, **kwargs))
+            except BaseException as e:
+                _write_meta(tasks_dir, key, end_ts=time.time(),
+                            status="FAILED", error=repr(e))
+                raise
+            if isinstance(value, Continuation):
+                # durable-first: persist the continuation DAG before
+                # executing it, then run it as a nested sub-workflow
+                _atomic_write(cont_path, cloudpickle.dumps(value.dag))
+                _write_meta(tasks_dir, key, continuation=True)
+                value = run_continuation(key, value.dag)
+            _write_meta(tasks_dir, key, end_ts=time.time(),
+                        status="SUCCESSFUL")
         _atomic_write(
-            os.path.join(tasks_dir, keys[n._id] + ".pkl"),
+            os.path.join(tasks_dir, key + ".pkl"),
             cloudpickle.dumps(value),
         )
         results[n._id] = value
@@ -240,6 +388,40 @@ def list_all(status_filter: Optional[str] = None) -> List[Tuple[str, str]]:
         if status_filter is None or s == status_filter:
             out.append((wid, s))
     return out
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    """Workflow + per-step durable metadata (reference:
+    `workflow.get_metadata`): status, and for each step its name, kind
+    (task/event), timestamps, status, and whether it spawned a
+    continuation.  Nested continuation steps appear under their parent
+    step's key with a '/'-joined path."""
+    wf = _wf_dir(workflow_id)
+    status_path = os.path.join(wf, "status.json")
+    if not os.path.exists(status_path):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    with open(status_path) as f:
+        info = json.load(f)
+    steps: Dict[str, Any] = {}
+
+    def scan(tasks_dir: str, prefix: str):
+        if not os.path.isdir(tasks_dir):
+            return
+        for fn in sorted(os.listdir(tasks_dir)):
+            full = os.path.join(tasks_dir, fn)
+            if fn.endswith(".meta.json"):
+                key = prefix + fn[: -len(".meta.json")]
+                try:
+                    with open(full) as f:
+                        steps[key] = json.load(f)
+                except Exception:
+                    continue
+            elif fn.endswith("_cont") and os.path.isdir(full):
+                scan(full, prefix + fn[: -len("_cont")] + "/")
+
+    scan(os.path.join(wf, "tasks"), "")
+    return {"workflow_id": workflow_id, "status": info.get("status"),
+            "error": info.get("error", ""), "steps": steps}
 
 
 def delete(workflow_id: str):

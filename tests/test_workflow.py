@@ -110,3 +110,156 @@ def test_delete(wf_storage):
     workflow.delete("w4")
     with pytest.raises(ValueError):
         workflow.get_status("w4")
+
+
+# ---------------------------------------------------------------------------
+# dynamic workflows: continuations, events, per-step metadata
+# (reference: workflow_executor.py continuations, wait_for_event,
+#  step metadata in storage)
+# ---------------------------------------------------------------------------
+def test_continuation_extends_dag(wf_storage):
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def plan(x):
+        # dynamically extend: the task's result IS a new sub-DAG
+        return workflow.continuation(double.bind(double.bind(x)))
+
+    assert workflow.run(plan.bind(5), workflow_id="wc1") == 20
+    assert workflow.get_status("wc1") == workflow.WorkflowStatus.SUCCESSFUL
+    # the continuation DAG was durably persisted
+    meta = workflow.get_metadata("wc1")
+    assert any(s.get("continuation") for s in meta["steps"].values())
+
+
+def test_nested_continuations(wf_storage):
+    @rt.remote
+    def add1(x):
+        return x + 1
+
+    @rt.remote
+    def inner(x):
+        return workflow.continuation(add1.bind(x))
+
+    @rt.remote
+    def outer(x):
+        return workflow.continuation(inner.bind(x))
+
+    assert workflow.run(outer.bind(10), workflow_id="wc2") == 11
+
+
+def test_continuation_survives_kill_restart(wf_storage, tmp_path):
+    """A workflow killed MID-CONTINUATION resumes from storage: the
+    producing task is not re-run (its continuation was persisted
+    first), and only the unfinished continuation tasks execute."""
+    import subprocess
+    import sys
+    import time as _time
+
+    store = wf_storage
+    marker = str(tmp_path / "ran_marker")
+    block = str(tmp_path / "block")
+    driver = f"""
+import os, time
+import ray_tpu as rt
+from ray_tpu import workflow
+
+rt.init(num_workers=2, num_cpus=4)
+workflow.init_storage({store!r})
+
+@rt.remote
+def plan(x):
+    # count how many times the producing task runs
+    with open({marker!r}, "a") as f:
+        f.write("plan\\n")
+    return workflow.continuation(slow_add.bind(x))
+
+@rt.remote
+def slow_add(x):
+    # first run blocks forever (the driver gets killed here)
+    while not os.path.exists({block!r}):
+        time.sleep(0.1)
+    return x + 1
+
+workflow.run(plan.bind(41), workflow_id="wkill")
+"""
+        # wait until the continuation is durably persisted + running
+    p = subprocess.Popen([sys.executable, "-c", driver])
+    deadline = _time.time() + 60
+    cont_seen = False
+    while _time.time() < deadline:
+        for root, _dirs, files in os.walk(os.path.join(store, "wkill")):
+            if any(f.endswith(".cont.pkl") for f in files):
+                cont_seen = True
+        if cont_seen:
+            break
+        _time.sleep(0.2)
+    assert cont_seen, "continuation never persisted"
+    p.kill()
+    p.wait()
+    assert workflow.get_status("wkill") == workflow.WorkflowStatus.RESUMABLE
+    with open(block, "w") as f:
+        f.write("go")  # unblock the continuation task for the resume
+    assert workflow.resume("wkill") == 42
+    # the producing task ran exactly once (continuation resumed, not
+    # re-planned)
+    with open(marker) as f:
+        assert f.read().count("plan") == 1
+
+
+def test_wait_for_event_blocks_then_delivers(wf_storage):
+    import threading
+    import time as _time
+
+    @rt.remote
+    def combine(payload, y):
+        return (payload, y)
+
+    @rt.remote
+    def seven():
+        return 7
+
+    dag = combine.bind(workflow.wait_for_event("go"), seven.bind())
+
+    def deliver():
+        _time.sleep(0.5)
+        workflow.send_event("wev1", "go", {"user": "alice"})
+
+    t = threading.Thread(target=deliver, daemon=True)
+    t.start()
+    out = workflow.run(dag, workflow_id="wev1")
+    assert out == ({"user": "alice"}, 7)
+    t.join()
+
+
+def test_event_is_durable_across_resume(wf_storage):
+    @rt.remote
+    def identity(x):
+        return x
+
+    dag = identity.bind(workflow.wait_for_event("sig", timeout_s=0.2))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wev2")
+    workflow.send_event("wev2", "sig", 99)
+    assert workflow.resume("wev2") == 99  # event persisted in storage
+
+
+def test_step_metadata_recorded(wf_storage):
+    @rt.remote
+    def a():
+        return 1
+
+    @rt.remote
+    def b(x):
+        return x + 1
+
+    workflow.run(b.bind(a.bind()), workflow_id="wmeta")
+    meta = workflow.get_metadata("wmeta")
+    assert meta["status"] == workflow.WorkflowStatus.SUCCESSFUL
+    assert len(meta["steps"]) == 2
+    for step in meta["steps"].values():
+        assert step["status"] == "SUCCESSFUL"
+        assert step["end_ts"] >= step["start_ts"]
+        assert step["kind"] == "task"
