@@ -7,6 +7,8 @@ checkpoint/resume.  Trainers integrate via `Tuner(JaxTrainer(...))`.
 
 from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
+    PB2,
+    HyperBandForBOHB,
     ASHAScheduler,
     FIFOScheduler,
     HyperBandScheduler,
@@ -15,6 +17,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BOHBSearcher,
     BasicVariantGenerator,
     Searcher,
     TPESearcher,
@@ -40,6 +43,9 @@ __all__ = [
     "ResultGrid",
     "Searcher",
     "TPESearcher",
+    "PB2",
+    "HyperBandForBOHB",
+    "BOHBSearcher",
     "Trainable",
     "TrialScheduler",
     "TuneConfig",

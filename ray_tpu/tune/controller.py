@@ -131,6 +131,11 @@ class TuneController:
         # results arrive (reference: SearchGenerator feeding
         # TuneController); a pre-generated trial list leaves it None
         self.searcher = searcher
+        # exhausted searchers stop SUGGESTING but keep receiving
+        # result/complete feedback for their still-running trials.
+        # Non-adaptive searchers were fully enumerated by the Tuner
+        # already — feedback only, never pulled.
+        self._search_exhausted = not getattr(searcher, "adaptive", False)
         self.trainable_def = trainable_def
         self.trials = trials
         self.experiment_dir = experiment_dir
@@ -247,12 +252,13 @@ class TuneController:
             # adaptive search: pull fresh configs once capacity frees
             while (
                 self.searcher is not None
+                and not self._search_exhausted
                 and len(running) + len(pending) < self.max_concurrent
             ):
                 tid = new_trial_id()
                 cfg = self.searcher.suggest(tid)
                 if cfg is None:
-                    self.searcher = None
+                    self._search_exhausted = True
                     break
                 t = Trial(trial_id=tid, config=cfg)
                 self.trials.append(t)
@@ -324,6 +330,21 @@ class TuneController:
             return
         trial.last_result = result
         trial.metrics_history.append(result)
+        if self.searcher is not None:
+            # intermediate feedback for multi-fidelity searchers (BOHB
+            # fits its model on the largest budget with enough points)
+            try:
+                self.searcher.on_trial_result(trial.trial_id, result)
+            except Exception:
+                # a broken feedback channel silently degrades a
+                # model-based search to random — warn once, loudly
+                if not getattr(self, "_searcher_feedback_warned", False):
+                    self._searcher_feedback_warned = True
+                    import traceback as _tb
+
+                    print("WARNING: searcher.on_trial_result raised; "
+                          "search feedback disabled for this error:\n"
+                          + _tb.format_exc())
         if self.on_result is not None:
             self.on_result(trial, result)
         it = result.get("training_iteration", 0)

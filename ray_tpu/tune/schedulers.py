@@ -283,3 +283,131 @@ class PopulationBasedTraining(TrialScheduler):
             elif isinstance(out.get(k), (int, float)):
                 out[k] = out[k] * self._rng.choice([0.8, 1.2])
         return out
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant paired with the BOHB searcher (reference:
+    `tune/schedulers/hb_bohb.py` HyperBandForBOHB): budget allocation
+    is HyperBand's; config SELECTION comes from `BOHBSearcher`, which
+    receives every intermediate result via the controller's
+    `on_trial_result` feedback and fits its KDE on the largest budget
+    with enough observations."""
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: `tune/schedulers/pb2.py`,
+    Parker-Holder et al. 2020): PBT's exploit step, but explore picks
+    new hyperparameters with a GP-UCB bandit fit on observed
+    (hyperparams -> reward change) data instead of random perturbation
+    — far more sample-efficient for small populations.
+
+    `hyperparam_bounds`: {key: (low, high)} continuous ranges the
+    bandit searches over (the reference's PB2 API takes the same).
+    """
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+        quantile_fraction: float = 0.25,
+        seed: Optional[int] = None,
+        time_attr: str = "training_iteration",
+        ucb_kappa: float = 1.0,
+        n_candidates: int = 64,
+    ):
+        super().__init__(
+            metric, mode, perturbation_interval,
+            hyperparam_mutations=None,
+            quantile_fraction=quantile_fraction, seed=seed,
+            time_attr=time_attr,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._keys = sorted(self.bounds)
+        # GP dataset: (normalized hyperparam vector, reward delta)
+        self._data: List[tuple] = []
+        self._last_metric: Dict[Any, float] = {}
+
+    # -- data collection ----------------------------------------------
+    def _normalize(self, config: Dict[str, Any]) -> Optional[List[float]]:
+        x = []
+        for k in self._keys:
+            v = config.get(k)
+            if not isinstance(v, (int, float)):
+                return None
+            lo, hi = self.bounds[k]
+            x.append((float(v) - lo) / max(hi - lo, 1e-12))
+        return x
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if self.metric in result and t and t % self.interval == 0:
+            v = float(result[self.metric])
+            if self.mode == "min":
+                v = -v
+            prev = self._last_metric.get(trial.trial_id)
+            self._last_metric[trial.trial_id] = v
+            if prev is not None:
+                x = self._normalize(trial.config)
+                if x is not None:
+                    self._data.append((x, v - prev))
+                    if len(self._data) > 256:  # bound the GP fit cost
+                        self._data = self._data[-256:]
+        return CONTINUE
+
+    def choose_exploit(self, trial, trials):
+        donor = super().choose_exploit(trial, trials)
+        if donor is not None:
+            # exploit resets the trial's lineage (it restarts from the
+            # donor's checkpoint): the next delta must not span the
+            # jump, or the GP learns post-exploit configs are golden
+            self._last_metric.pop(trial.trial_id, None)
+        return donor
+
+    # -- GP-UCB explore ------------------------------------------------
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        if len(self._data) < 4:
+            # cold start: uniform draw inside the bounds
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        X = np.asarray([x for x, _ in self._data])
+        y = np.asarray([d for _, d in self._data])
+        y = (y - y.mean()) / (y.std() + 1e-8)
+        ls = 0.3  # RBF length-scale on [0,1]-normalized inputs
+
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ls**2)
+
+        K = rbf(X, X) + 1e-3 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        except np.linalg.LinAlgError:
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        cand = np.asarray([
+            [self._rng.random() for _ in self._keys]
+            for _ in range(self.n_candidates)
+        ])
+        Ks = rbf(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v**2).sum(axis=0), 1e-12, None)
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cand[int(np.argmax(ucb))]
+        for i, k in enumerate(self._keys):
+            lo, hi = self.bounds[k]
+            out[k] = lo + float(best[i]) * (hi - lo)
+        return out

@@ -159,14 +159,33 @@ def generate_variants(
 
 
 class Searcher:
-    """Pluggable searcher interface (reference: `tune/search/searcher.py`).
-    suggest() returns a config or None when exhausted."""
+    """Pluggable searcher seam (reference: `tune/search/searcher.py`).
+
+    External search libraries plug in by implementing this interface
+    and passing the instance as `Tuner(..., searcher=...)`:
+
+    - `suggest(trial_id)` -> a config dict, or None when the search is
+      exhausted (the controller stops creating trials).
+    - `on_trial_complete(trial_id, result, error)` — terminal feedback.
+    - `on_trial_result(trial_id, result)` — intermediate feedback on
+      every reported result (multi-fidelity searchers like BOHB fit
+      their model on partial-budget observations).
+    - set `adaptive = True` to have the controller pull suggestions
+      lazily as capacity frees (model-based searchers want results
+      before suggesting more); leave False to enumerate up front.
+    """
+
+    adaptive = False
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
     def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None,
                           error: bool = False) -> None:
+        pass
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
         pass
 
 
@@ -363,3 +382,63 @@ class TPESearcher(Searcher):
         for path, dom in deferred:
             _set_in(cfg, path, dom.fn(cfg))
         return cfg
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based config selection (reference:
+    `tune/search/bohb/bohb_search.py` TuneBOHB, native here — the
+    hpbandster dependency doesn't exist in this image).
+
+    BOHB = HyperBand for budget allocation + a TPE/KDE model for
+    picking configs.  The multi-fidelity rule: fit the density model on
+    observations from the LARGEST budget that has at least `n_startup`
+    of them (falling back to smaller budgets), so early low-budget
+    results guide the search immediately and high-budget results take
+    over as they accumulate.  Pair with `HyperBandForBOHB`.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", num_samples: int = 32,
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None,
+                 time_attr: str = "training_iteration"):
+        super().__init__(param_space, metric=metric, mode=mode,
+                         num_samples=num_samples, n_startup=n_startup,
+                         gamma=gamma, n_candidates=n_candidates,
+                         seed=seed)
+        self.time_attr = time_attr
+        # budget -> [(config, score)] observations
+        self._budget_obs: Dict[int, List[tuple]] = {}
+
+    def _record(self, trial_id: str, result: Optional[Dict]) -> None:
+        cfg = self._live.get(trial_id)
+        if cfg is None or not result or self.metric not in result:
+            return
+        budget = int(result.get(self.time_attr, 0))
+        v = float(result[self.metric])
+        score = v if self.mode == "max" else -v
+        self._budget_obs.setdefault(budget, []).append((cfg, score))
+
+    def on_trial_result(self, trial_id, result):
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        # the final result already arrived via on_trial_result (the
+        # controller feeds every result through it) — recording here
+        # again would double the KDE mass of completed trials
+        self._live.pop(trial_id, None)
+
+    def _model_obs(self) -> List[tuple]:
+        """Observations at the largest budget with >= n_startup points;
+        else everything pooled (cold start)."""
+        for budget in sorted(self._budget_obs, reverse=True):
+            obs = self._budget_obs[budget]
+            if len(obs) >= self.n_startup:
+                return obs
+        return [o for obs in self._budget_obs.values() for o in obs]
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        # swap the fidelity-selected observations into the TPE
+        # machinery, then reuse the base suggest wholesale
+        self._observed = self._model_obs()
+        return super().suggest(trial_id)

@@ -174,11 +174,11 @@ class Tuner:
             resources_per_trial=self.tune_config.resources_per_trial,
             metric=self.tune_config.metric,
             mode=self.tune_config.mode,
-            searcher=(
-                self.tune_config.search_alg
-                if getattr(self.tune_config.search_alg, "adaptive", False)
-                else None
-            ),
+            # non-adaptive searchers enumerated their trials up front
+            # but still receive result/complete feedback (the seam's
+            # documented contract); the controller gates SUGGESTING on
+            # the adaptive flag itself
+            searcher=self.tune_config.search_alg,
         )
         controller.run()
         controller.save_experiment_state()
