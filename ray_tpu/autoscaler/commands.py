@@ -34,7 +34,12 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider, worker_startup_script
+from ray_tpu.autoscaler.gcp import (
+    _LIVE_STATES,
+    GcpTpuNodeProvider,
+    head_startup_script,
+    worker_startup_script,
+)
 
 
 def load_cluster_config(path: str) -> Dict[str, Any]:
@@ -109,7 +114,7 @@ def up(cfg: Dict[str, Any], *, transport=None, _print=print) -> Dict[str, Any]:
             "rt-node-type", "worker"
         )
         for n in nodes
-        if n.get("state") in ("CREATING", "READY", "STARTING", "REPAIRING")
+        if n.get("state") in _LIVE_STATES
     }
     created: Dict[str, List[str]] = {"head": [], "worker": []}
     have_head = "head" in live.values()
@@ -127,7 +132,14 @@ def up(cfg: Dict[str, Any], *, transport=None, _print=print) -> Dict[str, Any]:
             {"node_type": "head",
              "accelerator_type": cfg.get("head", {}).get(
                  "accelerator_type",
-                 cfg["provider"].get("accelerator_type", "v5e-8"))},
+                 cfg["provider"].get("accelerator_type", "v5e-8")),
+             # the head bootstraps its own daemon (controller + noded
+             # bound on all interfaces) instead of the worker script
+             "startup_script": head_startup_script(
+                 int(cfg.get("head", {}).get("controller_port", 7777)),
+                 num_workers=int(cfg.get("head", {}).get(
+                     "num_workers", 0)),
+             )},
             1,
         )
         _print(f"created head node {created['head'][0]}")
@@ -159,17 +171,82 @@ def status(cfg: Dict[str, Any], *, transport=None) -> List[Dict[str, Any]]:
     return _provider_for(cfg, transport).list_cluster_nodes()
 
 
+# ----------------------------------------------------------------------
+# attach / exec (reference: `ray attach` / `ray exec`,
+# `autoscaler/_private/commands.py` + `command_runner.py`)
+# ----------------------------------------------------------------------
+def _head_runner(cfg: Dict[str, Any], *, transport=None,
+                 runner_factory=None):
+    """CommandRunner for the cluster's head node.  `runner_factory`
+    (ip -> CommandRunner) is the injection seam tests use."""
+    provider = _provider_for(cfg, transport)
+    head_id = None
+    for n in provider._list():
+        if n.get("labels", {}).get("rt-node-type") == "head" and \
+                n.get("state") in _LIVE_STATES:
+            head_id = n["name"].rsplit("/", 1)[-1]
+            break
+    if head_id is None:
+        raise RuntimeError(
+            f"cluster {cfg['cluster_name']!r} has no live head node; "
+            "run `rt up` first"
+        )
+    ip = provider.node_ip(head_id)
+    if ip is None:
+        raise RuntimeError(f"head node {head_id} reports no IP yet")
+    if runner_factory is not None:
+        return runner_factory(ip)
+    from ray_tpu.autoscaler.command_runner import runner_for
+
+    return runner_for(cfg, ip)
+
+
+def exec_on_head(cfg: Dict[str, Any], command: str, *, transport=None,
+                 runner_factory=None, timeout: Optional[float] = None):
+    """Run one shell command on the head node; returns (rc, output)
+    (reference: `ray exec`)."""
+    runner = _head_runner(cfg, transport=transport,
+                          runner_factory=runner_factory)
+    return runner.run(command, timeout=timeout)
+
+
+def attach(cfg: Dict[str, Any], *, transport=None, runner_factory=None,
+           _print=print) -> int:
+    """Interactive shell on the head node (reference: `ray attach`).
+    Prints the equivalent ssh command first so the session is
+    reproducible without the CLI."""
+    runner = _head_runner(cfg, transport=transport,
+                          runner_factory=runner_factory)
+    _print("attaching: " + " ".join(runner.remote_shell_command("bash")))
+    return runner.run_interactive("bash")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="rt-cluster", description=__doc__)
-    p.add_argument("command", choices=["up", "down", "status"])
+    p.add_argument("command",
+                   choices=["up", "down", "status", "exec", "attach"])
     p.add_argument("config", help="cluster YAML path")
     p.add_argument("--dry-run", action="store_true",
                    help="print the API calls instead of making them")
+    p.add_argument("--cmd", default=None,
+                   help="shell command for `exec`")
     args = p.parse_args(argv)
     cfg = load_cluster_config(args.config)
     transport = _DryRunTransport() if args.dry_run else None
+    if args.command in ("attach", "exec") and args.dry_run:
+        # these commands run over ssh, not the provider API — there is
+        # no call list to preview
+        p.error(f"--dry-run is not supported with {args.command}")
+    if args.command == "attach":
+        return attach(cfg, transport=transport)
+    if args.command == "exec":
+        if not args.cmd:
+            p.error("exec requires --cmd")
+        rc, out = exec_on_head(cfg, args.cmd, transport=transport)
+        print(out, end="")
+        return rc
     fn = {"up": up, "down": down, "status": status}[args.command]
     out = fn(cfg, transport=transport)
     if args.dry_run:

@@ -196,6 +196,21 @@ class GcpTpuNodeProvider(NodeProvider):
         # just made — no extra API call per node
         return self._node_states.get(provider_id) == "READY"
 
+    def node_ip(self, provider_id: str) -> Optional[str]:
+        """Reachable IP of a node (external accessConfig when present,
+        else the internal endpoint) — what `rt attach/exec` ssh to."""
+        for n in self._list():
+            if n["name"].rsplit("/", 1)[-1] != provider_id:
+                continue
+            for ep in n.get("networkEndpoints", []):
+                ac = ep.get("accessConfig") or {}
+                if ac.get("externalIp"):
+                    return ac["externalIp"]
+            for ep in n.get("networkEndpoints", []):
+                if ep.get("ipAddress"):
+                    return ep["ipAddress"]
+        return None
+
     def list_cluster_nodes(self) -> List[Dict[str, Any]]:
         """Live cluster members from ONE list call: id, type label, and
         per-host resources (avoids the 1+N listing pattern a per-node
@@ -246,9 +261,38 @@ def worker_startup_script(controller_host: str, controller_port: int,
         "'http://metadata.google.internal/computeMetadata/v1/instance/"
         "attributes/rt-labels' || echo '{}')",
         '[ -n "$RT_LABELS" ] || RT_LABELS=\'{}\'',
+        # bind all interfaces + advertise the VM's routable IP: peers
+        # on OTHER hosts dial the registered address for object
+        # transfer / node routing — loopback would point them at
+        # themselves
+        "export RT_BIND_HOST=0.0.0.0",
         "nohup python3 -m ray_tpu.core.noded "
         "--session-dir /tmp/ray_tpu/node "
         f"--controller {controller_host}:{controller_port}{nw} "
         '--labels "$RT_LABELS" '
+        ">> /tmp/ray_tpu/node/noded.out 2>&1 &",
+    ])
+
+
+def head_startup_script(controller_port: int = 7777, *,
+                        num_workers: int = 0,
+                        pip_package: str = "ray_tpu") -> str:
+    """Bootstrap a TPU-VM HEAD node: start the head daemon (controller
+    + noded) bound on all interfaces at a pinned controller port so
+    worker VMs can join (reference analog: the cluster YAML's
+    head_start_ray_commands)."""
+    nw = f" --num-workers {num_workers}" if num_workers else ""
+    return "\n".join([
+        "#!/bin/bash",
+        "set -e",
+        f"python3 -m pip install -q {pip_package} || true",
+        "mkdir -p /tmp/ray_tpu/node",
+        # bind all interfaces + pin the controller port: worker VMs
+        # join via the head's internal IP
+        "export RT_BIND_HOST=0.0.0.0",
+        f"export RT_CONTROLLER_PORT={controller_port}",
+        "nohup python3 -m ray_tpu.core.noded "
+        "--session-dir /tmp/ray_tpu/node "
+        f"--head{nw} "
         ">> /tmp/ray_tpu/node/noded.out 2>&1 &",
     ])

@@ -103,6 +103,15 @@ class Config:
     #: disable durability entirely (no persist loop) (reference: in-memory vs Redis StoreClient
     #: choice, `redis_store_client.h:106`)
     controller_store_url: str = ""
+    #: address the node daemon + controller TCP servers bind.  The
+    #: default keeps single-host clusters loopback-only; multi-host
+    #: TPU-VM clusters set RT_BIND_HOST=0.0.0.0 in the bootstrap
+    #: script so workers on other hosts can join.
+    bind_host: str = "127.0.0.1"
+    #: address ADVERTISED to peers (node registration, controller
+    #: address).  Empty = the bind host, or the primary interface IP
+    #: when binding 0.0.0.0.
+    advertise_host: str = ""
     #: fixed TCP port for the controller (0 = ephemeral).  A pinned
     #: port is what lets worker daemons reconnect to a RESTARTED head
     #: (reference: raylets reconnect to the GCS at its known address,

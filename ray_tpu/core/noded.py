@@ -156,7 +156,11 @@ class NodeDaemon:
         self.unix_server = rpc.Server(self, name=f"noded-{self.node_name}-unix")
         await self.unix_server.start_unix(self.socket_path)
         self.tcp_server = rpc.Server(self, name=f"noded-{self.node_name}-tcp")
-        self.tcp_port = await self.tcp_server.start_tcp("127.0.0.1", 0)
+        bind = self.cfg.bind_host or "127.0.0.1"
+        self._advertise = self.cfg.advertise_host or (
+            _primary_ip() if bind == "0.0.0.0" else bind
+        )
+        self.tcp_port = await self.tcp_server.start_tcp(bind, 0)
 
         if self.is_head:
             from ray_tpu.core.controller import Controller
@@ -175,11 +179,11 @@ class NodeDaemon:
             self.controller._pg_manager = PlacementGroupManager(self.controller)
             ctl_server = rpc.Server(self.controller, name="controller")
             self.controller_port = await ctl_server.start_tcp(
-                "127.0.0.1", self.cfg.controller_port
+                bind, self.cfg.controller_port
             )
             self._ctl_server = ctl_server
             self.controller.start_health_checks()
-            self.controller_addr = ("127.0.0.1", self.controller_port)
+            self.controller_addr = (self._advertise, self.controller_port)
 
         # register with the controller like any node
         await self._connect_controller()
@@ -211,7 +215,7 @@ class NodeDaemon:
             "register_node",
             {
                 "node_id": self.node_id,
-                "addr": ("127.0.0.1", self.tcp_port),
+                "addr": (self._advertise, self.tcp_port),
                 "resources": dict(self.total_resources),
                 "is_head": self.is_head,
                 "labels": dict(self.node_labels),
@@ -1747,6 +1751,23 @@ async def _amain(args):
     asyncio.ensure_future(_parent_watch())
     await stop.wait()
     await daemon.shutdown()
+
+
+def _primary_ip() -> str:
+    """Primary interface IP (what peers on other hosts can reach when
+    binding 0.0.0.0).  The UDP connect never sends a packet; it only
+    asks the kernel which source address routes outward."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
 
 
 def main():

@@ -99,6 +99,64 @@ class DashboardHead:
             return httpd.json_response(await self._ctl("list_actors"))
         if path == "/api/placement_groups":
             return httpd.json_response(await self._ctl("list_placement_groups"))
+        if path == "/api/jobs" and req.method == "POST":
+            # REST job submission (reference: `dashboard/modules/job/
+            # job_head.py:329` POST /api/jobs/): body {entrypoint,
+            # submission_id?, env?, working_dir?, metadata?}
+            try:
+                body = req.json()
+                entrypoint = body["entrypoint"]
+            except Exception:
+                return httpd.json_response(
+                    {"error": "body must be JSON with 'entrypoint'"},
+                    status=400,
+                )
+            loop = asyncio.get_running_loop()
+
+            def _submit():
+                from ray_tpu.job import api as job_api
+
+                return job_api.submit_job(
+                    entrypoint,
+                    submission_id=body.get("submission_id"),
+                    env=body.get("env"),
+                    working_dir=body.get("working_dir"),
+                    metadata=body.get("metadata"),
+                )
+
+            try:
+                job_id = await loop.run_in_executor(None, _submit)
+            except ValueError as e:  # duplicate submission_id etc.
+                return httpd.json_response({"error": str(e)}, status=400)
+            return httpd.json_response(
+                {"job_id": job_id, "submission_id": job_id}
+            )
+        if path.startswith("/api/jobs/"):
+            parts = path.split("/")  # ['', 'api', 'jobs', <id>, (verb)]
+            job_id = parts[3]
+            verb = parts[4] if len(parts) > 4 else None
+            loop = asyncio.get_running_loop()
+            from ray_tpu.job import api as job_api
+
+            try:
+                if verb is None and req.method == "GET":
+                    info = await loop.run_in_executor(
+                        None, job_api.get_job_info, job_id
+                    )
+                    return httpd.json_response(info)
+                if verb == "logs" and req.method == "GET":
+                    logs = await loop.run_in_executor(
+                        None, job_api.get_job_logs, job_id
+                    )
+                    return 200, "text/plain; charset=utf-8", logs.encode()
+                if verb == "stop" and req.method == "POST":
+                    stopped = await loop.run_in_executor(
+                        None, job_api.stop_job, job_id
+                    )
+                    return httpd.json_response({"stopped": bool(stopped)})
+            except ValueError as e:  # unknown job id
+                return httpd.json_response({"error": str(e)}, status=404)
+            return httpd.json_response({"error": "unsupported"}, status=405)
         if path == "/api/jobs":
             jobs = await self._ctl("list_jobs") or []
             # submitted (supervised) jobs live in the KV

@@ -23,7 +23,8 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # cluster-lifecycle commands run WITHOUT a live cluster (reference:
     # `ray up/down` in autoscaler/_private/commands.py)
-    if argv and argv[0] in ("up", "down", "cluster-status"):
+    if argv and argv[0] in ("up", "down", "cluster-status", "attach",
+                            "exec"):
         from ray_tpu.autoscaler.commands import main as cluster_main
 
         cmd = {"cluster-status": "status"}.get(argv[0], argv[0])

@@ -430,12 +430,19 @@ class BOHBSearcher(TPESearcher):
 
     def _model_obs(self) -> List[tuple]:
         """Observations at the largest budget with >= n_startup points;
-        else everything pooled (cold start)."""
+        else one observation per distinct config (cold start) — raw
+        pooling would count a single trial's repeated intermediate
+        reports toward n_startup and flip into model mode after one
+        or two distinct configs."""
         for budget in sorted(self._budget_obs, reverse=True):
             obs = self._budget_obs[budget]
             if len(obs) >= self.n_startup:
                 return obs
-        return [o for obs in self._budget_obs.values() for o in obs]
+        latest: Dict[int, tuple] = {}
+        for obs in self._budget_obs.values():
+            for cfg, score in obs:
+                latest[id(cfg)] = (cfg, score)
+        return list(latest.values())
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         # swap the fidelity-selected observations into the TPE

@@ -165,3 +165,126 @@ def test_worker_startup_script_shape():
     assert "--controller 1.2.3.4:9999" in s
     assert "--num-workers 2" in s
     assert s.startswith("#!/bin/bash")
+
+
+# ---------------------------------------------------------------------------
+# attach / exec over the command-runner seam + head bootstrap
+# (reference: autoscaler/_private/commands.py ray attach/exec,
+#  command_runner.py)
+# ---------------------------------------------------------------------------
+class MockRunner:
+    """Records commands; the injection seam `ray attach/exec` tests use."""
+
+    def __init__(self, ip):
+        self.ip = ip
+        self.commands = []
+
+    def run(self, cmd, *, timeout=None):
+        self.commands.append(cmd)
+        return 0, f"ran on {self.ip}: {cmd}"
+
+    def run_interactive(self, cmd="bash"):
+        self.commands.append(("interactive", cmd))
+        return 0
+
+    def remote_shell_command(self, cmd=""):
+        return ["ssh", f"ubuntu@{self.ip}", cmd]
+
+
+def _dry_run_with_endpoints(t):
+    """Give the dry-run nodes network endpoints so node_ip works."""
+    for node in t.nodes.values():
+        node.setdefault("networkEndpoints", [
+            {"ipAddress": "10.1.0.5",
+             "accessConfig": {"externalIp": "34.1.2.3"}},
+        ])
+
+
+def test_exec_and_attach_via_mock_runner(tmp_path):
+    import yaml
+
+    from ray_tpu.autoscaler.commands import attach, exec_on_head
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    cfg = load_cluster_config(str(cfg_path))
+    t = _DryRunTransport()
+    up(cfg, transport=t, _print=lambda *a: None)
+    _dry_run_with_endpoints(t)
+
+    runners = {}
+
+    def factory(ip):
+        runners[ip] = MockRunner(ip)
+        return runners[ip]
+
+    rc, out = exec_on_head(cfg, "hostname", transport=t,
+                           runner_factory=factory)
+    assert rc == 0
+    # external IP preferred; the command round-tripped
+    assert "34.1.2.3" in runners and out.endswith("hostname")
+    assert runners["34.1.2.3"].commands == ["hostname"]
+
+    rc = attach(cfg, transport=t, runner_factory=factory,
+                _print=lambda *a: None)
+    assert rc == 0
+    assert ("interactive", "bash") in runners["34.1.2.3"].commands
+
+
+def test_exec_without_head_errors(tmp_path):
+    import yaml
+
+    from ray_tpu.autoscaler.commands import exec_on_head
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    cfg = load_cluster_config(str(cfg_path))
+    t = _DryRunTransport()  # no nodes created
+    with pytest.raises(RuntimeError, match="no live head"):
+        exec_on_head(cfg, "true", transport=t,
+                     runner_factory=lambda ip: MockRunner(ip))
+
+
+def test_ssh_and_docker_runner_command_shape():
+    from ray_tpu.autoscaler.command_runner import (
+        DockerCommandRunner,
+        SSHCommandRunner,
+        runner_for,
+    )
+
+    r = SSHCommandRunner("1.2.3.4", ssh_user="tpu",
+                         ssh_private_key="/k.pem")
+    argv = r.remote_shell_command("echo hi")
+    assert argv[0] == "ssh" and "-i" in argv and "tpu@1.2.3.4" in argv
+    assert argv[-1] == "echo hi"
+
+    d = DockerCommandRunner("1.2.3.4", container="rt")
+    wrapped = d._wrap("echo hi")
+    assert wrapped.startswith("docker exec") and "'echo hi'" in wrapped
+
+    cfg = {"auth": {"ssh_user": "u"},
+           "docker": {"container_name": "c1"}}
+    assert isinstance(runner_for(cfg, "5.6.7.8"), DockerCommandRunner)
+    assert isinstance(runner_for({"auth": {}}, "5.6.7.8"),
+                      SSHCommandRunner)
+
+
+def test_head_bootstrap_script_in_up(tmp_path):
+    """`rt up` provisions the head WITH a bootstrap: the startup script
+    starts the head daemon (controller bound on all interfaces at the
+    pinned port)."""
+    import yaml
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(yaml.safe_dump(CFG))
+    cfg = load_cluster_config(str(cfg_path))
+    t = _DryRunTransport()
+    up(cfg, transport=t, _print=lambda *a: None)
+    head_calls = [
+        b for m, u, b in t.calls
+        if m == "POST" and b and b["labels"]["rt-node-type"] == "head"
+    ]
+    script = head_calls[0]["metadata"]["startup-script"]
+    assert "--head" in script
+    assert "RT_BIND_HOST=0.0.0.0" in script
+    assert "RT_CONTROLLER_PORT=7777" in script

@@ -70,3 +70,72 @@ def test_follow_job_logs_streams_until_done(cluster):
     # finished
     assert len(chunks) >= 2
     assert job.get_job_status(jid) == job.JobStatus.SUCCEEDED
+
+
+# ---------------------------------------------------------------------------
+# REST job submission (reference: dashboard/modules/job/job_head.py:329
+# POST /api/jobs/)
+# ---------------------------------------------------------------------------
+def test_rest_job_submit_status_logs_stop(cluster):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    head, (host, port) = start_dashboard()
+    base = f"http://{host}:{port}"
+    try:
+        # submit
+        body = json.dumps({
+            "entrypoint": f"{sys.executable} -c \"print('rest job ran')\"",
+            "metadata": {"owner": "resttest"},
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/api/jobs", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=15) as r:
+            reply = json.loads(r.read())
+        jid = reply["submission_id"]
+        assert reply["job_id"] == jid
+        assert job.wait_job(jid, timeout=60) == job.JobStatus.SUCCEEDED
+        # info
+        with urllib.request.urlopen(f"{base}/api/jobs/{jid}",
+                                    timeout=10) as r:
+            info = json.loads(r.read())
+        assert info["status"] == job.JobStatus.SUCCEEDED
+        assert info["metadata"] == {"owner": "resttest"}
+        # logs
+        with urllib.request.urlopen(f"{base}/api/jobs/{jid}/logs",
+                                    timeout=10) as r:
+            assert b"rest job ran" in r.read()
+        # bad submissions are 400s
+        req = urllib.request.Request(
+            f"{base}/api/jobs", data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        # unknown job id is a 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/api/jobs/nope", timeout=10)
+        assert e.value.code == 404
+        # stop a long-running REST-submitted job
+        body = json.dumps({
+            "entrypoint": f"{sys.executable} -c \"import time; time.sleep(300)\"",
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/api/jobs", data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            jid2 = json.loads(r.read())["job_id"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if job.get_job_status(jid2) == job.JobStatus.RUNNING:
+                break
+            time.sleep(0.2)
+        req = urllib.request.Request(
+            f"{base}/api/jobs/{jid2}/stop", data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert json.loads(r.read())["stopped"] is True
+        assert job.wait_job(jid2, timeout=30) == job.JobStatus.STOPPED
+    finally:
+        rt.kill(head)
