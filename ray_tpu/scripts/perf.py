@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -234,6 +235,146 @@ def measure_task_storm(rt, n: int = 1000) -> Dict[str, float]:
     }
 
 
+# ----------------------------------------------------------------------
+# control-plane core scaling (VERDICT r3 #4: the asyncio-control-plane
+# bet is validated per-core only — measure where CPU time goes and what
+# dedicated cores buy)
+# ----------------------------------------------------------------------
+def _proc_tree_cpu() -> Dict[int, Dict[str, object]]:
+    """pid -> {ppid, role, ticks} for this process and its descendants
+    (driver, node daemon, workers), from /proc — no psutil dependency."""
+    procs: Dict[int, Dict[str, object]] = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                stat = f.read()
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode(
+                    errors="replace")
+        except OSError:
+            continue
+        # comm may contain spaces/parens: split after the LAST ')'
+        rest = stat.rsplit(")", 1)[1].split()
+        ppid = int(rest[1])      # field 4
+        utime = int(rest[11])    # field 14
+        stime = int(rest[12])    # field 15
+        procs[pid] = {"ppid": ppid, "cmdline": cmdline,
+                      "ticks": utime + stime}
+    me = os.getpid()
+    keep: Dict[int, Dict[str, object]] = {}
+    children: Dict[int, List[int]] = {}
+    for pid, info in procs.items():
+        children.setdefault(info["ppid"], []).append(pid)
+    stack = [me]
+    while stack:
+        pid = stack.pop()
+        if pid not in procs:
+            continue
+        keep[pid] = procs[pid]
+        stack.extend(children.get(pid, []))
+    for pid, info in keep.items():
+        cmd = info["cmdline"]
+        if pid == me:
+            info["role"] = "driver"
+        elif "noded" in cmd:
+            info["role"] = "noded"
+        elif "worker_main" in cmd:
+            info["role"] = "worker"
+        else:
+            info["role"] = "other"
+    return keep
+
+
+def measure_core_split(rt, n: int = 1000) -> Dict[str, float]:
+    """Task-storm with per-component CPU accounting: how many CPU
+    microseconds each plane (driver runtime, node daemon, workers)
+    burns per task.  On a 1-core box throughput ~= 1e6 / SUM(us); with
+    each plane on its own core the pipeline bound is 1e6 / MAX(us) —
+    the analytic multi-core projection PERF.md records.  On multi-core
+    rigs combine with --pin-cores for the measured curve."""
+    # warm-up storm: spawn/prestart every worker BEFORE the snapshot,
+    # or their multi-second import cost pollutes the per-task delta
+    measure_task_storm(rt, n=min(200, n))
+    before = _proc_tree_cpu()
+    dist = measure_task_storm(rt, n=n)
+    after = _proc_tree_cpu()
+    tick = os.sysconf("SC_CLK_TCK")
+    split_us = {r: 0.0 for r in ("driver", "noded", "worker", "other")}
+    steady_workers = 0
+    for pid, info in after.items():
+        prev = before.get(pid)
+        if prev is None:
+            continue  # spawned mid-storm: startup cost, not task cost
+        if info["role"] == "worker":
+            steady_workers += 1
+        delta = (info["ticks"] - prev["ticks"]) / tick
+        split_us[info["role"]] += delta * 1e6 / n
+    total_us = sum(v for v in split_us.values() if v > 0)
+    # the worker plane is a POOL: its cost spreads over num_workers
+    # cores; driver and daemon are single event loops (one core each).
+    # Only workers present for the WHOLE storm count — their CPU is
+    # what the deltas above summed.
+    n_workers = max(1, steady_workers)
+    plane_us = {
+        "driver": split_us["driver"],
+        "noded": split_us["noded"],
+        "worker_pool": split_us["worker"] / n_workers,
+    }
+    bottleneck = max(plane_us, key=plane_us.get)
+    # every delta can round to zero ticks on tiny storms
+    # (SC_CLK_TCK=100 -> 10 ms granularity): report, don't divide
+    projected = (
+        round(1e6 / plane_us[bottleneck], 1)
+        if plane_us[bottleneck] > 0 else 0.0
+    )
+    return {
+        **{f"{k}_us_per_task": round(v, 1) for k, v in split_us.items()},
+        "num_workers": float(n_workers),
+        "total_us_per_task": round(total_us, 1),
+        "measured_tasks_per_s": round(dist["tasks_per_s"], 1),
+        "projected_pipelined_tasks_per_s": projected,
+        "bottleneck": bottleneck,
+    }
+
+
+def apply_core_pinning(cores: int) -> Dict[str, List[int]]:
+    """Pin each plane to its own core(s): driver -> 0, node daemon ->
+    1, workers round-robin over the rest (reference analog: the
+    release-test rigs isolate raylet/worker CPU).  Requires a box with
+    >= `cores` cores; returns the placement actually applied.
+
+    Pinning covers processes alive NOW: workers respawned later
+    inherit the daemon's single-core affinity — warm the worker pool
+    first (main() runs a warm-up storm before pinning) and re-apply
+    after any worker churn."""
+    avail = sorted(os.sched_getaffinity(0))
+    if len(avail) < cores:
+        raise RuntimeError(
+            f"--pin-cores {cores} needs {cores} cores; this box exposes "
+            f"{len(avail)} ({avail})"
+        )
+    use = avail[:cores]
+    placement: Dict[str, List[int]] = {}
+    for pid, info in _proc_tree_cpu().items():
+        role = info["role"]
+        if role == "driver":
+            core = use[0]
+        elif role == "noded":
+            core = use[1 % len(use)]
+        else:  # workers + other spread over the remaining cores
+            rest = use[2:] or use
+            core = rest[pid % len(rest)]
+        try:
+            os.sched_setaffinity(pid, {core})
+            placement.setdefault(role, []).append(core)
+        except OSError:
+            pass
+    return placement
+
+
 class _BusbwMember:
     def __init__(self, rank, world, size_mb):
         from ray_tpu.parallel import collectives as col
@@ -287,6 +428,12 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                    help="also measure the 1k-task storm latency "
                         "distribution (scheduling throughput bound)")
     p.add_argument("--storm-n", type=int, default=1000)
+    p.add_argument("--core-split", action="store_true",
+                   help="task storm with per-plane CPU accounting + "
+                        "multi-core pipeline projection")
+    p.add_argument("--pin-cores", type=int, default=0,
+                   help="pin driver/daemon/workers to dedicated cores "
+                        "(needs a box with that many cores)")
     p.add_argument("--busbw", action="store_true",
                    help="also measure host ring-allreduce bus bandwidth")
     p.add_argument("--busbw-world", type=int, default=2)
@@ -302,6 +449,31 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
         ))
     results: Dict[str, Dict[str, float]] = {}
     try:
+        if args.pin_cores:
+            # warm the worker pool BEFORE pinning: workers spawned
+            # after pinning inherit the daemon's core
+            measure_task_storm(rt, n=100)
+            placement = apply_core_pinning(args.pin_cores)
+            print(f"pinned planes to cores: {placement}", flush=True)
+        if args.core_split:
+            split = measure_core_split(rt, n=args.storm_n)
+            print(
+                f"core split ({args.storm_n} tasks): "
+                + ", ".join(
+                    f"{k.split('_')[0]} {split[k]}us"
+                    for k in ("driver_us_per_task", "noded_us_per_task",
+                              "worker_us_per_task", "other_us_per_task")
+                )
+                + f" | measured {split['measured_tasks_per_s']}/s, "
+                f"pipelined-projection "
+                f"{split['projected_pipelined_tasks_per_s']}/s "
+                f"(bottleneck: {split['bottleneck']})",
+                flush=True,
+            )
+            results["core_split"] = {
+                k: v for k, v in split.items() if isinstance(v, float)
+            }
+            results["core_split"]["bottleneck"] = split["bottleneck"]  # type: ignore[assignment]
         for name, factory, mult in build_matrix(rt, args):
             if args.filter and args.filter not in name:
                 continue

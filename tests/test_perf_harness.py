@@ -32,3 +32,35 @@ def test_perf_harness_actor_row():
         "--num-workers", "2",
     ])
     assert results["1:1 actor calls sync"]["ops_per_s"] > 0
+
+
+def test_core_split_accounting():
+    """--core-split: per-plane CPU accounting is internally consistent
+    (planes identified, per-task costs positive, projection computed)."""
+    from ray_tpu.scripts.perf import main
+
+    results = main([
+        "--filter", "ZZZNONE",  # skip the matrix; core-split only
+        "--core-split",
+        "--storm-n", "300",
+        "--num-workers", "2",
+    ])
+    split = results["core_split"]
+    assert split["measured_tasks_per_s"] > 0
+    assert split["projected_pipelined_tasks_per_s"] > 0
+    # the storm burned driver + worker CPU; the daemon plane is cheap
+    assert split["driver_us_per_task"] > 0
+    assert split["worker_us_per_task"] > 0
+    assert split["bottleneck"] in ("driver", "noded", "worker_pool")
+
+
+def test_pin_cores_rejects_oversubscription():
+    import os
+
+    import pytest
+
+    from ray_tpu.scripts.perf import apply_core_pinning
+
+    have = len(os.sched_getaffinity(0))
+    with pytest.raises(RuntimeError, match="needs"):
+        apply_core_pinning(have + 1)
