@@ -1,20 +1,49 @@
-"""Driver benchmark: GPT-2 124M pretraining throughput on one chip.
+"""Driver benchmarks: single-chip training throughput.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Mirrors BASELINE.json config #2 (Ray Train GPT-2 124M pretraining,
-reference: ray/release/air_tests/air_benchmarks) scaled to the single
-chip the driver provides.  `vs_baseline` is measured MFU divided by
-0.30 — the model-flops-utilization a tuned torch-DDP GPT-2 run of this
-size typically reaches on the reference's GPU path — so >1.0 means the
-TPU-native step beats the reference's utilization.
+Configs (--config):
+- gpt2 (default): BASELINE config #2 — GPT-2 124M pretraining
+  (reference: ray/release/air_tests/air_benchmarks), 6*N FLOPs/token.
+- llama_lora: BASELINE config #4 — Llama LoRA fine-tune (frozen bf16
+  base + rank-8 adapters), 4*N FLOPs/token (no weight-grad matmuls
+  for frozen weights).
+
+`vs_baseline` is measured MFU divided by 0.30 — the
+model-flops-utilization a tuned torch run of this size typically
+reaches on the reference's GPU path — so >1.0 means the TPU-native
+step beats the reference's utilization.
 """
 
 from __future__ import annotations
 
 import json
 import time
+
+
+def _run_timed(step_once, iters, *, tokens_per_iter, flops_per_token,
+               metric):
+    """Shared warmup + timing + MFU harness; `step_once()` runs one
+    compiled train step (managing its own state) and returns the
+    metrics dict.  The float() reads force device->host syncs —
+    block_until_ready does NOT round-trip through the axon tunnel."""
+    float(step_once()["loss"])  # warmup / compile
+
+    t0 = time.perf_counter()
+    for _ in range(iters - 1):
+        step_once()
+    float(step_once()["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = tokens_per_iter * iters / dt
+    mfu = tokens_per_sec * flops_per_token / _peak_flops_per_device()
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.30, 4),
+    }))
 
 
 def _peak_flops_per_device() -> float:
@@ -69,6 +98,9 @@ def bench_llama_lora() -> None:
         batch, seq, iters = 2, 128, 3
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    # the base is FROZEN: no optimizer state, no f32 master needed —
+    # store it bf16 (halves base HBM and weight-read bandwidth)
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
     lora = llama.init_lora(cfg, jax.random.PRNGKey(1), rank=8)
     opt = optax.adamw(2e-4)
     opt_state = opt.init(lora)
@@ -79,26 +111,20 @@ def bench_llama_lora() -> None:
     step = jax.jit(
         llama.make_lora_train_step(cfg, opt), donate_argnums=(1, 2)
     )
-    lora, opt_state, metrics = step(params, lora, opt_state, tokens)
-    float(metrics["loss"])  # forced host read syncs through the tunnel
+    state = {"lora": lora, "opt": opt_state}
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        lora, opt_state, metrics = step(params, lora, opt_state, tokens)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    def step_once():
+        state["lora"], state["opt"], metrics = step(
+            params, state["lora"], state["opt"], tokens
+        )
+        return metrics
 
-    tokens_per_sec = batch * seq * iters / dt
-    n_params = llama.num_params(params)
-    mfu = tokens_per_sec * 4 * n_params / _peak_flops_per_device()
-    vs_baseline = mfu / 0.30  # same tuned-reference-MFU bar as gpt2
-    print(json.dumps({
-        "metric": ("llama_1b4_lora_tokens_per_sec_per_chip" if on_tpu
-                   else "llama_lora_scaled_tokens_per_sec_cpu"),
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(vs_baseline, 4),
-    }))
+    _run_timed(
+        step_once, iters, tokens_per_iter=batch * seq,
+        flops_per_token=4 * llama.num_params(params),
+        metric=("llama_1b4_lora_tokens_per_sec_per_chip" if on_tpu
+                else "llama_lora_scaled_tokens_per_sec_cpu"),
+    )
 
 
 def main() -> None:
@@ -144,35 +170,20 @@ def bench_gpt2() -> None:
     )
 
     step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
-    # warmup / compile; float() forces a device->host sync (block_until_ready
-    # does not round-trip through the axon tunnel)
-    params, opt_state, metrics = step(params, opt_state, tokens)
-    float(metrics["loss"])
+    state = {"params": params, "opt": opt_state}
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, metrics = step(params, opt_state, tokens)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * iters / dt
-    # 6*N*T fwd+bwd FLOPs per token (PaLM appendix convention, non-attn)
-    n_params = gpt2.num_params(params)
-    flops_per_token = 6 * n_params
-    mfu = tokens_per_sec * flops_per_token / _peak_flops_per_device()
-    vs_baseline = mfu / 0.30
-
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
-                if on_tpu
-                else "gpt2_scaled_train_tokens_per_sec_cpu",
-                "value": round(tokens_per_sec, 2),
-                "unit": "tokens/s",
-                "vs_baseline": round(vs_baseline, 4),
-            }
+    def step_once():
+        state["params"], state["opt"], metrics = step(
+            state["params"], state["opt"], tokens
         )
+        return metrics
+
+    # 6*N FLOPs/token fwd+bwd (PaLM appendix convention, non-attn)
+    _run_timed(
+        step_once, iters, tokens_per_iter=batch * seq,
+        flops_per_token=6 * gpt2.num_params(state["params"]),
+        metric=("gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
+                else "gpt2_scaled_train_tokens_per_sec_cpu"),
     )
 
 
