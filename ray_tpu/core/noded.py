@@ -1115,7 +1115,10 @@ class NodeDaemon:
             return {"error": "no such worker"}
         import shutil
 
-        if payload.get("native") and shutil.which("py-spy"):
+        if payload.get("native") and shutil.which("py-spy") \
+                and payload.get("mode", "stacks") == "stacks":
+            # py-spy covers the one-shot dump only; flamegraph/memory
+            # modes always use the in-process profilers
             proc = await asyncio.create_subprocess_exec(
                 "py-spy", "dump", "--pid", str(w.pid),
                 stdout=asyncio.subprocess.PIPE,
@@ -1125,11 +1128,29 @@ class NodeDaemon:
             return {"stacks": out.decode(errors="replace"), "pid": w.pid}
         if w.conn is None or w.conn.closed:
             return {"error": "worker not connected"}
+        # mode: stacks (default, one-shot) | flamegraph (sampled CPU,
+        # folded-stack output) | memory (tracemalloc window) —
+        # reference: py-spy dump/record + memray in profile_manager.py
+        mode = payload.get("mode", "stacks")
+        duration = float(payload.get("duration_s", 5.0))
         try:
-            stacks = await w.conn.call("dump_stacks", None, timeout=10)
+            if mode == "flamegraph":
+                out = await w.conn.call(
+                    "profile_cpu", {"duration_s": duration,
+                                    "hz": payload.get("hz", 99.0)},
+                    timeout=duration + 30,
+                )
+            elif mode == "memory":
+                out = await w.conn.call(
+                    "profile_memory", {"duration_s": duration,
+                                       "top": payload.get("top", 30)},
+                    timeout=duration + 30,
+                )
+            else:
+                out = await w.conn.call("dump_stacks", None, timeout=10)
         except Exception as e:
             return {"error": str(e)}
-        return {"stacks": stacks, "pid": w.pid}
+        return {"stacks": out, "pid": w.pid, "mode": mode}
 
     async def _fanout_once(self, method: str, payload: Dict[str, Any],
                            done=None, timeout: float = 10.0,

@@ -2417,18 +2417,34 @@ class Runtime:
         """All-thread stack dump for the on-demand profiler (reference:
         py-spy dump via `profile_manager.py:78`; this is the in-process
         fallback that needs no native tooling)."""
-        import sys as _sys
-        import traceback as _tb
+        from ray_tpu.util.profiling import dump_all_stacks
 
-        frames = _sys._current_frames()
-        names = {t.ident: t.name for t in threading.enumerate()}
-        parts = []
-        for tid, frame in frames.items():
-            parts.append(
-                f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
-                + "".join(_tb.format_stack(frame))
-            )
-        return "\n".join(parts)
+        return dump_all_stacks()
+
+    async def _h_profile_cpu(self, payload, conn):
+        """Sampled CPU flamegraph of this worker (reference: py-spy
+        record --format flamegraph): folded stacks over a window, run
+        off-loop so sampling never blocks task execution."""
+        from ray_tpu.util.profiling import sample_flamegraph
+
+        duration = min(float((payload or {}).get("duration_s", 5.0)), 60.0)
+        hz = min(float((payload or {}).get("hz", 99.0)), 500.0)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: sample_flamegraph(duration, hz)
+        )
+
+    async def _h_profile_memory(self, payload, conn):
+        """Windowed allocation profile (reference: memray heap
+        profiles): stdlib tracemalloc diff over a window, off-loop."""
+        from ray_tpu.util.profiling import memory_profile
+
+        duration = min(float((payload or {}).get("duration_s", 5.0)), 60.0)
+        top = int((payload or {}).get("top", 30))
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: memory_profile(duration, top)
+        )
 
     async def _h_set_accel_env(self, payload, conn):
         """Daemon push at lease-grant time: accelerator isolation env

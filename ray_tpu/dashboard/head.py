@@ -184,15 +184,27 @@ class DashboardHead:
                 )
             from ray_tpu.core.runtime import get_runtime
 
+            mode = req.query_params.get("mode", "stacks")
+            duration = min(
+                float(req.query_params.get("duration", "5")), 60.0
+            )
             reply = await get_runtime().noded.call(
                 "route_node",
                 {"node_id": node_id, "method": "profile_worker",
                  "payload": {
                      "worker_id": worker_id,
                      "native": req.query_params.get("native") == "1",
+                     "mode": mode,
+                     "duration_s": duration,
                  }},
-                timeout=20,
+                timeout=duration + 40,
             )
+            if mode == "flamegraph" and isinstance(reply, dict) \
+                    and "stacks" in reply:
+                # folded stacks as plain text: paste straight into
+                # speedscope / flamegraph.pl
+                return (200, "text/plain; charset=utf-8",
+                        str(reply["stacks"]).encode())
             return httpd.json_response(reply)
         if path == "/api/tasks":
             limit = int(req.query_params.get("limit", "100"))

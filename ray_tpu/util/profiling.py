@@ -1,0 +1,103 @@
+"""On-demand worker profiling: CPU flamegraphs + heap profiles.
+
+Reference: `dashboard/modules/reporter/profile_manager.py:78` — py-spy
+CPU flamegraphs and memray heap profiles per worker.  Neither tool is
+a dependency here: the CPU profiler is a native wall-clock sampler
+over `sys._current_frames()` emitting standard FOLDED stacks (the
+flamegraph.pl / speedscope input format), and the heap profiler rides
+stdlib `tracemalloc` for allocations during a window.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List
+
+
+def sample_flamegraph(duration_s: float = 5.0, hz: float = 99.0,
+                      top: int = 0) -> str:
+    """Sample every thread's stack for `duration_s` at `hz` and return
+    folded-stack text: one line per unique stack,
+    `func (file:line);...;leaf N` — paste into speedscope or
+    flamegraph.pl.  Wall-clock sampling (like py-spy's default): a
+    thread blocked in IO shows where it waits."""
+    me = threading.get_ident()
+    counts: Dict[str, int] = {}
+    interval = 1.0 / max(hz, 1.0)
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            parts: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(
+                    f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}"
+                    f":{f.f_lineno})"
+                )
+                f = f.f_back
+            stack = ";".join(reversed(parts))
+            counts[stack] = counts.get(stack, 0) + 1
+        time.sleep(interval)
+    lines = sorted(counts.items(), key=lambda kv: -kv[1])
+    if top:
+        lines = lines[:top]
+    return "\n".join(f"{stack} {n}" for stack, n in lines)
+
+
+_memory_profile_lock = threading.Lock()
+
+
+def memory_profile(duration_s: float = 5.0, top: int = 30) -> str:
+    """Allocations made during a `duration_s` window, grouped by
+    allocation site (stdlib tracemalloc; the memray-analog tier).
+    Returns one line per site: `size_kb count file:line <- caller`.
+    Serialized process-wide: tracemalloc tracing is global state, and
+    one window's stop() must not kill another's."""
+    import tracemalloc
+
+    with _memory_profile_lock:
+        started_here = not tracemalloc.is_tracing()
+        if started_here:
+            tracemalloc.start(8)  # frames per allocation site
+        before = tracemalloc.take_snapshot()
+        time.sleep(duration_s)
+        after = tracemalloc.take_snapshot()
+        if started_here:
+            tracemalloc.stop()
+    # positives FIRST, then slice: compare_to sorts by |size_diff|, so
+    # slicing first would let big frees crowd out allocation sites
+    stats = [s for s in after.compare_to(before, "traceback")
+             if s.size_diff > 0]
+    out: List[str] = []
+
+    def _frame_str(frame) -> str:
+        return f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+
+    for s in stats[:top]:
+        frames = list(s.traceback)  # oldest -> newest
+        site = _frame_str(frames[-1]) if frames else "?"
+        caller = _frame_str(frames[-2]) if len(frames) >= 2 else ""
+        out.append(
+            f"{s.size_diff / 1024:.1f}kB x{s.count_diff} {site}"
+            + (f" <- {caller}" if caller else "")
+        )
+    return "\n".join(out) or "(no net allocations in window)"
+
+
+def dump_all_stacks() -> str:
+    """One-shot all-thread stack dump (the original /api/profile
+    behavior)."""
+    out = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        out.append(
+            f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(out)

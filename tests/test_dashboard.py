@@ -188,3 +188,51 @@ def test_state_list_workers_uses_snapshot(dash):
     assert snap, "controller never cached a worker snapshot"
     listed = state.list_workers()
     assert len(listed) >= len([w for w in snap if w["kind"] == "worker"])
+
+
+def test_profile_flamegraph_and_memory(dash):
+    """Sampled CPU flamegraph (folded stacks) + tracemalloc heap window
+    per worker (reference: py-spy record + memray via
+    profile_manager.py:78)."""
+    import urllib.request
+
+    @rt.remote
+    def busy(sec):
+        import time as _t
+
+        end = _t.time() + sec
+        acc = 0
+        while _t.time() < end:
+            acc += sum(range(200))
+        return acc
+
+    rt.get(busy.remote(0.01), timeout=30)  # warm: busy lands on a LISTED worker
+    workers = json.loads(
+        urllib.request.urlopen(dash + "/api/workers", timeout=10).read()
+    )
+    # the busy window must outlive one sequential profile per worker
+    budget = 6.0 + 3.0 * len(workers)
+    ref = busy.remote(budget)
+    hot, lines, url = [], [], None
+    for target in workers:
+        if not target.get("worker_id"):
+            continue
+        url = (dash + f"/api/profile?node_id={target['node_id']}"
+               f"&worker_id={target['worker_id']}")
+        with urllib.request.urlopen(f"{url}&mode=flamegraph&duration=1.5",
+                                    timeout=45) as r:
+            folded = r.read().decode()
+        lines += [ln for ln in folded.splitlines() if ln.strip()]
+        hot += [ln for ln in folded.splitlines() if "busy" in ln]
+        if hot:
+            break  # found the hot worker; no need to profile the rest
+    # folded-stack format: "frame;frame;... N" lines
+    assert lines and all(ln.rsplit(" ", 1)[1].isdigit() for ln in lines)
+    assert any(";" in ln for ln in lines)
+    # the sampler caught the hot loop on whichever worker ran it
+    assert hot, lines[:5]
+    with urllib.request.urlopen(f"{url}&mode=memory&duration=1",
+                                timeout=45) as r:
+        mem = json.loads(r.read())
+    assert "stacks" in mem and mem["mode"] == "memory"
+    rt.get(ref, timeout=budget + 30)
