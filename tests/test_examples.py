@@ -55,3 +55,18 @@ def test_serve_llm_example(cluster):
         assert body["tokens"][0] == out[0]
     finally:
         serve.delete("llm")
+
+
+def test_ppo_pixels_example(cluster):
+    """BASELINE config #3 parity demo wiring: the example builds the
+    CNN pixel stack and trains.  Cheap smoke only — full convergence
+    is already proven by test_rllib.py::test_ppo_learns_pixel_catch
+    (same config); re-training to convergence here would double one of
+    the suite's most expensive tests."""
+    import numpy as np
+
+    from ray_tpu.examples import ppo_pixels
+
+    result = ppo_pixels.run(iterations=2, target_return=10.0)
+    assert np.isfinite(result["total_loss"])
+    assert result["num_env_steps_sampled"] > 0
