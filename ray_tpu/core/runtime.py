@@ -1956,12 +1956,7 @@ class Runtime:
                     self._pubsub_queues.pop(channel, None)
             # the RPC may have landed despite the failure (uncertain):
             # a follow-up reconcile unsubscribes anything undesired
-            try:
-                asyncio.run_coroutine_threadsafe(
-                    self._pubsub_reconcile(), self.loop
-                )
-            except Exception:
-                pass
+            self._spawn_pubsub_reconcile()
             if cancelled is not None:
                 raise cancelled
             raise RuntimeError(
@@ -1988,14 +1983,26 @@ class Runtime:
                 # fire-and-forget: close() must not block on a wedged
                 # controller, and the reconciler serializes against any
                 # concurrent subscribe()
-                try:
-                    asyncio.run_coroutine_threadsafe(
-                        self._rt._pubsub_reconcile(), self._rt.loop
-                    )
-                except Exception:
-                    pass
+                self._rt._spawn_pubsub_reconcile()
 
         return _Subscription(self)
+
+    def _spawn_pubsub_reconcile(self) -> None:
+        """Fire-and-forget a reconcile pass on the io loop.  If the loop
+        is already closed (teardown racing a close()), the coroutine
+        object must be explicitly closed — otherwise it is abandoned
+        un-awaited and CPython warns at GC time."""
+        coro = self._pubsub_reconcile()
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        except Exception:
+            coro.close()
+            return
+        # consume the result so a failed pass never surfaces as an
+        # "exception was never retrieved" warning
+        fut.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
 
     async def _pubsub_reconcile(self) -> bool:
         """Single-writer pubsub registration reconciler: drives the
