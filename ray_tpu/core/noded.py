@@ -112,6 +112,7 @@ class NodeDaemon:
         self.num_workers = num_workers or int(ncpu)
         self.store: Optional[ShmStore] = None
         self.workers: Dict[str, WorkerState] = {}  # worker_id -> state
+        self._booting_pids: set = set()  # spawned, not yet registered
         self._conn_worker: Dict[rpc.Connection, str] = {}
         # actor_id -> (ActorCreationSpec, worker_id) for actors this
         # node hosts — re-reported to a restarted controller so the
@@ -312,6 +313,15 @@ class NodeDaemon:
     def _spawn_worker(self) -> None:
         from ray_tpu.core.env_utils import worker_env
 
+        if logger.isEnabledFor(logging.DEBUG):
+            import traceback
+
+            caller = traceback.extract_stack(limit=2)[0]
+            logger.debug(
+                "spawn_worker pending=%d pool=%d from %s:%d",
+                self._pending_spawns, len(self.workers),
+                caller.name, caller.lineno,
+            )
         self._pending_spawns += 1
         env = worker_env()
         env.update(self.cfg.to_env())
@@ -323,27 +333,52 @@ class NodeDaemon:
             stdout=open(os.path.join(self.session_dir, "logs", f"worker-{time.time():.0f}-{os.urandom(2).hex()}.out"), "wb"),
             stderr=subprocess.STDOUT,
         )
+        # booting = spawned but not yet registered; membership (not pid
+        # presence in self.workers) is what decides who releases the
+        # pending-spawn slot, so a registered worker's later death can
+        # never double-release it
+        self._booting_pids.add(proc.pid)
         # the worker introduces itself via `register`; we just remember
         # the proc so we can reap/replace it
         asyncio.ensure_future(self._watch_proc(proc))
 
     async def _watch_proc(self, proc: subprocess.Popen):
+        # a boot that HANGS (rather than crashes) would otherwise hold
+        # its pending-spawn slot forever and wedge the pool at size 0 —
+        # kill it past the deadline so the crash path releases the slot
+        # and the next schedule pass can spawn a fresh worker
+        boot_deadline = time.monotonic() + float(
+            os.environ.get("RT_WORKER_BOOT_TIMEOUT_S", "120")
+        )
         while proc.poll() is None:
+            if (proc.pid in self._booting_pids
+                    and time.monotonic() > boot_deadline):
+                logger.warning(
+                    "worker pid %d still booting after deadline: killing",
+                    proc.pid,
+                )
+                proc.kill()
             await asyncio.sleep(0.2)
-        # find the worker that had this pid
+        if proc.pid in self._booting_pids:
+            # died before registering: release the pending-spawn slot
+            # so on-demand spawning doesn't deadlock on a boot-crashing
+            # worker
+            self._booting_pids.discard(proc.pid)
+            if self._pending_spawns > 0:
+                self._pending_spawns -= 1
+            logger.warning(
+                "worker pid %d exited with %s before registering",
+                proc.pid,
+                proc.returncode,
+            )
+            return
+        # registered at some point: normal death path (a racing
+        # connection-close may have handled it already — then the pid
+        # is no longer in self.workers and this is a no-op)
         for w in list(self.workers.values()):
             if w.pid == proc.pid:
                 self._on_worker_dead(w, f"process exited with {proc.returncode}")
                 return
-        # died before registering: release the pending-spawn slot so
-        # on-demand spawning doesn't deadlock on a boot-crashing worker
-        if self._pending_spawns > 0:
-            self._pending_spawns -= 1
-        logger.warning(
-            "worker pid %d exited with %s before registering",
-            proc.pid,
-            proc.returncode,
-        )
 
     def on_connect(self, conn: rpc.Connection):
         conn.on_close = self._on_conn_close
@@ -399,8 +434,10 @@ class NodeDaemon:
             conn=conn,
             kind=payload["kind"],
         )
-        if w.kind == "worker" and self._pending_spawns > 0:
-            self._pending_spawns -= 1
+        if w.pid in self._booting_pids:
+            self._booting_pids.discard(w.pid)
+            if self._pending_spawns > 0:
+                self._pending_spawns -= 1
         w.socket_path = payload.get("socket_path")
         self.workers[w.worker_id] = w
         self._conn_worker[conn] = w.worker_id
@@ -509,8 +546,14 @@ class NodeDaemon:
             if not dispatched:
                 asyncio.ensure_future(self._maybe_spill(q[0]))
                 break
-        # spawn extra workers if queue is deep and the pool is small
-        if q and len(self.workers) < self.num_workers:
+        # spawn extra workers if queue is deep and the pool is small.
+        # Workers still BOOTING (spawned, not yet registered) count
+        # against the pool — without that, every schedule pass during a
+        # slow boot (jax import takes seconds; worse when the core is
+        # contended) spawns another worker, and each new boot slows the
+        # others further: a spawn storm (reference: starting-worker
+        # accounting in `worker_pool.cc` MaybeStartNewWorker)
+        if q and len(self.workers) + self._pending_spawns < self.num_workers:
             self._spawn_worker()
 
     def _find_worker_for(self, spec: TaskSpec) -> Optional[WorkerState]:
@@ -1642,8 +1685,11 @@ class NodeDaemon:
         self._hosted_actors[aspec.actor_id.binary()] = (
             aspec, target.worker_id
         )
-        # replace the consumed pool worker
-        if sum(1 for w in self.workers.values() if w.kind == "worker" and w.actor_id is None) < self.num_workers:
+        # replace the consumed pool worker (booting spawns count: see
+        # the spawn-storm note in _schedule)
+        free = sum(1 for w in self.workers.values()
+                   if w.kind == "worker" and w.actor_id is None)
+        if free + self._pending_spawns < self.num_workers:
             self._spawn_worker()
         return {"ok": True, "worker_id": target.worker_id}
 
@@ -1731,7 +1777,7 @@ def _default_store_capacity() -> int:
 # ----------------------------------------------------------------------
 async def _amain(args):
     logging.basicConfig(
-        level=logging.INFO,
+        level=os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
         format="%(asctime)s noded %(levelname)s %(message)s",
     )
     daemon = NodeDaemon(

@@ -23,7 +23,7 @@ faulthandler.register(signal.SIGUSR1, all_threads=True)
 
 def main():
     logging.basicConfig(
-        level=os.environ.get("RT_LOG_LEVEL", "INFO"),
+        level=os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
         format="%(asctime)s worker %(levelname)s %(message)s",
     )
     # Import parity with the driver: functions pickled BY REFERENCE
@@ -40,6 +40,12 @@ def main():
         from ray_tpu.core.env_utils import adopt_sys_path
 
         adopt_sys_path(_json.loads(extra))
+    # test hook: simulate the slow-boot regime (heavy imports, axon
+    # tunnel handshakes) that the worker pool's starting-worker
+    # accounting must tolerate without a spawn storm
+    boot_delay = float(os.environ.get("RT_TEST_WORKER_BOOT_DELAY", "0"))
+    if boot_delay > 0:
+        time.sleep(boot_delay)
     node_socket = os.environ["RT_NODE_SOCKET"]
     host, port = os.environ["RT_CONTROLLER"].rsplit(":", 1)
 

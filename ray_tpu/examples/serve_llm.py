@@ -22,7 +22,7 @@ from typing import List, Optional
 
 from ray_tpu import serve
 
-MODEL_SIZES = ("tiny", "llama2_7b", "llama3_8b")
+MODEL_SIZES = ("tiny", "llama1b4", "llama2_7b", "llama3_8b")
 
 
 @serve.deployment(
@@ -39,8 +39,16 @@ class LlamaService:
     """
 
     def __init__(self, model_size: str = "tiny", max_new_tokens: int = 16,
-                 seed: int = 0, max_batch_size: int = 8):
+                 seed: int = 0, max_batch_size: int = 8,
+                 jax_platform: Optional[str] = None):
         import jax
+
+        if jax_platform:
+            # must land before any jax array op touches a backend; an
+            # env var is NOT enough — the image's sitecustomize can bake
+            # its own JAX_PLATFORMS over the inherited one (same
+            # override tests/conftest.py uses)
+            jax.config.update("jax_platforms", jax_platform)
 
         from ray_tpu.models import llama
 
@@ -49,11 +57,32 @@ class LlamaService:
         self._llama = llama
         self.cfg = {
             "tiny": llama.LlamaConfig.tiny,
+            # the per-chip serving unit for a 16 GB v5e-1 (same 1.4B
+            # class as the llama_lora train bench); bigger models shard
+            # over a mesh, the replica stays the per-host unit
+            "llama1b4": lambda: llama.LlamaConfig(
+                vocab_size=32000, max_seq_len=1024, dim=2048, n_layers=22,
+                n_heads=16, n_kv_heads=16, intermediate=5632,
+            ),
             "llama2_7b": llama.LlamaConfig.llama2_7b,
             "llama3_8b": llama.LlamaConfig.llama3_8b,
         }[model_size]()
         self.params = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
+        if model_size != "tiny":
+            # serving decode is weight-read bound: bf16 weights halve
+            # HBM footprint and double effective decode bandwidth
+            import jax.numpy as jnp
+
+            self.params = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16), self.params
+            )
         self.max_new_tokens = max_new_tokens
+        # request clamp: each pow-2 generation-length bucket is its own
+        # compiled program AND its own KV-cache footprint, so the
+        # configured default is also the per-request ceiling (pass a
+        # larger max_new_tokens at deploy time to allow longer asks)
+        self.max_new_tokens_limit = max_new_tokens
+        self._max_batch_size = max_batch_size
         # instance-level batching config consumed by @serve.batch
         self.__serve_batch_overrides__ = {
             "_generate_batch": {"max_batch_size": max_batch_size},
@@ -63,7 +92,12 @@ class LlamaService:
     async def _generate_batch(self, requests: List[dict]) -> List[List[int]]:
         """Batched generation.  Prompts are grouped by length so each
         group is one [B, T] generate call — XLA compiles per shape, and
-        same-shape batches reuse the compiled prefill/decode programs."""
+        same-shape batches reuse the compiled prefill/decode programs.
+        Each group is padded up to the next power-of-two batch size
+        (repeating the first row) so only log2(max_batch)+1 shapes ever
+        compile, whatever sizes the batcher hands us — shape-bucketing,
+        the standard XLA serving trick (a fresh [G, T] shape is a
+        multi-second compile; a bucketed one is a cache hit)."""
         import asyncio
 
         import jax.numpy as jnp
@@ -77,11 +111,28 @@ class LlamaService:
                 arr = jnp.asarray(
                     [requests[i]["tokens"] for i in idxs], jnp.int32
                 )
+                G = arr.shape[0]
+                # next pow2 >= G, but never beyond the configured batch
+                # cap the replica was memory-sized for
+                bucket = min(1 << (G - 1).bit_length(),
+                             self._max_batch_size)
+                if bucket > G:
+                    arr = jnp.concatenate(
+                        [arr, jnp.broadcast_to(arr[:1], (bucket - G, T))]
+                    )
+                # generation length is a compile axis too (the fused
+                # program scans n_new steps): bucket it to the next
+                # pow2 and slice, so a client sweeping max_new_tokens
+                # cannot force a compile per distinct value; the KV
+                # cache is (T + n) slots, so never run past max_seq_len
+                # (generate() clamps per request, so this stays >= 1)
+                n_bucket = max(1, min(1 << max(0, n_new - 1).bit_length(),
+                                      self.cfg.max_seq_len - T))
                 gen = self._llama.generate(
-                    self.cfg, self.params, arr, n_new, temperature=0.0
+                    self.cfg, self.params, arr, n_bucket, temperature=0.0
                 )
                 for j, i in enumerate(idxs):
-                    out[i] = [int(t) for t in gen[j]]
+                    out[i] = [int(t) for t in gen[j][:n_new]]
             return out
 
         # the decode loop blocks (per-token device syncs): run it on
@@ -100,10 +151,55 @@ class LlamaService:
 
         n_new = (max_new_tokens if max_new_tokens is not None
                  else self.max_new_tokens)
+        n_new = max(1, min(int(n_new), self.max_new_tokens_limit))
+        # per-request validation/clamping BEFORE batching: a bad
+        # request must fail alone, never take its co-batched group
+        # down with it, and the clamped length must drive the grouping
+        # (so n_bucket below is always >= 1)
+        limit = self.cfg.max_seq_len
+        reqs = []
+        for toks in token_lists:
+            if not toks or len(toks) >= limit:
+                raise ValueError(
+                    f"prompt length must be in [1, {limit - 1}] "
+                    f"(got {len(toks)}; max_seq_len={limit})"
+                )
+            reqs.append({"tokens": toks,
+                         "max_new_tokens": min(n_new, limit - len(toks))})
         return list(await asyncio.gather(*[
-            self._generate_batch({"tokens": toks, "max_new_tokens": n_new})
-            for toks in token_lists
+            self._generate_batch(r) for r in reqs
         ]))
+
+    def bench_direct(self, batch: int, prompt_len: int,
+                     max_new_tokens: int, iters: int = 3) -> dict:
+        """Bare `llama.generate` timing measured IN the replica process
+        (the chip owner) — the no-serve baseline the serve data-plane
+        overhead is computed against.  Returns generated-token
+        throughput after one warmup/compile iteration."""
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(0), (batch, prompt_len), 0,
+            self.cfg.vocab_size, dtype=jnp.int32,
+        )
+        np.asarray(self._llama.generate(
+            self.cfg, self.params, prompt, max_new_tokens
+        ))  # warmup: compiles prefill + decode step; host read = sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(self._llama.generate(
+                self.cfg, self.params, prompt, max_new_tokens
+            ))
+        dt = time.perf_counter() - t0
+        return {
+            "tokens_per_sec": batch * max_new_tokens * iters / dt,
+            "seconds_per_iter": dt / iters,
+            "batch": batch,
+        }
 
     async def __call__(self, request):
         body = request.json() if request.body() else {}

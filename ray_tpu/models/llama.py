@@ -419,59 +419,74 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
 _DECODE_JIT_CACHE: Dict = {}
 
 
-def _jitted_decode_fns(cfg: LlamaConfig, mesh=None):
-    """One jitted (prefill, step) pair per config — jax.jit's cache is
-    keyed on the wrapper object, so rebuilding wrappers per generate()
-    call would recompile on EVERY request (minutes at 7B+)."""
-    import functools
+def _jitted_generate_fn(cfg: LlamaConfig, max_new_tokens: int,
+                        greedy: bool, mesh=None):
+    """One fused prefill+decode program per (cfg, n_new, greedy): the
+    WHOLE generation — prefill and a `lax.scan` over decode steps —
+    compiles into a single XLA program, so a request costs ONE
+    dispatch instead of `max_new_tokens` host round-trips.  On a real
+    deployment the per-dispatch latency is what dominates small-batch
+    decode (each python-loop step is a blocking device round-trip);
+    scanning the loop on-device removes it entirely.  This is the
+    compiler-friendly-control-flow rule applied to serving."""
+    key_ = (cfg, max_new_tokens, greedy, id(mesh) if mesh else None)
+    fn = _DECODE_JIT_CACHE.get(key_)
+    if fn is not None:
+        return fn
 
-    key = (cfg, id(mesh) if mesh is not None else None)
-    fns = _DECODE_JIT_CACHE.get(key)
-    if fns is None:
-        fns = (
-            jax.jit(functools.partial(prefill, cfg, mesh=mesh),
-                    static_argnames=("max_len",)),
-            jax.jit(functools.partial(decode_step, cfg)),
-        )
-        _DECODE_JIT_CACHE[key] = fns
-    return fns
+    def gen(params, prompt, temperature, rng):
+        B, T = prompt.shape
+        max_len = T + max_new_tokens
+
+        def pick(logits, k):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        keys = jax.random.split(rng, max_new_tokens)
+        logits, cache = prefill(cfg, params, prompt, max_len, mesh)
+        tok0 = pick(logits, keys[0])
+
+        def body(carry, k_i):
+            tok, cache, pos = carry
+            logits, cache = decode_step(cfg, params, tok, cache, pos)
+            nt = pick(logits, k_i)
+            return (nt, cache, pos + 1), nt
+
+        if max_new_tokens > 1:
+            _, toks = lax.scan(
+                body, (tok0, cache, jnp.asarray(T, jnp.int32)), keys[1:]
+            )  # toks [n-1, B]
+            return jnp.concatenate(
+                [tok0[:, None], toks.transpose(1, 0)], axis=1
+            )
+        return tok0[:, None]
+
+    fn = jax.jit(gen)
+    # each entry retains compiled executables (host + device memory):
+    # bound the cache so a long-lived server with badly-bucketed
+    # callers degrades to recompiles, not to unbounded growth
+    while len(_DECODE_JIT_CACHE) >= 32:
+        _DECODE_JIT_CACHE.pop(next(iter(_DECODE_JIT_CACHE)))
+    _DECODE_JIT_CACHE[key_] = fn
+    return fn
 
 
 def generate(cfg: LlamaConfig, params: Dict, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0,
              key: Optional[jax.Array] = None, mesh=None) -> jax.Array:
-    """Autoregressive generation: prefill + KV-cached decode loop.
+    """Autoregressive generation: fused prefill + KV-cached decode scan.
 
     prompt [B, T] int32 -> generated [B, max_new_tokens] int32.
     temperature 0 = greedy; otherwise softmax sampling with `key`.
-    The prefill and the step compile once per (B, T+max_new_tokens)
-    shape; the python loop re-enters the cached jit.
+    One compiled program per (B, T, max_new_tokens, greedy) shape — a
+    whole generation is a single device dispatch (see
+    `_jitted_generate_fn`); same-shape requests reuse the program.
     """
-    B, T = prompt.shape
-    max_len = T + max_new_tokens
-    prefill_fn, step_fn = _jitted_decode_fns(cfg, mesh)
-
-    def pick(logits, k):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
-        ).astype(jnp.int32)
-
     if key is None:
         key = jax.random.PRNGKey(0)
-    logits, cache = prefill_fn(params, prompt, max_len=max_len)
-    out = []
-    key, k0 = jax.random.split(key)
-    tok = pick(logits, k0)
-    out.append(tok)
-    for i in range(max_new_tokens - 1):
-        # pos travels as a device scalar so the step compiles ONCE and
-        # every token reuses it (a python int would retrace per step)
-        logits, cache = step_fn(
-            params, tok, cache, jnp.asarray(T + i, jnp.int32)
-        )
-        key, ki = jax.random.split(key)
-        tok = pick(logits, ki)
-        out.append(tok)
-    return jnp.stack(out, axis=1)
+    fn = _jitted_generate_fn(cfg, max_new_tokens, temperature <= 0.0, mesh)
+    return fn(params, prompt,
+              jnp.asarray(max(temperature, 1e-6), jnp.float32), key)

@@ -417,6 +417,187 @@ def measure_allreduce_busbw(rt, world: int = 2, size_mb: int = 16,
     return float(min(vals))
 
 
+# ----------------------------------------------------------------------
+# scalability envelope (reference:
+# `release/benchmarks/single_node/test_single_node.py:12-53` and
+# `release/benchmarks/object_store/test_object_store.py` — the published
+# envelope BASELINE.md carries: 10k args to one task, 3k returns,
+# 10k-ref get, 1M queued tasks, 100 GiB objects, 1 GiB broadcast)
+# ----------------------------------------------------------------------
+def _count_args(*args):
+    return len(args)
+
+
+def _envelope_checksum(arr):
+    return int(arr[0]), int(arr[-1]), int(arr.nbytes)
+
+
+def _rss_gb(pid: int = 0) -> float:
+    try:
+        with open(f"/proc/{pid or os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024 / 1024
+    except OSError:
+        pass
+    return 0.0
+
+
+def _warm_sleep(sec):
+    time.sleep(sec)
+    return 0
+
+
+def measure_envelope(rt, *, args_n: int = 10_000, returns_n: int = 3_000,
+                     get_n: int = 10_000, queue_n: int = 100_000,
+                     large_gb: float = 50.0, num_workers: int = 4,
+                     rows: Optional[List[str]] = None) -> Dict[str, Dict]:
+    """Single-node envelope rows (the broadcast row needs a multi-node
+    cluster — `measure_envelope_broadcast`).  Each row returns measured
+    seconds; a row that raises records the failure instead of killing
+    the run, so one cliff doesn't hide the others."""
+    rows = rows or ["args", "returns", "get", "queue", "large"]
+    out: Dict[str, Dict] = {}
+
+    def _row(name, fn):
+        if name not in rows:
+            return
+        try:
+            out[name] = fn()
+            print(f"envelope[{name}]: " + ", ".join(
+                f"{k}={v}" for k, v in out[name].items()), flush=True)
+        except Exception as e:  # record the cliff, keep going
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"envelope[{name}] FAILED: {e}", flush=True)
+
+    count_args = rt.remote(num_cpus=0)(_count_args)
+    # boot the whole worker pool before timing anything: a cold worker
+    # pays seconds of interpreter+jax import, which is boot latency,
+    # not envelope capacity.  The sleeps overlap, so the tasks cannot
+    # all pipeline onto the first worker to register — every pool slot
+    # must boot to drain this batch
+    warm = rt.remote(num_cpus=1)(_warm_sleep)
+    rt.get([warm.remote(0.5) for _ in range(2 * num_workers)])
+
+    def row_args():
+        t0 = time.perf_counter()
+        refs = [rt.put(0) for _ in range(args_n)]
+        put_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = rt.get(count_args.remote(*refs))
+        call_s = time.perf_counter() - t0
+        assert got == args_n, got
+        return {"n": args_n, "put_s": round(put_s, 2),
+                "call_s": round(call_s, 2),
+                "total_s": round(put_s + call_s, 2)}
+
+    def row_returns():
+        many = rt.remote(num_cpus=0, num_returns=returns_n)(
+            lambda: tuple(range(returns_n))
+        )
+        t0 = time.perf_counter()
+        refs = many.remote()
+        vals = rt.get(list(refs))
+        dt = time.perf_counter() - t0
+        assert vals[0] == 0 and vals[-1] == returns_n - 1
+        return {"n": returns_n, "total_s": round(dt, 2)}
+
+    def row_get():
+        refs = [rt.put(i) for i in range(get_n)]
+        t0 = time.perf_counter()
+        vals = rt.get(refs)
+        dt = time.perf_counter() - t0
+        assert vals[-1] == get_n - 1
+        return {"n": get_n, "get_s": round(dt, 2)}
+
+    def row_queue():
+        noop = rt.remote(num_cpus=0.001)(_small_value)
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(queue_n)]
+        submit_s = time.perf_counter() - t0
+        rss_peak = _rss_gb()
+        t0 = time.perf_counter()
+        step = 10_000
+        for i in range(0, queue_n, step):
+            rt.get(refs[i:i + step])
+        drain_s = time.perf_counter() - t0
+        return {"n": queue_n, "submit_s": round(submit_s, 2),
+                "submit_per_s": round(queue_n / submit_s, 1),
+                "drain_s": round(drain_s, 2),
+                "tasks_per_s": round(queue_n / (submit_s + drain_s), 1),
+                "driver_rss_gb": round(rss_peak, 2)}
+
+    def row_large():
+        n = int(large_gb * (1 << 30))
+        # zeros: source pages stay the kernel zero page until written,
+        # so the numpy side costs ~nothing — the shm copy is the cost
+        arr = np.zeros(n, dtype=np.uint8)
+        arr[0], arr[-1] = 7, 9  # corners prove round-trip integrity
+        t0 = time.perf_counter()
+        ref = rt.put(arr)
+        put_s = time.perf_counter() - t0
+        del arr
+        t0 = time.perf_counter()
+        got = rt.get(ref)
+        get_s = time.perf_counter() - t0
+        assert got[0] == 7 and got[-1] == 9 and got.nbytes == n
+        del got, ref
+        return {"gib": large_gb, "put_s": round(put_s, 2),
+                "get_s": round(get_s, 2),
+                "put_gb_per_s": round(large_gb / put_s, 2),
+                "get_gb_per_s": round(large_gb / max(get_s, 1e-9), 2)}
+
+    _row("args", row_args)
+    _row("returns", row_returns)
+    _row("get", row_get)
+    _row("queue", row_queue)
+    _row("large", row_large)
+    return out
+
+
+def measure_envelope_broadcast(n_nodes: int = 4, size_gb: float = 1.0,
+                               workers_per_node: int = 1) -> Dict[str, float]:
+    """1 GiB object broadcast to every node of a local multi-node
+    cluster (reference: `object_store.json` 1 GiB x 50 nodes over the
+    network; here the nodes share a host, so this measures the chunked
+    daemon-to-daemon transfer path, fan-out dedup included).  Owns its
+    cluster: call with no runtime initialized."""
+    import ray_tpu as rt_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    if rt_mod.is_initialized():
+        raise RuntimeError(
+            "envelope broadcast owns its cluster: call with no "
+            "runtime initialized"
+        )
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 1})
+    c.connect()
+    try:
+        for i in range(n_nodes):
+            c.add_node(num_cpus=2, resources={f"bn{i}": 1},
+                       num_workers=workers_per_node)
+        c.wait_for_nodes()
+        checksum = rt_mod.remote(num_cpus=0)(_envelope_checksum)
+        n = int(size_gb * (1 << 30))
+        arr = np.zeros(n, dtype=np.uint8)
+        arr[0], arr[-1] = 3, 5
+        ref = rt_mod.put(arr)
+        del arr
+        t0 = time.perf_counter()
+        outs = rt_mod.get([
+            checksum.options(resources={f"bn{i}": 1}).remote(ref)
+            for i in range(n_nodes)
+        ])
+        dt = time.perf_counter() - t0
+        assert all(o == (3, 5, n) for o in outs), outs
+        return {"nodes": n_nodes, "gib": size_gb,
+                "broadcast_s": round(dt, 2),
+                "aggregate_gb_per_s": round(n_nodes * size_gb / dt, 2)}
+    finally:
+        c.shutdown()
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -438,9 +619,71 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                    help="also measure host ring-allreduce bus bandwidth")
     p.add_argument("--busbw-world", type=int, default=2)
     p.add_argument("--busbw-mb", type=int, default=16)
+    p.add_argument("--envelope", action="store_true",
+                   help="run the scalability-envelope rows INSTEAD of "
+                        "the microbenchmark matrix (reference: "
+                        "release/benchmarks/single_node)")
+    p.add_argument("--envelope-rows", default="args,returns,get,queue,large",
+                   help="comma list: args,returns,get,queue,large,broadcast")
+    p.add_argument("--envelope-args-n", type=int, default=10_000)
+    p.add_argument("--envelope-returns-n", type=int, default=3_000)
+    p.add_argument("--envelope-get-n", type=int, default=10_000)
+    p.add_argument("--envelope-queue-n", type=int, default=100_000)
+    p.add_argument("--envelope-large-gb", type=float, default=50.0)
+    p.add_argument("--envelope-bcast-nodes", type=int, default=4)
+    p.add_argument("--envelope-bcast-gb", type=float, default=1.0)
     args = p.parse_args(argv)
 
+    # kill -USR1 <pid> dumps all thread stacks — the only way to see
+    # where a wedged run is stuck on a box with no gdb/py-spy
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+
     import ray_tpu as rt
+
+    if args.envelope:
+        rows = [r.strip() for r in args.envelope_rows.split(",") if r.strip()]
+        results = {}
+        single_rows = [r for r in rows if r != "broadcast"]
+        if single_rows:
+            store = None
+            if "large" in rows:
+                store = int((args.envelope_large_gb + 4) * (1 << 30))
+            if rt.is_initialized():
+                raise RuntimeError(
+                    "--envelope sizes its own object store: run with "
+                    "no runtime initialized"
+                )
+            rt.init(num_workers=args.num_workers,
+                    num_cpus=max(16, args.num_workers * 2),
+                    object_store_memory=store)
+            try:
+                results.update(measure_envelope(
+                    rt, rows=single_rows,
+                    args_n=args.envelope_args_n,
+                    returns_n=args.envelope_returns_n,
+                    get_n=args.envelope_get_n,
+                    queue_n=args.envelope_queue_n,
+                    large_gb=args.envelope_large_gb,
+                    num_workers=args.num_workers,
+                ))
+            finally:
+                rt.shutdown()
+        if "broadcast" in rows:
+            results["broadcast"] = measure_envelope_broadcast(
+                n_nodes=args.envelope_bcast_nodes,
+                size_gb=args.envelope_bcast_gb,
+            )
+            print("envelope[broadcast]: " + ", ".join(
+                f"{k}={v}" for k, v in results["broadcast"].items()),
+                flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
 
     owns = not rt.is_initialized()
     if owns:

@@ -267,3 +267,48 @@ def test_node_label_strategy_e2e():
             ).remote(), timeout=60)
     finally:
         c.shutdown()
+
+
+def _boot_noop():
+    return 0
+
+
+def test_slow_worker_boot_no_spawn_storm(monkeypatch):
+    """Starting (spawned, unregistered) workers count against the pool:
+    while boots are slow, neither the schedule pass nor the 1 s retry
+    loop may spawn extra workers for a daemon-routed (spilled) task —
+    the historical failure mode was one new spawn per tick, each making
+    the boots slower (reference: starting-worker accounting in
+    `worker_pool.cc`).  The storm only existed on the daemon task_queue
+    path, so the task must SPILL to a booting node, not take a driver
+    lease."""
+    import glob
+
+    if rt.is_initialized():
+        rt.shutdown()
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 1})
+    c.connect()
+    try:
+        # only the second node's workers boot slowly (env inherited by
+        # the daemon at spawn)
+        monkeypatch.setenv("RT_TEST_WORKER_BOOT_DELAY", "3")
+        slow = c.add_node(num_cpus=2, resources={"slow": 1},
+                          num_workers=2)
+        c.wait_for_nodes()
+        noop = rt.remote(num_cpus=0)(_boot_noop)
+        # pinned to the booting node -> spills to its daemon queue and
+        # sits there while the pool boots; every pre-fix retry tick
+        # spawned another worker
+        refs = [noop.options(resources={"slow": 0.1}).remote()
+                for _ in range(6)]
+        assert rt.get(refs, timeout=120) == [0] * 6
+        spawned = glob.glob(os.path.join(slow.session_dir, "logs",
+                                         "worker-*"))
+        # 2 pool workers (+1 tolerated respawn for an incidental death)
+        assert len(spawned) <= 3, (
+            f"spawn storm: {len(spawned)} workers spawned for a "
+            f"2-worker pool: {sorted(spawned)}"
+        )
+    finally:
+        c.shutdown()
