@@ -350,13 +350,15 @@ class NodeDaemon:
         boot_deadline = time.monotonic() + float(
             os.environ.get("RT_WORKER_BOOT_TIMEOUT_S", "120")
         )
+        boot_killed = False
         while proc.poll() is None:
-            if (proc.pid in self._booting_pids
+            if (not boot_killed and proc.pid in self._booting_pids
                     and time.monotonic() > boot_deadline):
                 logger.warning(
                     "worker pid %d still booting after deadline: killing",
                     proc.pid,
                 )
+                boot_killed = True  # once; an unkillable proc must not re-warn 5x/s
                 proc.kill()
             await asyncio.sleep(0.2)
         if proc.pid in self._booting_pids:

@@ -290,6 +290,17 @@ class Runtime:
         # the io loop (see _pubsub_reconcile); binds to the loop on
         # first acquisition
         self._pubsub_async_lock = asyncio.Lock()
+        # coalesced ref-event channel (reference: `src/ray/pubsub/` —
+        # WaitForRefRemoved rides a per-worker-pair channel so borrow
+        # traffic is O(#counterparts), not O(#objects)): un-ACK'd
+        # add/remove borrow events queue per owner address and flush as
+        # ONE routed frame per counterpart per flush window
+        self._ref_event_lock = threading.Lock()
+        self._ref_event_queues: Dict[tuple, list] = {}
+        self._ref_event_flush_scheduled = False
+        # bulk-resolved owner replies awaiting their per-ref consumer
+        # (io-loop only; see _prime_borrowed)
+        self._primed_replies: Dict[bytes, object] = {}
         # executing normal tasks: task_id -> thread ident (cancellation)
         self._task_threads: Dict[bytes, int] = {}
         # runtime-env dedication (worker mode): hash applied, if any
@@ -410,6 +421,12 @@ class Runtime:
             flush = getattr(self, "_flush_task", None)
             if flush is not None:
                 flush.cancel()
+            # push any queued borrow releases out before the routes die
+            # (best-effort: owners also clean up on connection loss)
+            try:
+                await self._flush_ref_events(immediate=True)
+            except Exception:
+                pass
             for timer in list(self._lease_timers):
                 timer.cancel()
             self._lease_timers.clear()
@@ -700,7 +717,14 @@ class Runtime:
         rest = refs[len(vals):]
 
         async def _get_all():
-            return await asyncio.gather(*[self._get_one(r) for r in rest])
+            primed = await self._prime_borrowed(rest)
+            try:
+                return await asyncio.gather(
+                    *[self._get_one(r) for r in rest]
+                )
+            finally:
+                for b in primed:  # drop unconsumed entries (cancel/error)
+                    self._primed_replies.pop(b, None)
 
         vals.extend(self._run(_get_all(), timeout=timeout))
         return vals[0] if single else vals
@@ -900,18 +924,75 @@ class Runtime:
             rc.owner_addr = rc.owner_addr or tuple(owner)
             self._maybe_free(inner_id)
 
+    # ref-event channel tuning: a flush window long enough to coalesce
+    # a churn burst, short enough to be latency-invisible next to the
+    # object-free paths it feeds
+    _REF_EVENT_FLUSH_S = 0.005
+    _REF_EVENT_MAX_BATCH = 1024
+    # bulk location/value lookup chunk (see _prime_borrowed)
+    _BULK_GET_CHUNK = 512
+
     def _send_remove_borrow(self, inner_id: bytes, owner):
+        self._queue_ref_event(
+            tuple(owner), "remove_borrow",
+            {"id": inner_id, "borrower": self.address},
+        )
+
+    def _queue_ref_event(self, owner: tuple, method: str, payload: dict):
+        """Queue an un-ACK'd borrow event for the coalesced per-owner
+        channel (reference: `src/ray/pubsub/README.md` — the fan-in
+        argument: O(#subscribers) messages instead of O(#objects);
+        `reference_count.h:64` WaitForRefRemoved).  Events to one owner
+        preserve queue order; ACK'd registrations stay direct RPCs (the
+        ACK future is awaited individually) and always precede any
+        queued remove for the same ref causally."""
         if self.noded is None:
             return
-        try:
-            self.noded.send_threadsafe("route", {
-                "target": tuple(owner),
-                "method": "remove_borrow",
-                "payload": {"id": inner_id, "borrower": self.address},
-                "want_reply": False,
-            })
-        except Exception:
-            pass
+        with self._ref_event_lock:
+            q = self._ref_event_queues.setdefault(owner, [])
+            q.append((method, payload))
+            # boundary transition only: a burst past MAX must not spawn
+            # one no-op flush coroutine per further event
+            full = len(q) % self._REF_EVENT_MAX_BATCH == 0
+            schedule = not self._ref_event_flush_scheduled
+            if schedule:
+                self._ref_event_flush_scheduled = True
+        if schedule or full:
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self._flush_ref_events(immediate=full), self.loop
+                ).add_done_callback(
+                    lambda f: f.exception() if not f.cancelled() else None
+                )
+            except Exception:
+                with self._ref_event_lock:
+                    self._ref_event_flush_scheduled = False
+
+    async def _flush_ref_events(self, immediate: bool = False):
+        if not immediate:
+            await asyncio.sleep(self._REF_EVENT_FLUSH_S)
+        while True:
+            with self._ref_event_lock:
+                batches = self._ref_event_queues
+                self._ref_event_queues = {}
+                if not batches:
+                    self._ref_event_flush_scheduled = False
+                    return
+            for owner, events in batches.items():
+                for i in range(0, len(events), self._REF_EVENT_MAX_BATCH):
+                    try:
+                        self.noded.send_threadsafe("route", {
+                            "target": owner,
+                            "method": "ref_events",
+                            "payload": {
+                                "events": events[
+                                    i:i + self._REF_EVENT_MAX_BATCH
+                                ],
+                            },
+                            "want_reply": False,
+                        })
+                    except Exception:
+                        break  # daemon gone: owner cleanup handles it
 
     def _pool_for(self, spec: TaskSpec) -> _LeasePool:
         demand = spec.resources.as_dict()
@@ -1625,21 +1706,70 @@ class Runtime:
                     return await self._reconstruct_and_get(ref)
         return self._deser_pinned(ref.binary(), buf)
 
+    async def _prime_borrowed(self, refs):
+        """Bulk-resolve foreign-owned refs before the per-ref gather:
+        one `get_object_values` frame per owner per 512 refs instead of
+        one routed RPC per ref (the object-location fan-in channel —
+        `src/ray/pubsub/README.md`).  Failures degrade silently to the
+        per-ref path.  Returns the primed ids so the caller can prune
+        entries its gather never consumed."""
+        groups: Dict[tuple, list] = {}
+        primed: list = []
+        for r in refs:
+            b = r.binary()
+            if (r.owner is not None and tuple(r.owner) != self.address
+                    and b not in self.objects
+                    and b not in self._primed_replies
+                    and not self.store.contains(b)):
+                groups.setdefault(tuple(r.owner), []).append(b)
+
+        async def _one_chunk(owner, chunk):
+            try:
+                replies = await self.noded.call("route", {
+                    "target": owner,
+                    "method": "get_object_values",
+                    "payload": {"ids": chunk},
+                    "want_reply": True,
+                })
+            except Exception:
+                return  # degraded: per-ref path covers this chunk
+            for id_b, rep in zip(chunk, replies):
+                # not-yet-ready objects come back "pending" so one slow
+                # producer can't hold its chunk's reply hostage; the
+                # per-ref path (which awaits readiness) handles them
+                if rep and rep[0] != "pending":
+                    self._primed_replies[id_b] = rep
+                    primed.append(id_b)
+
+        chunks = []
+        for owner, ids in groups.items():
+            if len(ids) < 4:
+                continue  # a couple of refs aren't worth a bulk frame
+            for i in range(0, len(ids), self._BULK_GET_CHUNK):
+                chunks.append(
+                    _one_chunk(owner, ids[i:i + self._BULK_GET_CHUNK])
+                )
+        if chunks:  # all owners, all chunks resolve concurrently
+            await asyncio.gather(*chunks)
+        return primed
+
     async def _get_borrowed(self, ref: ObjectRef):
         if self.store.contains(ref.binary()):
             buf = self.store.get(ref.binary(), timeout_ms=0)
             return self._deser_pinned(ref.binary(), buf)
         if ref.owner is None:
             raise exc.ObjectLostError(object_id=ref.id)
-        reply = await self.noded.call(
-            "route",
-            {
-                "target": tuple(ref.owner),
-                "method": "get_object_value",
-                "payload": {"id": ref.binary()},
-                "want_reply": True,
-            },
-        )
+        reply = self._primed_replies.pop(ref.binary(), None)
+        if reply is None:
+            reply = await self.noded.call(
+                "route",
+                {
+                    "target": tuple(ref.owner),
+                    "method": "get_object_value",
+                    "payload": {"id": ref.binary()},
+                    "want_reply": True,
+                },
+            )
         kind = reply[0]
         if kind == "inline":
             tag, val = ser.deserialize(memoryview(reply[1]))
@@ -1806,6 +1936,7 @@ class Runtime:
         if not entries:
             return
         recorded = []
+        foreign: Dict[tuple, list] = {}
         for inner_id, owner in entries:
             owner = tuple(owner)
             if owner == self.address:
@@ -1818,20 +1949,32 @@ class Runtime:
                 rc.borrowers += 1
                 recorded.append(("selfborrow", inner_id, None))
             else:
-                msg = {
-                    "target": owner,
-                    "method": "add_borrow",
-                    "payload": {"id": inner_id, "borrower": self.address},
-                    "want_reply": acks is not None,
-                }
+                foreign.setdefault(owner, []).append(inner_id)
+                recorded.append(("borrow", inner_id, owner))
+        # one frame per (owner, 1024-chunk), NOT per inner ref: a task
+        # result carrying 10k refs registers in ~10 frames (reference:
+        # `src/ray/pubsub/README.md` fan-in argument).  On the ACK'd
+        # path one want_reply future covers its whole chunk — the owner
+        # replies after processing every event in it.
+        for owner, ids in foreign.items():
+            for i in range(0, len(ids), self._REF_EVENT_MAX_BATCH):
+                chunk = [
+                    ("add_borrow", {"id": x, "borrower": self.address})
+                    for x in ids[i:i + self._REF_EVENT_MAX_BATCH]
+                ]
                 try:
                     if acks is not None:
                         acks.append(asyncio.run_coroutine_threadsafe(
-                            self.noded.call("route", msg), self.loop
+                            self.noded.call("route", {
+                                "target": owner,
+                                "method": "ref_events",
+                                "payload": {"events": chunk},
+                                "want_reply": True,
+                            }), self.loop
                         ))
                     else:
-                        self.noded.send_threadsafe("route", msg)
-                    recorded.append(("borrow", inner_id, owner))
+                        for method, p in chunk:
+                            self._queue_ref_event(owner, method, p)
                 except Exception:
                     pass
         if recorded:
@@ -2352,6 +2495,23 @@ class Runtime:
             return ("inline", st.value)
         return ("shm", st.node_id)
 
+    async def _h_get_object_values(self, payload, conn):
+        """Bulk location/value lookup: one routed frame resolves a whole
+        batch of this owner's objects for a borrower's multi-ref get
+        (reference: the object-location pubsub channel's fan-in
+        argument, `src/ray/pubsub/README.md` — a 10k-ref get must not
+        be 10k waiting RPCs)."""
+        out = []
+        for i in payload["ids"]:
+            st = self.objects.get(i)
+            if st is None or not st.ready.is_set():
+                # don't hold the whole batch for one slow producer —
+                # the caller's per-ref path awaits readiness itself
+                out.append(("pending",))
+            else:
+                out.append(await self._h_get_object_value({"id": i}, conn))
+        return out
+
     async def _h_add_borrow(self, payload, conn):
         """Owner side: a borrower registered (reference: the owner's
         borrower set, `reference_count.h:64`).  The reply doubles as the
@@ -2366,6 +2526,17 @@ class Runtime:
                 rc.borrower_addrs[b] = rc.borrower_addrs.get(b, 0) + 1
             rc.contained = 0  # pin transfers to the borrower
         return {"ok": True}
+
+    async def _h_ref_events(self, payload, conn):
+        """Owner side of the coalesced ref-event channel: one frame
+        carries a whole batch of borrow registrations/releases from one
+        counterpart (reference: `src/ray/pubsub/README.md` — reducing
+        O(#objects) waiting RPCs to O(#subscribers))."""
+        for method, p in payload["events"]:
+            if method == "add_borrow":
+                await self._h_add_borrow(p, conn)
+            elif method == "remove_borrow":
+                await self._h_remove_borrow(p, conn)
 
     async def _h_remove_borrow(self, payload, conn):
         with self._state_lock:
@@ -3197,12 +3368,13 @@ def on_ref_deserialized(ref: ObjectRef):
             except Exception:
                 pass
         else:
-            try:
-                rt.noded.send_threadsafe(
-                    "route", {**payload, "want_reply": False}
-                )
-            except Exception:
-                pass
+            # drivers don't forward refs in results: the registration
+            # needs no ACK, so it rides the coalesced channel (a 10k-ref
+            # get registers in ~10 frames, not 10k)
+            rt._queue_ref_event(
+                tuple(ref.owner), "add_borrow",
+                {"id": ref.binary(), "borrower": rt.address},
+            )
 
 
 def on_ref_deleted(ref: ObjectRef):
