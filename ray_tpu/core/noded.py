@@ -1150,6 +1150,46 @@ class NodeDaemon:
         aggregator + `_private/test_utils.py` killer actors)."""
         return self._worker_inventory()
 
+    async def handle_memory_table(self, payload, conn):
+        """Node-level object-memory table for `rt memory` (reference:
+        `ray memory` / `internal_api.py:34`): every local runtime's
+        reference table plus this daemon's store occupancy and spilled
+        primaries."""
+        async def _one(w):
+            try:
+                s = await w.conn.call("memory_summary", {}, timeout=5)
+            except Exception:
+                return None  # process died/hung mid-listing
+            s["worker_id"] = w.worker_id
+            s["worker_kind"] = w.kind
+            return s
+
+        # concurrent polls: one wedged worker costs the slowest single
+        # timeout, not N of them — `rt memory` gets run exactly when a
+        # worker IS wedged, and the sick node must stay in the report
+        live = [w for w in self.workers.values()
+                if w.conn is not None and not w.conn.closed]
+        procs = [
+            s for s in await asyncio.gather(*[_one(w) for w in live])
+            if s is not None
+        ]
+        with self._spill_lock:
+            spilled = [i.hex() for i in self._spilled]
+        store = {}
+        try:
+            store = {
+                "used": self.store.used,
+                "capacity": self.store.capacity,
+            }
+        except Exception:
+            pass
+        return {
+            "node_id": self.node_id,
+            "store": store,
+            "spilled": spilled,
+            "processes": procs,
+        }
+
     async def handle_profile_worker(self, payload, conn):
         """On-demand stack profile of one local worker (reference:
         `modules/reporter/profile_manager.py:78` py-spy dumps; here a

@@ -57,6 +57,113 @@ class RpcError(Exception):
     pass
 
 
+class NetworkChaos:
+    """Injectable network-fault model applied at the frame-receive seam
+    (reference capability: `python/ray/tests/chaos/chaos_network_delay.yaml`
+    + `release/nightly_tests/setup_chaos.py:94` — the reference injects
+    tc/netem delay, bandwidth caps, and partitions at the pod level;
+    here the faults are injected where every control-plane byte already
+    passes, so one implementation covers unix and TCP links alike).
+
+    Faults:
+    - `delay_s` (+ uniform `jitter_s`): per-frame latency, stream-order
+      preserving (TCP congestion model).
+    - `reorder=True`: delayed frames are delivered by detached tasks,
+      so frames can overtake each other ACROSS an endpoint's
+      connections and within one (scheduling/reordering model — what
+      multiplexed HTTP/2 streams or multiple TCP connections do).
+    - `drop_prob`: probabilistic frame drop.  NOTE: dropping violates
+      TCP's reliable-delivery contract, so components are only expected
+      to survive it where they own a timeout+retry (calls); one-way
+      frames ride an ordered reliable stream by design and their loss
+      model is CONNECTION death, not frame loss.
+    - `partition(pattern, duration_s)`: drop every inbound frame from
+      peers whose connection name contains `pattern` until `heal()` or
+      the duration elapses — a one-sided network partition.
+
+    Enable per process via `rpc.set_chaos(...)`, or for spawned
+    daemons/workers via `RT_CHAOS` (JSON kwargs) in their environment.
+    The handshake is never chaos-affected: real netem delays SYNs too,
+    but a build that can't even connect tests nothing.
+    """
+
+    def __init__(self, delay_s: float = 0.0, jitter_s: float = 0.0,
+                 drop_prob: float = 0.0, reorder: bool = False,
+                 match: str = "", seed: int = 0):
+        import random
+
+        self.delay_s = delay_s
+        self.jitter_s = jitter_s
+        self.drop_prob = drop_prob
+        self.reorder = reorder
+        self.match = match
+        self._rng = random.Random(seed)
+        self._partitions: Dict[str, Optional[float]] = {}
+
+    def partition(self, pattern: str, duration_s: Optional[float] = None):
+        """Drop all inbound frames from peers matching `pattern` (name
+        substring) until `heal(pattern)` or `duration_s` elapses."""
+        import time as _time
+
+        self._partitions[pattern] = (
+            None if duration_s is None else _time.monotonic() + duration_s
+        )
+
+    def heal(self, pattern: Optional[str] = None):
+        if pattern is None:
+            self._partitions.clear()
+        else:
+            self._partitions.pop(pattern, None)
+
+    def plan(self, conn_name: str, method: str, kind: int):
+        """-> (drop, delay_s) for one inbound frame."""
+        import time as _time
+
+        for pat, until in list(self._partitions.items()):
+            if pat in conn_name:
+                if until is not None and _time.monotonic() > until:
+                    self._partitions.pop(pat, None)
+                    continue
+                return True, 0.0
+        if self.match and self.match not in conn_name:
+            return False, 0.0
+        if self.drop_prob and self._rng.random() < self.drop_prob:
+            return True, 0.0
+        delay = self.delay_s
+        if self.jitter_s:
+            delay += self._rng.random() * self.jitter_s
+        return False, delay
+
+
+_chaos: Optional[NetworkChaos] = None
+_chaos_env_checked = False
+
+
+def set_chaos(chaos: Optional[NetworkChaos]) -> None:
+    """Install (or clear, with None) this process's fault model."""
+    global _chaos, _chaos_env_checked
+    _chaos = chaos
+    _chaos_env_checked = True
+
+
+def get_chaos() -> Optional[NetworkChaos]:
+    """Active fault model; lazily constructed from RT_CHAOS for child
+    processes (daemons/workers inherit the env)."""
+    global _chaos, _chaos_env_checked
+    if not _chaos_env_checked:
+        _chaos_env_checked = True
+        import json as _json
+        import os as _os
+
+        raw = _os.environ.get("RT_CHAOS")
+        if raw:
+            try:
+                _chaos = NetworkChaos(**_json.loads(raw))
+            except Exception:
+                logger.warning("bad RT_CHAOS %r ignored", raw)
+    return _chaos
+
+
 class ConnectionLost(RpcError):
     pass
 
@@ -292,17 +399,25 @@ class Connection:
                     if not self._handshake(method, payload):
                         return
                     continue
-                if kind == REPLY:
-                    fut = self._pending.get(msg_id)
-                    if fut and not fut.done():
-                        if method == "__error__":
-                            fut.set_exception(RemoteError(payload))
-                        else:
-                            fut.set_result(payload)
-                elif kind == REQUEST:
-                    asyncio.create_task(self._dispatch(msg_id, method, payload))
-                else:  # ONEWAY
-                    asyncio.create_task(self._dispatch(None, method, payload))
+                chaos = get_chaos()
+                if chaos is not None:
+                    drop, delay = chaos.plan(self.name, method, kind)
+                    if drop:
+                        continue
+                    if delay > 0:
+                        if chaos.reorder:
+                            # detached delivery: later frames can
+                            # overtake this one (reordering model)
+                            asyncio.create_task(
+                                self._deliver_later(
+                                    delay, msg_id, kind, method, payload
+                                )
+                            )
+                            continue
+                        # in-loop sleep delays the whole stream:
+                        # order-preserving congestion model
+                        await asyncio.sleep(delay)
+                self._deliver(msg_id, kind, method, payload)
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             self._teardown(ConnectionLost(f"peer {self.name} disconnected"))
         except asyncio.CancelledError:
@@ -310,6 +425,24 @@ class Connection:
         except Exception as e:  # pragma: no cover
             logger.exception("recv loop error from %s", self.name)
             self._teardown(e)
+
+    def _deliver(self, msg_id, kind, method, payload):
+        if kind == REPLY:
+            fut = self._pending.get(msg_id)
+            if fut and not fut.done():
+                if method == "__error__":
+                    fut.set_exception(RemoteError(payload))
+                else:
+                    fut.set_result(payload)
+        elif kind == REQUEST:
+            asyncio.create_task(self._dispatch(msg_id, method, payload))
+        else:  # ONEWAY
+            asyncio.create_task(self._dispatch(None, method, payload))
+
+    async def _deliver_later(self, delay, msg_id, kind, method, payload):
+        await asyncio.sleep(delay)
+        if not self._closed:
+            self._deliver(msg_id, kind, method, payload)
 
     async def _dispatch(self, msg_id, method, payload):
         try:

@@ -58,6 +58,33 @@ from ray_tpu.shm import ObjectNotFoundError, ShmStore
 
 logger = logging.getLogger(__name__)
 
+# `rt memory` callsite column, opt-in like the reference's
+# RAY_record_ref_creation_sites (stack capture per ref is too costly to
+# leave on by default)
+_RECORD_CALLSITES = os.environ.get(
+    "RT_RECORD_REF_CREATION_SITES", ""
+) not in ("", "0")
+
+
+import sysconfig as _sysconfig
+
+_STDLIB_PREFIX = _sysconfig.get_paths().get("stdlib", "/nonexistent")
+# the installed package directory, NOT a name substring — a user
+# checkout whose path merely contains "ray_tpu" must still get
+# callsites
+_PKG_PREFIX = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _creation_site() -> str:
+    """First stack frame outside the ray_tpu package AND the stdlib,
+    as 'file:line in fn' — the user frame that created the ref."""
+    for f in reversed(traceback.extract_stack(limit=16)[:-2]):
+        fn = f.filename or ""
+        if not fn.startswith(_PKG_PREFIX) and not fn.startswith(
+                _STDLIB_PREFIX):
+            return f"{fn}:{f.lineno} in {f.name}"
+    return ""
+
 _INLINE = "inline"
 _SHM = "shm"
 # Max tasks pushed ahead of completion on one leased worker (the
@@ -133,6 +160,10 @@ class _RefCount:
     # owner-side borrower identity ledger: address -> count (reference:
     # the owner tracks WHICH workers borrow, `reference_count.h:64`)
     borrower_addrs: Dict[tuple, int] = field(default_factory=dict)
+    # creation callsite ("file:line in fn"), recorded only under
+    # RT_RECORD_REF_CREATION_SITES=1 (reference:
+    # RAY_record_ref_creation_sites + `ray memory` callsite column)
+    callsite: str = ""
 
     def total(self):
         return (self.local + self.submitted + self.borrowers
@@ -255,6 +286,9 @@ class Runtime:
         self._actor_seq_expect: Dict[tuple, int] = {}
         self._actor_seq_buffer: Dict[tuple, Dict[int, TaskSpec]] = {}
         self._actor_drain_lock: Optional[asyncio.Lock] = None
+        # per-(caller, group) gap timers: advance past sequence numbers
+        # that never arrive (consumed by a previous actor incarnation)
+        self._actor_seq_timers: Dict[tuple, object] = {}
         self._put_counter = 0
         self._task_local = threading.local()
         # shm objects this process has materialized via get: the pin is
@@ -1998,6 +2032,8 @@ class Runtime:
     def _add_local_ref(self, id_bytes: bytes):
         rc = self.refs.setdefault(id_bytes, _RefCount())
         rc.local += 1
+        if _RECORD_CALLSITES and not rc.callsite:
+            rc.callsite = _creation_site()
 
     def _maybe_free(self, id_bytes: bytes):
         rc = self.refs.get(id_bytes)
@@ -2588,6 +2624,53 @@ class Runtime:
             with self._state_lock:
                 self._release_transit(entries)
 
+    async def _h_memory_summary(self, payload, conn):
+        """This process's object-reference table for `rt memory`
+        (reference: `ray memory` — `_private/internal_api.py:34`
+        memory_summary over every worker's reference table +
+        `scripts.py:1955`).  One row per live ref entry: what kind of
+        hold this process has, the value's residence, and (opt-in) the
+        creation callsite."""
+        rows = []
+        with self._state_lock:
+            for id_b, rc in self.refs.items():
+                st = self.objects.get(id_b)
+                if st is not None:
+                    kind = "owned"
+                elif rc.registered:
+                    kind = "borrowed"
+                else:
+                    kind = "pending"  # counted but neither owned nor
+                    #                   registered (e.g. pure transit)
+                rows.append({
+                    "object_id": id_b.hex(),
+                    "kind": kind,
+                    "local": rc.local,
+                    "submitted": rc.submitted,
+                    "borrowers": rc.borrowers,
+                    "contained": rc.contained,
+                    "transit": rc.transit,
+                    "lineage_pinned": id_b in self.lineage,
+                    "size": st.size if st else None,
+                    "where": st.where if st else None,
+                    "node_id": st.node_id if st else None,
+                    "owner": ("self" if kind == "owned" else
+                              list(rc.owner_addr) if rc.owner_addr
+                              else None),
+                    "borrower_addrs": [
+                        [list(a), n] for a, n in rc.borrower_addrs.items()
+                    ],
+                    "callsite": rc.callsite,
+                })
+            held_pins = len(self._held_pins)
+        return {
+            "address": list(self.address),
+            "mode": self.mode,
+            "pid": os.getpid(),
+            "held_pins": held_pins,
+            "refs": rows,
+        }
+
     async def _h_ping(self, payload, conn):
         return "pong"
 
@@ -2739,11 +2822,15 @@ class Runtime:
         # sequence lane, so a blocked "io" call never stalls "compute"
         caller = spec.owner[1]
         key = (caller, group)
-        # First contact from a caller sets the baseline: after an actor
-        # restart the caller's counter keeps running, and a fresh
-        # incarnation must not wait for sequence numbers that were
-        # consumed by the previous one.
-        expect = self._actor_seq_expect.setdefault(key, spec.seq_no)
+        # Baseline 0 (a fresh handle's first seq), NOT first-arrival:
+        # under transport reordering the first frame to ARRIVE can be a
+        # later seq, and a first-arrival baseline would misread the
+        # earlier seqs as stale retries and run them out of order
+        # (reference: `actor_scheduling_queue.cc` buffers out-of-order
+        # arrivals by seq_no for exactly this reason).  Sequence numbers
+        # consumed by a PREVIOUS actor incarnation never arrive; the gap
+        # timer in _drain_actor_seq skips past them after a bounded wait.
+        expect = self._actor_seq_expect.setdefault(key, 0)
         if spec.seq_no < expect:
             # late retry of an already-superseded sequence number:
             # execute out-of-band (restart relaxes exactly-once ordering,
@@ -2752,6 +2839,20 @@ class Runtime:
             return
         buf = self._actor_seq_buffer.setdefault(key, {})
         buf[spec.seq_no] = (spec, conn)
+        await self._drain_actor_seq(key, group)
+
+    # How long a sequence gap may stall a lane before it is declared a
+    # previous-incarnation hole and skipped (transport reorder fills
+    # gaps in milliseconds; only restart holes persist this long).
+    # Tunable via RT_ACTOR_SEQ_GAP_S: on links whose delays can exceed
+    # it, raise the window — a skip on a merely-slow frame relaxes the
+    # lane to out-of-order delivery for that frame (logged when it
+    # happens).
+    _ACTOR_SEQ_GAP_S = float(os.environ.get("RT_ACTOR_SEQ_GAP_S", "1.0"))
+
+    async def _drain_actor_seq(self, key: tuple, group: Optional[str]):
+        aspec = self._actor_aspec
+        buf = self._actor_seq_buffer.get(key, {})
         if self._actor_drain_lock is None:
             self._actor_drain_lock = asyncio.Lock()
         async with self._actor_drain_lock:
@@ -2762,6 +2863,36 @@ class Runtime:
                     self._lane_dispatch(group, s, c)
                 else:
                     await self._exec_task(s, c)
+        if not buf:
+            return
+        snapshot = self._actor_seq_expect[key]
+        existing = self._actor_seq_timers.get(key)
+        if existing is not None:
+            if existing[1] == snapshot:
+                return  # an up-to-date timer is already pending
+            existing[0].cancel()  # stale window: restart it at the new expect
+
+        def _gap_fire():
+            self._actor_seq_timers.pop(key, None)
+            b = self._actor_seq_buffer.get(key)
+            if b and self._actor_seq_expect.get(key) == snapshot:
+                # nothing filled the gap within the window: those
+                # seqs were consumed by a previous incarnation
+                logger.warning(
+                    "actor seq lane %s: skipping gap %d->%d after "
+                    "%.1fs (previous-incarnation hole, or a frame "
+                    "delayed past RT_ACTOR_SEQ_GAP_S)",
+                    key, snapshot, min(b), self._ACTOR_SEQ_GAP_S,
+                )
+                self._actor_seq_expect[key] = min(b)
+                asyncio.ensure_future(
+                    self._drain_actor_seq(key, group)
+                )
+
+        self._actor_seq_timers[key] = (
+            self.loop.call_later(self._ACTOR_SEQ_GAP_S, _gap_fire),
+            snapshot,
+        )
 
     def _lane_dispatch(self, group: Optional[str], spec: TaskSpec, conn):
         """Enqueue one actor task on its lane.  Each lane has a single
@@ -3328,6 +3459,8 @@ def on_ref_deserialized(ref: ObjectRef):
     with rt._state_lock:
         rc = rt.refs.setdefault(ref.binary(), _RefCount())
         rc.local += 1
+        if _RECORD_CALLSITES and not rc.callsite:
+            rc.callsite = _creation_site()
         if ref.owner is not None and tuple(ref.owner) == rt.address:
             rc.contained = 0  # owner consumed its own container: pin -> local
         # `registered` (not a local==1 heuristic) drives exactly one

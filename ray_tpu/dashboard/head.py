@@ -172,6 +172,27 @@ class DashboardHead:
         if path == "/api/workers":
             snap = await self._ctl("get_worker_snapshot")
             return httpd.json_response(snap or [])
+        if path == "/api/memory":
+            # object-ref memory debugging (reference: `ray memory` —
+            # `_private/internal_api.py:34`): per-node reference tables
+            # + store occupancy, aggregated over live nodes
+            from ray_tpu.core.runtime import get_runtime
+
+            rt_ = get_runtime()
+            tables = []
+            for n in (await self._ctl("get_nodes")) or []:
+                if not n.get("alive"):
+                    continue
+                try:
+                    t = await rt_.noded.call("route_node", {
+                        "node_id": n["node_id"],
+                        "method": "memory_table",
+                    }, timeout=20)
+                except Exception:
+                    continue
+                if t:
+                    tables.append(t)
+            return httpd.json_response(tables)
         if path == "/api/profile":
             # on-demand worker stack profile (reference: py-spy via
             # `modules/reporter/profile_manager.py:78`)

@@ -101,6 +101,7 @@ class LlamaService:
         import asyncio
 
         import jax.numpy as jnp
+        import numpy as np
 
         def _run_groups():
             out: List[Optional[List[int]]] = [None] * len(requests)
@@ -131,8 +132,14 @@ class LlamaService:
                 gen = self._llama.generate(
                     self.cfg, self.params, arr, n_bucket, temperature=0.0
                 )
+                # ONE device->host transfer for the whole batch.
+                # Element-wise int() on the device array is a
+                # per-TOKEN host read — through a remote-tunnel
+                # device that is ~100 ms each, turning a 150 ms
+                # generation into seconds
+                gen_host = np.asarray(gen)
                 for j, i in enumerate(idxs):
-                    out[i] = [int(t) for t in gen[j][:n_new]]
+                    out[i] = [int(t) for t in gen_host[j, :n_new]]
             return out
 
         # the decode loop blocks (per-token device syncs): run it on

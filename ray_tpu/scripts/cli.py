@@ -19,6 +19,56 @@ def _connect(address: str):
     return rt
 
 
+def _fmt_size(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n}B"
+
+
+def render_memory_table(tables, kind=None, min_size=0) -> str:
+    """Human rendering of `state.memory_summary()` grouped by node and
+    process (reference: the `ray memory` grouped report)."""
+    lines = []
+    for node in tables:
+        store = node.get("store") or {}
+        lines.append(
+            f"node {node['node_id'][:12]}  store "
+            f"{_fmt_size(store.get('used'))}/"
+            f"{_fmt_size(store.get('capacity'))}  "
+            f"spilled {len(node.get('spilled') or [])}"
+        )
+        for proc in node.get("processes", []):
+            refs = [
+                r for r in proc.get("refs", [])
+                if (kind is None or r["kind"] == kind)
+                and (r.get("size") or 0) >= min_size
+            ]
+            lines.append(
+                f"  {proc.get('mode')} pid={proc.get('pid')} "
+                f"({len(refs)} refs, {proc.get('held_pins', 0)} pins)"
+            )
+            header = (f"    {'OBJECT':<18} {'KIND':<9} {'WHERE':<7} "
+                      f"{'SIZE':>9}  L/S/B/C/T  LIN  CALLSITE")
+            if refs:
+                lines.append(header)
+            for r in sorted(refs, key=lambda r: -(r.get("size") or 0)):
+                counts = (f"{r['local']}/{r['submitted']}/"
+                          f"{r['borrowers']}/{r['contained']}/"
+                          f"{r['transit']}")
+                lines.append(
+                    f"    {r['object_id'][:16]:<18} {r['kind']:<9} "
+                    f"{(r.get('where') or '-'):<7} "
+                    f"{_fmt_size(r.get('size')):>9}  {counts:<9}  "
+                    f"{'y' if r.get('lineage_pinned') else '-':<3}  "
+                    f"{r.get('callsite') or '-'}"
+                )
+    return "\n".join(lines) if lines else "(no nodes)"
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # cluster-lifecycle commands run WITHOUT a live cluster (reference:
@@ -54,6 +104,16 @@ def main(argv=None):
     ep.add_argument("--limit", type=int, default=100)
     tp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     tp.add_argument("--output", default="timeline.json")
+    mp = sub.add_parser(
+        "memory",
+        help="object-memory table: what is pinning the object store "
+             "(reference: `ray memory`)",
+    )
+    mp.add_argument("--kind", choices=["owned", "borrowed", "pending"],
+                    default=None)
+    mp.add_argument("--min-size", type=int, default=0)
+    mp.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw per-node tables instead of the rendering")
     jp = sub.add_parser("job", help="job submission")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)
     js = jsub.add_parser("submit")
@@ -95,6 +155,20 @@ def main(argv=None):
         elif args.cmd == "timeline":
             events = state.timeline(args.output)
             print(f"wrote {len(events)} events to {args.output}")
+        elif args.cmd == "memory":
+            if args.as_json:
+                if args.kind or args.min_size:
+                    # filters apply to JSON output too: flattened rows
+                    out = state.list_objects(kind=args.kind,
+                                             min_size=args.min_size)
+                else:
+                    out = state.memory_summary()
+                print(json.dumps(out, indent=2, default=str))
+            else:
+                print(render_memory_table(
+                    state.memory_summary(), kind=args.kind,
+                    min_size=args.min_size,
+                ))
         elif args.cmd == "job":
             from ray_tpu import job as job_api
 

@@ -156,6 +156,53 @@ __all__ = [
 ]
 
 
+def memory_summary() -> List[Dict[str, Any]]:
+    """Per-node object-memory tables (reference: `ray memory` —
+    `python/ray/_private/internal_api.py:34` + `scripts.py:1955`):
+    every runtime's reference table (kind, counts, size, residence,
+    opt-in creation callsite via RT_RECORD_REF_CREATION_SITES=1) plus
+    each daemon's store occupancy and spilled primaries.  This is the
+    tool that answers "what is pinning my object store"."""
+    rt = get_runtime()
+    out = []
+    for n in rt.controller_call("get_nodes") or []:
+        if not n.get("alive"):
+            continue
+        try:
+            t = rt.noded_call(
+                "route_node",
+                {"node_id": n["node_id"], "method": "memory_table"},
+                timeout=20,
+            )
+        except Exception:
+            continue  # node died between listing and the call
+        if t:
+            out.append(t)
+    return out
+
+
+def list_objects(kind: Optional[str] = None,
+                 min_size: int = 0) -> List[Dict[str, Any]]:
+    """Flattened object-reference rows across the cluster (reference:
+    `ray list objects`).  One row per (process, object) hold; filter by
+    `kind` (owned/borrowed/pending) or minimum value size."""
+    rows: List[Dict[str, Any]] = []
+    for node in memory_summary():
+        for proc in node.get("processes", []):
+            for r in proc.get("refs", []):
+                if kind and r["kind"] != kind:
+                    continue
+                if min_size and (r.get("size") or 0) < min_size:
+                    continue
+                rows.append({
+                    **r,
+                    "process": proc.get("mode"),
+                    "pid": proc.get("pid"),
+                    "node_id_host": node.get("node_id"),
+                })
+    return rows
+
+
 def list_cluster_events(severity: Optional[str] = None,
                         event_type: Optional[str] = None,
                         limit: int = 200) -> List[Dict[str, Any]]:

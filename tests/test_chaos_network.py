@@ -1,0 +1,223 @@
+"""Network-chaos suite: the control plane under injected delay,
+jitter, reorder, loss, and partition.
+
+Reference capability: `python/ray/tests/chaos/chaos_network_delay.yaml`
+and `release/nightly_tests/setup_chaos.py:94` (tc/netem pod-level
+faults).  Here faults are injected at the rpc frame-receive seam
+(`core/rpc.py NetworkChaos`) — one implementation covers unix and TCP
+links, per-process via `set_chaos` or cluster-wide via `RT_CHAOS` in
+the spawned daemons' environment.
+
+The drop model is deliberate: frame drop is only expected to be
+survivable where the component owns a timeout+retry (calls); one-way
+frames ride reliable ordered streams, so their loss model is
+connection death — covered by the lease-connection-kill test.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core import rpc
+
+
+@pytest.fixture()
+def chaos_cluster(monkeypatch):
+    """Cluster whose EVERY process (driver, daemon, workers) runs with
+    delay+jitter+reorder on every inbound frame."""
+    if rt.is_initialized():
+        rt.shutdown()
+    monkeypatch.setenv(
+        "RT_CHAOS",
+        '{"delay_s": 0.005, "jitter_s": 0.02, "reorder": true, "seed": 7}',
+    )
+    rpc.set_chaos(rpc.NetworkChaos(
+        delay_s=0.005, jitter_s=0.02, reorder=True, seed=11
+    ))
+    rt.init(num_workers=2, num_cpus=4)
+    yield
+    rt.shutdown()
+    rpc.set_chaos(None)
+
+
+@pytest.fixture()
+def quiet_cluster():
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_workers=2, num_cpus=4, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+    rpc.set_chaos(None)
+
+
+def _double(x):
+    return 2 * x
+
+
+class _Seq:
+    def __init__(self):
+        self.seen = []
+
+    def record(self, i):
+        self.seen.append(i)
+        return i
+
+    def all(self):
+        return self.seen
+
+
+def test_tasks_complete_under_delay_jitter_reorder(chaos_cluster):
+    """Submission, leases, results, and gets all survive every frame
+    being delayed 5-25 ms and delivered out of order."""
+    f = rt.remote(num_cpus=0)(_double)
+    assert rt.get([f.remote(i) for i in range(40)], timeout=120) == [
+        2 * i for i in range(40)
+    ]
+
+
+def test_actor_call_order_survives_transport_reorder(chaos_cluster):
+    """The per-(caller, group) sequence lanes must deliver actor tasks
+    in submission order even when the transport reorders frames."""
+    A = rt.remote(num_cpus=0)(_Seq)
+    a = A.remote()
+    for i in range(30):
+        a.record.remote(i)
+    assert rt.get(a.all.remote(), timeout=120) == list(range(30))
+
+
+def test_object_values_survive_chaos(chaos_cluster):
+    """Borrowed-object value resolution (bulk + per-ref) under chaos."""
+    class Owner:
+        def make(self, n):
+            self._refs = [rt.put(i) for i in range(n)]
+            return self._refs
+
+    O = rt.remote(num_cpus=0)(Owner)
+    o = O.remote()
+    refs = rt.get(o.make.remote(64), timeout=120)
+    assert rt.get(refs, timeout=120) == list(range(64))
+
+
+def test_controller_partition_then_heal(quiet_cluster):
+    """A one-sided controller partition: calls time out during the
+    outage, and the SAME connection serves calls again after heal —
+    no wedged state, no stale failure."""
+    chaos = rpc.NetworkChaos()
+    rpc.set_chaos(chaos)
+    from ray_tpu.core.runtime import get_runtime
+
+    r = get_runtime()
+    assert r.controller_call("get_nodes", timeout=10)  # healthy before
+
+    chaos.partition("controller")
+    with pytest.raises(Exception):
+        r.controller_call("get_nodes", timeout=1.5)
+    chaos.heal()
+    assert r.controller_call("get_nodes", timeout=30)
+
+
+def test_timed_partition_self_heals(quiet_cluster):
+    """`partition(duration_s=...)` expires on its own — the cluster
+    converges without an explicit heal."""
+    chaos = rpc.NetworkChaos()
+    rpc.set_chaos(chaos)
+    from ray_tpu.core.runtime import get_runtime
+
+    r = get_runtime()
+    chaos.partition("controller", duration_s=1.0)
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            if r.controller_call("get_nodes", timeout=2):
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.1)
+    assert ok, "controller never became reachable after timed partition"
+
+
+def test_dropped_call_frames_recovered_by_retry(quiet_cluster):
+    """30% frame loss on the controller link: individual calls may
+    fail, but a caller with timeout+retry always converges (the
+    documented survivable-loss contract)."""
+    chaos = rpc.NetworkChaos(drop_prob=0.3, match="controller", seed=3)
+    rpc.set_chaos(chaos)
+    from ray_tpu.core.runtime import get_runtime
+
+    r = get_runtime()
+    successes = 0
+    for _ in range(10):
+        for _attempt in range(20):
+            try:
+                if r.controller_call("get_nodes", timeout=1.0):
+                    successes += 1
+                    break
+            except Exception:
+                continue
+        else:
+            pytest.fail("a call never succeeded through 30% loss")
+    assert successes == 10
+
+
+def test_lease_connection_kill_mid_flight_retries(quiet_cluster):
+    """One-way result frames ride reliable streams; their loss model is
+    connection death.  Killing every live lease connection mid-storm
+    must not lose tasks — the close path requeues/retries them."""
+    import threading
+
+    from ray_tpu.core.runtime import get_runtime
+
+    r = get_runtime()
+
+    def slow(x):
+        import time as _t
+
+        _t.sleep(0.05)
+        return x + 1
+
+    f = rt.remote(num_cpus=0)(slow)
+    refs = [f.remote(i) for i in range(30)]
+
+    def killer():
+        time.sleep(0.3)  # let leases establish and tasks start flowing
+        for conn in list(r._conn_lease):
+            try:
+                asyncio.run_coroutine_threadsafe(conn.close(), r.loop)
+            except Exception:
+                pass
+
+    import asyncio
+
+    t = threading.Thread(target=killer)
+    t.start()
+    vals = rt.get(refs, timeout=120)
+    t.join()
+    assert vals == [i + 1 for i in range(30)]
+
+
+def test_serve_request_path_under_delay(chaos_cluster):
+    """proxy -> router -> replica over a chaotic control plane: HTTP
+    requests still complete correctly."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    def square(request):
+        n = int(request.query_params.get("n", "0"))
+        return {"sq": n * n}
+
+    serve.run(square.bind(), name="sq", route_prefix="/sq", timeout_s=120)
+    try:
+        host, port = serve.http_address()
+        for n in (3, 7, 11):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/sq?n={n}", timeout=60
+            ) as resp:
+                assert json.loads(resp.read())["sq"] == n * n
+    finally:
+        serve.shutdown()
