@@ -113,6 +113,18 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 node.proc.kill()
                 node.proc.wait(timeout=5)
+        # a SIGKILL'd (or kill-injected) daemon never unlinks its shm
+        # store; /dev/shm is a shared host resource, so reap it here —
+        # tmpfs segments leaked per killed node otherwise accumulate
+        # across test runs until the host's shm fills
+        shm_name = (node.ready or {}).get("shm_name")
+        if shm_name:
+            try:
+                from ray_tpu.shm import ShmStore
+
+                ShmStore.unlink(shm_name)
+            except Exception:
+                pass
         self._nodes = [n for n in self._nodes if n is not node]
 
     def connect(self, **init_kwargs):

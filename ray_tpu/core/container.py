@@ -1,0 +1,184 @@
+"""Container runtime-env: run workers inside an image.
+
+Reference: `python/ray/_private/runtime_env/image_uri.py:106`
+(`ImageURIPlugin` — the runtime-env agent wraps the worker command in
+`podman run` with the session dir and networking shared).  Here the
+node daemon owns worker spawning, so the container wrapper is applied
+at spawn synthesis time through an injectable `ContainerRuntime` seam
+(mock in tests; podman/docker when present on the host).
+
+runtime_env surface (either form):
+    {"image_uri": "docker.io/org/img:tag"}
+    {"container": {"image": "...", "run_options": ["--cap-add=..."],
+                   "python": "/usr/bin/python3"}}
+
+Workers spawned for a container env are DEDICATED to its env hash: a
+plain worker can never serve a containerized env (there is no way to
+enter an image from inside an already-running process), and the
+scheduler only matches exact env hashes for such demands.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+from typing import Any, Dict, List, Optional
+
+CONTAINER_KEYS = ("image_uri", "container")
+
+
+def container_section(renv: Optional[Dict[str, Any]]) -> Optional[Dict]:
+    """Normalized container spec from a runtime env, or None.
+    `image_uri` is sugar for `{"container": {"image": ...}}`."""
+    if not renv:
+        return None
+    if renv.get("image_uri") and renv.get("container"):
+        raise ValueError(
+            "runtime_env cannot set both 'image_uri' and 'container'"
+        )
+    if renv.get("image_uri"):
+        return {"image": renv["image_uri"]}
+    c = renv.get("container")
+    if not c:
+        return None
+    if not isinstance(c, dict) or not c.get("image"):
+        raise ValueError(
+            "runtime_env['container'] must be a dict with an 'image'"
+        )
+    if not isinstance(c["image"], str):
+        raise ValueError("container 'image' must be a string")
+    opts = c.get("run_options") or []
+    # a bare string would explode into characters; non-strings would
+    # fail deep inside the daemon's spawn, leaking its pending slot
+    if (not isinstance(opts, (list, tuple))
+            or not all(isinstance(o, str) for o in opts)):
+        raise ValueError(
+            "container 'run_options' must be a list of strings"
+        )
+    python = c.get("python") or "python3"
+    if not isinstance(python, str):
+        raise ValueError("container 'python' must be a string")
+    return {
+        "image": c["image"],
+        "run_options": list(opts),
+        "python": python,
+    }
+
+
+class ContainerRuntime:
+    """Synthesizes the argv that runs a worker inside a container.
+    Injectable seam (reference: the podman command assembly in
+    `image_uri.py`); `available()` gates scheduling-time validation."""
+
+    def available(self) -> bool:
+        raise NotImplementedError
+
+    def synthesize(self, spec: Dict[str, Any], inner_argv: List[str],
+                   env: Dict[str, str],
+                   mounts: List[str]) -> List[str]:
+        raise NotImplementedError
+
+    def kill_booting(self, token: str) -> None:
+        """Best-effort kill of a spawned-but-unregistered worker; the
+        default (host-exec fakes) needs nothing beyond the client
+        SIGKILL the daemon already sends."""
+
+
+class DefaultContainerRuntime(ContainerRuntime):
+    """podman preferred, docker fallback (reference: podman in
+    `image_uri.py`, docker via the cluster-launcher path)."""
+
+    def __init__(self):
+        self._exe = shutil.which("podman") or shutil.which("docker")
+
+    def available(self) -> bool:
+        return self._exe is not None
+
+    def synthesize(self, spec, inner_argv, env, mounts):
+        if not self._exe:
+            raise RuntimeError(
+                "no container runtime on PATH (podman/docker) for "
+                f"image {spec.get('image')!r}"
+            )
+        # host namespaces: the daemon addresses workers by pid (boot
+        # accounting, shm creator reaping) and shares unix sockets and
+        # /dev/shm segments with them — an isolated pid/ipc/net
+        # namespace would break all three
+        argv = [self._exe, "run", "--rm", "--network=host",
+                "--ipc=host", "--pid=host"]
+        token = env.get("RT_SPAWN_TOKEN")
+        if token:
+            # a deterministic name so a hung boot can be killed: SIGKILL
+            # on the podman CLIENT would strand the container
+            argv += ["--name", f"rtw-{token}"]
+        for m in mounts:
+            argv += ["-v", f"{m}:{m}"]
+        for k, v in sorted(env.items()):
+            argv += ["--env", f"{k}={v}"]
+        argv += list(spec.get("run_options") or ())
+        argv.append(spec["image"])
+        python = spec.get("python") or "python3"
+        # inner_argv is [sys.executable, "-m", ...]: swap in the
+        # image's interpreter
+        argv += [python] + list(inner_argv[1:])
+        return argv
+
+
+    def kill_booting(self, token: str) -> None:
+        """Terminate a named still-booting container (the boot-deadline
+        path: killing the client process does not kill the container)."""
+        if self._exe and token:
+            import subprocess
+
+            subprocess.Popen(
+                [self._exe, "kill", f"rtw-{token}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+
+class RecordingFakeRuntime(ContainerRuntime):
+    """Test double: records what WOULD run (JSON lines at `log_path`)
+    and execs the worker directly on the host so clusters in images
+    without podman still exercise the full spawn/dedication path."""
+
+    def __init__(self, log_path: str):
+        self.log_path = log_path
+        self._real = DefaultContainerRuntime()
+
+    def available(self) -> bool:
+        return True
+
+    def synthesize(self, spec, inner_argv, env, mounts):
+        record = {
+            "image": spec.get("image"),
+            "run_options": spec.get("run_options") or [],
+            "env": dict(env),
+            "mounts": list(mounts),
+            "argv": (self._real.synthesize(spec, inner_argv, env, mounts)
+                     if self._real.available() else None),
+        }
+        with open(self.log_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        return list(inner_argv)
+
+
+_runtime: Optional[ContainerRuntime] = None
+
+
+def set_container_runtime(runtime: Optional[ContainerRuntime]) -> None:
+    global _runtime
+    _runtime = runtime
+
+
+def get_container_runtime() -> ContainerRuntime:
+    """Process-wide container runtime; `RT_CONTAINER_FAKE_LOG` installs
+    the recording fake (inherited by spawned daemons, so tests can
+    assert command synthesis across processes)."""
+    global _runtime
+    if _runtime is None:
+        fake = os.environ.get("RT_CONTAINER_FAKE_LOG")
+        _runtime = (RecordingFakeRuntime(fake) if fake
+                    else DefaultContainerRuntime())
+    return _runtime

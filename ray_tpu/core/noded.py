@@ -112,7 +112,7 @@ class NodeDaemon:
         self.num_workers = num_workers or int(ncpu)
         self.store: Optional[ShmStore] = None
         self.workers: Dict[str, WorkerState] = {}  # worker_id -> state
-        self._booting_pids: set = set()  # spawned, not yet registered
+        self._booting_tokens: set = set()  # spawn tokens not yet registered
         self._conn_worker: Dict[rpc.Connection, str] = {}
         # actor_id -> (ActorCreationSpec, worker_id) for actors this
         # node hosts — re-reported to a restarted controller so the
@@ -310,7 +310,12 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     _pending_spawns = 0
 
-    def _spawn_worker(self) -> None:
+    def _spawn_worker(self, container: Optional[tuple] = None) -> None:
+        """`container=(env_hash, spec)` spawns the worker INSIDE the
+        image via the injectable container runtime (reference:
+        `runtime_env/image_uri.py:106` — the worker command wrapped in
+        `podman run` with session dir and networking shared); such
+        workers register pre-dedicated to their env hash."""
         from ray_tpu.core.env_utils import worker_env
 
         if logger.isEnabledFor(logging.DEBUG):
@@ -327,22 +332,61 @@ class NodeDaemon:
         env.update(self.cfg.to_env())
         env["RT_NODE_SOCKET"] = self.socket_path
         env["RT_CONTROLLER"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
+        # spawn tokens (not pids) key the boot accounting: a container
+        # worker's registering pid is NOT the Popen pid (that's the
+        # podman client), and pid reuse could misattribute anyway
+        token = os.urandom(8).hex()
+        env["RT_SPAWN_TOKEN"] = token
+        argv = [sys.executable, "-m", "ray_tpu.core.worker_main"]
+        if container is not None:
+            env_hash, cspec = container
+            env["RT_ENV_HASH"] = env_hash
+            from ray_tpu.core.container import get_container_runtime
+
+            import ray_tpu as _pkg
+
+            pkg_root = os.path.dirname(
+                os.path.dirname(os.path.abspath(_pkg.__file__))
+            )
+            mounts = sorted({
+                os.environ.get("RT_TMPDIR", "/tmp/ray_tpu"),
+                self.session_dir, pkg_root, "/dev/shm",
+            })
+            try:
+                # the WHOLE worker env crosses the boundary — a
+                # container worker with default-config RT_* settings
+                # would silently diverge from every host worker
+                argv = get_container_runtime().synthesize(
+                    cspec, argv,
+                    {k: v for k, v in env.items() if v is not None},
+                    mounts,
+                )
+            except Exception:
+                # e.g. no podman/docker on this host: release the
+                # pending-spawn slot or on-demand spawning wedges
+                # forever for ALL tasks on this node
+                self._pending_spawns -= 1
+                logger.exception(
+                    "container worker spawn failed for image %r",
+                    cspec.get("image"),
+                )
+                raise
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            argv,
             env=env,
             stdout=open(os.path.join(self.session_dir, "logs", f"worker-{time.time():.0f}-{os.urandom(2).hex()}.out"), "wb"),
             stderr=subprocess.STDOUT,
         )
-        # booting = spawned but not yet registered; membership (not pid
-        # presence in self.workers) is what decides who releases the
+        # booting = spawned but not yet registered; token membership
+        # (not pid presence in self.workers) decides who releases the
         # pending-spawn slot, so a registered worker's later death can
         # never double-release it
-        self._booting_pids.add(proc.pid)
+        self._booting_tokens.add(token)
         # the worker introduces itself via `register`; we just remember
         # the proc so we can reap/replace it
-        asyncio.ensure_future(self._watch_proc(proc))
+        asyncio.ensure_future(self._watch_proc(proc, token))
 
-    async def _watch_proc(self, proc: subprocess.Popen):
+    async def _watch_proc(self, proc: subprocess.Popen, token: str):
         # a boot that HANGS (rather than crashes) would otherwise hold
         # its pending-spawn slot forever and wedge the pool at size 0 —
         # kill it past the deadline so the crash path releases the slot
@@ -352,20 +396,30 @@ class NodeDaemon:
         )
         boot_killed = False
         while proc.poll() is None:
-            if (not boot_killed and proc.pid in self._booting_pids
+            if (not boot_killed and token in self._booting_tokens
                     and time.monotonic() > boot_deadline):
                 logger.warning(
                     "worker pid %d still booting after deadline: killing",
                     proc.pid,
                 )
                 boot_killed = True  # once; an unkillable proc must not re-warn 5x/s
+                try:
+                    # containerized boots: the client SIGKILL below
+                    # strands the container — kill it by name too
+                    from ray_tpu.core.container import (
+                        get_container_runtime,
+                    )
+
+                    get_container_runtime().kill_booting(token)
+                except Exception:
+                    pass
                 proc.kill()
             await asyncio.sleep(0.2)
-        if proc.pid in self._booting_pids:
+        if token in self._booting_tokens:
             # died before registering: release the pending-spawn slot
             # so on-demand spawning doesn't deadlock on a boot-crashing
             # worker
-            self._booting_pids.discard(proc.pid)
+            self._booting_tokens.discard(token)
             if self._pending_spawns > 0:
                 self._pending_spawns -= 1
             logger.warning(
@@ -436,10 +490,14 @@ class NodeDaemon:
             conn=conn,
             kind=payload["kind"],
         )
-        if w.pid in self._booting_pids:
-            self._booting_pids.discard(w.pid)
+        tok = payload.get("spawn_token")
+        if tok and tok in self._booting_tokens:
+            self._booting_tokens.discard(tok)
             if self._pending_spawns > 0:
                 self._pending_spawns -= 1
+        if payload.get("env_hash"):
+            # spawned inside a container image: dedicated from birth
+            w.env_hash = payload["env_hash"]
         w.socket_path = payload.get("socket_path")
         self.workers[w.worker_id] = w
         self._conn_worker[conn] = w.worker_id
@@ -555,8 +613,44 @@ class NodeDaemon:
         # contended) spawns another worker, and each new boot slows the
         # others further: a spawn storm (reference: starting-worker
         # accounting in `worker_pool.cc` MaybeStartNewWorker)
-        if q and len(self.workers) + self._pending_spawns < self.num_workers:
-            self._spawn_worker()
+        head = self._spec_container(q[0]) if q else None
+        if head is not None and not _fits(
+            q[0].resources.as_dict(), self.available
+        ):
+            # a saturated node must not boot dedicated container
+            # workers it cannot lease — they can never serve plain
+            # tasks and each boot costs seconds and memory
+            head = None
+        if q and (
+            len(self.workers) + self._pending_spawns < self.num_workers
+            or (head is not None and self._pending_spawns == 0
+                and len(self.workers) <= self.num_workers * 2)
+        ):
+            # container demands need a DEDICATED image-spawned worker:
+            # the pre-spawned host pool can never serve them, so the
+            # pool-full gate alone would starve queued container tasks
+            try:
+                self._spawn_worker(
+                    container=((q[0].env_hash, head) if head else None)
+                )
+            except Exception:
+                pass  # logged in _spawn_worker; queue retries next tick
+
+    @staticmethod
+    def _spec_container(spec) -> Optional[Dict]:
+        """Container section of a spec's runtime env (daemon-routed
+        tasks carry the full env in the spec).  env_hash-gated: specs
+        with no runtime env (the overwhelmingly common case — this
+        runs inside the scheduling scan) exit without touching the
+        env dict."""
+        if getattr(spec, "env_hash", None) is None:
+            return None
+        try:
+            from ray_tpu.core.container import container_section
+
+            return container_section(getattr(spec, "runtime_env", None))
+        except Exception:
+            return None
 
     def _find_worker_for(self, spec: TaskSpec) -> Optional[WorkerState]:
         demand = spec.resources.as_dict()
@@ -577,7 +671,8 @@ class NodeDaemon:
         if _fits(demand, self.available):
             tpu_n = self._tpu_chips_needed(demand)
             w = self._pick_idle_worker(
-                tpu_n, require_no_lease=True, env_hash=spec.env_hash
+                tpu_n, require_no_lease=True, env_hash=spec.env_hash,
+                require_exact_env=self._spec_container(spec) is not None,
             )
             if w is None:
                 # idle workers may be pinned to the wrong chip count or
@@ -955,7 +1050,7 @@ class NodeDaemon:
 
     def _pick_idle_worker(
         self, tpu_n: int, require_no_lease: bool = False,
-        env_hash: Optional[str] = None,
+        env_hash: Optional[str] = None, require_exact_env: bool = False,
     ) -> Optional[WorkerState]:
         """Idle-worker choice, chip- and env-pinning aware: an n-chip
         demand prefers a worker already pinned to n chips (its runtime
@@ -971,6 +1066,11 @@ class NodeDaemon:
                 continue
             if w.env_hash is not None and w.env_hash != env_hash:
                 continue  # tainted with a different env: never reuse
+            if require_exact_env and w.env_hash != env_hash:
+                # container envs: a plain worker cannot enter an image
+                # from inside a running process — only a worker spawned
+                # IN the image (pre-dedicated) may serve this demand
+                continue
             # env_ready: this worker already applied the demanded env
             # (a clean worker serving an env demand is acceptable but a
             # same-env worker is better); for clean demands both are
@@ -1036,7 +1136,11 @@ class NodeDaemon:
             return None
         tpu_n = self._tpu_chips_needed(demand)
         env_hash = payload.get("env_hash")
-        w = self._pick_idle_worker(tpu_n, env_hash=env_hash)
+        container = payload.get("container")
+        w = self._pick_idle_worker(
+            tpu_n, env_hash=env_hash,
+            require_exact_env=container is not None,
+        )
         if w is not None:
             # reserve BEFORE any await: a concurrent lease request must
             # see these resources as taken or the node oversubscribes
@@ -1065,7 +1169,15 @@ class NodeDaemon:
             return (w.worker_id, w.socket_path)
         self._reclaim_idle_pinned(tpu_n, env_hash)
         if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
-            self._spawn_worker()
+            try:
+                self._spawn_worker(
+                    container=((env_hash, container) if container else None)
+                )
+            except Exception as e:
+                # surface spawn failures (no podman on host, bad image)
+                # to the caller: the driver fails the queued tasks with
+                # a runtime-env error instead of retrying forever
+                return {"env_error": f"container worker spawn failed: {e}"}
         return None
 
     async def handle_return_lease(self, payload, conn):
@@ -1676,6 +1788,11 @@ class NodeDaemon:
         from ray_tpu.core.runtime_env import runtime_env_hash as _reh
 
         actor_env_hash = _reh(aspec.runtime_env)
+        # NOT _spec_container: its env_hash fast-gate is for TaskSpecs;
+        # ActorCreationSpec carries runtime_env without an env_hash
+        from ray_tpu.core.container import container_section
+
+        actor_container = container_section(aspec.runtime_env)
         target = None
         # generous: a fresh worker's first boot imports jax + the TPU
         # plugin (~10s/worker on hardware, multiplied under CPU
@@ -1684,7 +1801,8 @@ class NodeDaemon:
         deadline = time.monotonic() + 240
         while target is None:
             target = self._pick_idle_worker(
-                tpu_n, require_no_lease=True, env_hash=actor_env_hash
+                tpu_n, require_no_lease=True, env_hash=actor_env_hash,
+                require_exact_env=actor_container is not None,
             )
             if target is not None and tpu_n and not self._assign_chips(
                 target, tpu_n
@@ -1697,7 +1815,18 @@ class NodeDaemon:
                         self.available[k] = self.available.get(k, 0.0) + v
                     return {"ok": False, "error": "no idle worker"}
                 if self._pending_spawns == 0:
-                    self._spawn_worker()
+                    try:
+                        self._spawn_worker(container=(
+                            (actor_env_hash, actor_container)
+                            if actor_container else None
+                        ))
+                    except Exception as e:
+                        for k, v in demand.items():
+                            self.available[k] = (
+                                self.available.get(k, 0.0) + v
+                            )
+                        return {"ok": False,
+                                "error": f"worker spawn failed: {e}"}
                 await asyncio.sleep(0.02)
         if actor_env_hash is not None:
             # even if __init__ fails and the worker returns to the

@@ -219,13 +219,14 @@ class _LeasePool:
     `normal_task_submitter.h`)."""
 
     __slots__ = ("sig", "demand", "leases", "queue", "requesting",
-                 "env_hash")
+                 "env_hash", "container")
 
     def __init__(self, sig, demand):
         self.sig = sig
         self.demand = demand
         self.leases: Dict[str, _Lease] = {}
         self.queue: deque = deque()
+        self.container = None
         self.requesting = False
         self.env_hash: Optional[str] = None  # runtime-env dedication
 
@@ -388,6 +389,10 @@ class Runtime:
                 "pid": os.getpid(),
                 "job_id": self.job_id.hex(),
                 "socket_path": self.my_socket,
+                # spawn-token boot accounting + container pre-dedication
+                # (set by the daemon's _spawn_worker; absent for drivers)
+                "spawn_token": os.environ.get("RT_SPAWN_TOKEN"),
+                "env_hash": os.environ.get("RT_ENV_HASH"),
             },
         )
         self.node_id = info["node_id"]
@@ -1035,6 +1040,13 @@ class Runtime:
         if pool is None:
             pool = self._pools[sig] = _LeasePool(sig, demand)
             pool.env_hash = spec.env_hash
+            # container envs ride the lease request so the daemon can
+            # spawn the worker INSIDE the image (core/container.py)
+            from ray_tpu.core.container import container_section
+
+            pool.container = container_section(
+                getattr(spec, "runtime_env", None)
+            )
         return pool
 
     # args at least this big make their node the preferred executor
@@ -1129,7 +1141,8 @@ class Runtime:
                     reply = await self.noded.call(
                         "request_lease",
                         {"resources": pool.demand,
-                         "env_hash": pool.env_hash},
+                         "env_hash": pool.env_hash,
+                         "container": getattr(pool, "container", None)},
                         timeout=60,
                     )
                 except Exception:
@@ -1138,6 +1151,28 @@ class Runtime:
                 if reply is None:
                     await asyncio.sleep(0.02)
                     continue
+                if isinstance(reply, dict) and reply.get("env_error"):
+                    # the daemon cannot materialize this runtime env at
+                    # all (e.g. container image with no podman/docker on
+                    # the host): fail the queued tasks with the cause
+                    # instead of retrying forever
+                    envelope = ser.serialize_to_bytes(
+                        exc.RayTpuError(
+                            f"runtime_env setup failed: "
+                            f"{reply['env_error']}"
+                        ),
+                        tag=ser.TAG_ERROR,
+                    )
+                    with self._state_lock:
+                        specs = list(pool.queue)
+                        pool.queue.clear()
+                        pool.requesting = False
+                    for s in specs:
+                        self._complete_task(TaskResult(
+                            task_id=s.task_id, status="error",
+                            error=envelope,
+                        ))
+                    return
                 if isinstance(reply, dict) and reply.get("infeasible"):
                     # local node can never host this demand: hand the
                     # queued tasks to the node daemon, whose queue path

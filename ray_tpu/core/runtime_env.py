@@ -428,10 +428,45 @@ def validate_runtime_env(renv: Optional[Dict[str, Any]]) -> None:
             "runtime_env cannot set both 'pip' and 'conda'; put pip "
             "requirements under the conda env's dependencies instead"
         )
+    from ray_tpu.core.container import container_section
+
+    container_section(renv)  # raises on a malformed container/image_uri
+
+
+class _ContainerPlugin(RuntimeEnvPlugin):
+    """Worker-side arm of the container env (reference:
+    `runtime_env/image_uri.py:106`): the image was entered at SPAWN
+    time by the node daemon's command synthesis, so setup here only
+    verifies this worker really was spawned for this env — a plain
+    worker cannot enter an image from inside a running process."""
+
+    name = "container"
+    priority = 0
+
+    async def setup(self, value, runtime):
+        if not value:
+            return
+        from ray_tpu.core.container import container_section
+
+        expected = runtime_env_hash(
+            getattr(runtime, "_applying_renv", None)
+        )
+        have = os.environ.get("RT_ENV_HASH")
+        if expected is not None and have != expected:
+            raise RuntimeError(
+                "container runtime_env reached a worker that was not "
+                f"spawned in its image (want env {expected}, worker "
+                f"has {have!r}) — scheduler dedication bug"
+            )
+
+
+class _ImageUriPlugin(_ContainerPlugin):
+    name = "image_uri"
 
 
 for _p in (_EnvVarsPlugin(), _WorkingDirPlugin(), _PyModulesPlugin(),
-           _PipPlugin(), _CondaPlugin()):
+           _PipPlugin(), _CondaPlugin(), _ContainerPlugin(),
+           _ImageUriPlugin()):
     register_runtime_env_plugin(_p)
 
 
@@ -448,9 +483,15 @@ async def apply_runtime_env(renv: Dict[str, Any], runtime: Any) -> None:
             f"runtime_env sections {sorted(unknown)} have no registered "
             "plugin (register_runtime_env_plugin)"
         )
-    for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
-        if plugin.name in renv:
-            await plugin.setup(renv[plugin.name], runtime)
+    if runtime is not None:
+        runtime._applying_renv = renv  # full env, for plugin hash checks
+    try:
+        for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
+            if plugin.name in renv:
+                await plugin.setup(renv[plugin.name], runtime)
+    finally:
+        if runtime is not None:
+            runtime._applying_renv = None
 
 
 def materialize_py_module(key: str, blob: bytes) -> str:

@@ -15,6 +15,7 @@ numpy arrays deserialize as views over shared memory without a copy.
 
 from __future__ import annotations
 
+import os
 import pickle
 import struct
 from typing import Any, Callable, List, Optional, Tuple
@@ -110,7 +111,11 @@ def serialize_to_bytes(value: Any, tag: int = TAG_DATA) -> bytes:
 
 
 _PARALLEL_COPY_MIN = 16 * 1024 * 1024
-_COPY_WORKERS = 6
+# parallel memcpy only helps with cores to run it: on a 1-2 vCPU box
+# the thread fan-out costs ~7x on fresh tmpfs pages (page-fault path is
+# kernel-serialized; threads just thrash the core) — measured 0.14 GB/s
+# with 6 workers vs 1.0 GB/s single-threaded on 1 vCPU
+_COPY_WORKERS = max(1, min(6, (os.cpu_count() or 1) - 1))
 _copy_pool = None
 
 
@@ -143,7 +148,8 @@ def _parallel_copy(dest: memoryview, src: memoryview) -> None:
 def write_chunks(chunks: List[memoryview], dest: memoryview):
     pos = 0
     for c in chunks:
-        if c.nbytes >= _PARALLEL_COPY_MIN and c.contiguous:
+        if (_COPY_WORKERS > 1 and c.nbytes >= _PARALLEL_COPY_MIN
+                and c.contiguous):
             _parallel_copy(dest[pos : pos + c.nbytes], c)
         else:
             dest[pos : pos + c.nbytes] = c
