@@ -633,8 +633,30 @@ class NodeDaemon:
                 self._spawn_worker(
                     container=((q[0].env_hash, head) if head else None)
                 )
-            except Exception:
-                pass  # logged in _spawn_worker; queue retries next tick
+            except Exception as e:
+                # the env cannot be materialized on this host (no
+                # podman/docker, bad image): fail the queued tasks of
+                # that env with the cause — retrying every tick would
+                # hang them forever while spamming the log (the lease
+                # path returns env_error for the same contract)
+                from ray_tpu.core import serialization as ser
+
+                bad_env = q[0].env_hash
+                doomed = [s for s in q
+                          if s.env_hash == bad_env
+                          and self._spec_container(s) is not None]
+                for s in doomed:
+                    q.remove(s)
+                    result = TaskResult(
+                        task_id=s.task_id, status="error",
+                        error=ser.serialize_to_bytes(RuntimeError(
+                            "runtime_env setup failed: container "
+                            f"worker spawn failed: {e}"),
+                            tag=ser.TAG_ERROR),
+                    )
+                    asyncio.ensure_future(self._route_to_owner(
+                        s.owner, "task_result", result
+                    ))
 
     @staticmethod
     def _spec_container(spec) -> Optional[Dict]:

@@ -446,8 +446,6 @@ class _ContainerPlugin(RuntimeEnvPlugin):
     async def setup(self, value, runtime):
         if not value:
             return
-        from ray_tpu.core.container import container_section
-
         expected = runtime_env_hash(
             getattr(runtime, "_applying_renv", None)
         )

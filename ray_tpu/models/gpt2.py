@@ -311,12 +311,15 @@ def loss_fn(cfg: GPT2Config, params: Dict, tokens: jax.Array,
 
     Uses the lse-reduction form: XLA fuses the logsumexp into the
     lm-head matmul's epilogue, so the [B, T, vocab] *log-prob* tensor
-    never materializes (the logits do, transiently).  Measured faster
-    at 124M/seq1024 on v5e than `ops.xent.fused_cross_entropy` (76.0k
-    vs 65.7k tok/s): the explicit row-chunk scan serializes the lm-head
-    matmul and pays [vocab, embd] f32 dW-accumulator traffic per chunk.
-    The fused op remains the right tool when the logits themselves
-    don't fit (long-seq / big-vocab), not as this benchmark's default.
+    never materializes (the logits do, transiently).  Measured best at
+    EVERY scale tried on v5e (PERF.md r5): the lm-head is MXU-bound at
+    these widths, XLA stores bf16 logits once and skips the backward
+    recompute, and its fused schedule keeps scaling linearly even when
+    logits+dlogits exceed HBM — so both no-materialize formulations
+    (`ops.xent.fused_cross_entropy` scan-chunked, and the Pallas
+    blockwise `ops.xent_pallas.pallas_cross_entropy`) lose: they must
+    recompute the lm-head matmul in the backward, which costs more
+    than the HBM they save.
     """
     inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
