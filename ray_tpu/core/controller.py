@@ -650,6 +650,35 @@ class Controller:
             self.task_events.append(ev)
         return {"ok": True}
 
+    async def handle_task_state_summary(self, payload, conn):
+        """state -> count over the event window, reduced IN the
+        controller (latest event per task wins; terminal states break
+        timestamp ties).  The dashboard header polls this every couple
+        of seconds — shipping the 50k-event ring over RPC per poll
+        would dwarf the reduction itself, so a short TTL cache bounds
+        the cost to O(ring)/TTL regardless of client count."""
+        import time as _t
+
+        now = _t.monotonic()
+        cached = getattr(self, "_task_summary_cache", None)
+        if cached is not None and now - cached[0] < 2.0:
+            return cached[1]
+        rank = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+        latest = {}
+        for ev in self.task_events:
+            tid = ev.get("task_id")
+            st = ev.get("state")
+            if not tid or st is None:
+                continue  # malformed reports must not poison the poll
+            key = (ev.get("ts", 0.0), rank.get(st, 0))
+            if tid not in latest or key >= latest[tid][0]:
+                latest[tid] = (key, st)
+        summary: dict = {}
+        for _, st in latest.values():
+            summary[st] = summary.get(st, 0) + 1
+        self._task_summary_cache = (now, summary)
+        return summary
+
     async def handle_list_task_events(self, payload, conn):
         payload = payload or {}
         limit = payload.get("limit", 1000)

@@ -15,7 +15,31 @@ from typing import Any, Dict, Optional, Tuple
 from ray_tpu.serve.request import Request
 from ray_tpu.util import httpd
 
-_PAGE = """<!doctype html>
+_SPA_CACHE: Optional[str] = None
+
+
+def _load_spa() -> str:
+    """The buildless single-file SPA (app.html, served at `/`) —
+    capability parity with the reference's React client
+    (`dashboard/client/src/App.tsx`: live task/actor/node/job tables
+    with filters, inline timeline, metric sparklines, log tail)
+    without any npm pipeline.  Read once and cached — handlers run on
+    the actor's io loop and must not do per-request disk I/O.  Falls
+    back to the minimal inline page if the file is missing."""
+    global _SPA_CACHE
+    if _SPA_CACHE is None:
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "app.html")
+        try:
+            with open(path, encoding="utf-8") as f:
+                _SPA_CACHE = f.read()
+        except OSError:
+            _SPA_CACHE = _FALLBACK_PAGE
+    return _SPA_CACHE
+
+
+_FALLBACK_PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
 <style>
  body { font-family: monospace; margin: 2em; background: #111; color: #eee; }
@@ -83,15 +107,19 @@ class DashboardHead:
     async def _dispatch(self, req: Request) -> Tuple[int, str, bytes]:
         path = req.path.rstrip("/") or "/"
         if path == "/":
-            return 200, "text/html; charset=utf-8", _PAGE.encode()
+            return 200, "text/html; charset=utf-8", _load_spa().encode()
         if path == "/api/cluster_status":
             nodes = await self._ctl("get_nodes")
             actors = await self._ctl("list_actors")
             auto = await self._ctl("get_autoscaler_state")
+            # controller-side reduction with a TTL cache: no 50k-event
+            # RPC per poll (the SPA hits this every 2 s)
+            summary = await self._ctl("task_state_summary") or {}
             return httpd.json_response({
                 "nodes_alive": sum(1 for n in nodes if n["alive"]),
                 "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
                 "pending_demands": auto["pending_demands"],
+                "task_summary": summary,
             })
         if path == "/api/nodes":
             return httpd.json_response(await self._ctl("get_nodes"))

@@ -236,3 +236,22 @@ def test_profile_flamegraph_and_memory(dash):
         mem = json.loads(r.read())
     assert "stacks" in mem and mem["mode"] == "memory"
     rt.get(ref, timeout=budget + 30)
+
+
+def test_spa_served_with_live_features(dash):
+    """`/` serves the single-file SPA (reference capability:
+    `dashboard/client/src/App.tsx`) — tables with state filters,
+    inline timeline renderer, sparklines, log tail."""
+    status, body = _get(dash + "/")
+    assert status == 200
+    page = body.decode()
+    for marker in ("drawTimeline", "taskState", "sp-rate", "api/memory",
+                   "loglist"):
+        assert marker in page, f"SPA missing {marker}"
+
+
+def test_cluster_status_includes_task_summary(dash):
+    status, body = _get(dash + "/api/cluster_status")
+    assert status == 200
+    doc = json.loads(body)
+    assert "task_summary" in doc and isinstance(doc["task_summary"], dict)
