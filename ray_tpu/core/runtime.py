@@ -511,7 +511,13 @@ class Runtime:
     # helpers bridging threads
     # ------------------------------------------------------------------
     def _run(self, coro, timeout=None):
-        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        except BaseException:
+            # loop already closed (teardown race): the coroutine object
+            # must be closed or CPython warns 'never awaited' at GC
+            coro.close()
+            raise
         try:
             return fut.result(timeout)
         except TimeoutError:
