@@ -226,11 +226,21 @@ class CollectiveGroup:
             return rt.get(ref)
 
         # reduce-scatter: after n-1 steps rank r holds the fully
-        # reduced chunk (r+1) mod n
+        # reduced chunk (r+1) mod n.  The reduce writes IN PLACE into
+        # the accumulator chunk (acc entries are private copies): the
+        # out-of-place form allocated + wrote a fresh chunk per step,
+        # doubling memory traffic on the host tier's scarcest resource
+        # (all ranks time-slice the same cores)
         for step in range(n - 1):
             _send_chunk(acc[(r - step) % n])
             recv_idx = (r - step - 1) % n
-            acc[recv_idx] = reduce_pair(acc[recv_idx], _recv_chunk())
+            recv = _recv_chunk()
+            tgt = acc[recv_idx]
+            if (tgt.flags.writeable
+                    and np.can_cast(recv.dtype, tgt.dtype, "same_kind")):
+                reduce_pair(tgt, recv, out=tgt)
+            else:
+                acc[recv_idx] = reduce_pair(tgt, recv)
         # allgather: circulate the reduced chunks
         for step in range(n - 1):
             _send_chunk(acc[(r - step + 1) % n])
