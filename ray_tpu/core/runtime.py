@@ -2151,6 +2151,8 @@ class Runtime:
         import queue as _q
 
         q = _q.Queue()
+        if self._shutdown:
+            raise RuntimeError("runtime is shut down")
         with self._state_lock:
             self._pubsub_queues.setdefault(channel, []).append(q)
         cancelled = None
@@ -2208,21 +2210,24 @@ class Runtime:
         return _Subscription(self)
 
     def _spawn_pubsub_reconcile(self) -> None:
-        """Fire-and-forget a reconcile pass on the io loop.  If the loop
-        is already closed (teardown racing a close()), the coroutine
-        object must be explicitly closed — otherwise it is abandoned
-        un-awaited and CPython warns at GC time."""
-        coro = self._pubsub_reconcile()
+        """Fire-and-forget a reconcile pass on the io loop.  The
+        coroutine is created INSIDE the loop-thread callback, never
+        handed across threads: `run_coroutine_threadsafe` parks the
+        coroutine in a callback that silently never runs when the loop
+        stops first — abandoning it un-awaited (CPython warns at GC).
+        With this shape, a stopped loop simply never creates it."""
+        def _cb():
+            if self._shutdown:
+                return
+            task = asyncio.ensure_future(self._pubsub_reconcile())
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+
         try:
-            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+            self.loop.call_soon_threadsafe(_cb)
         except Exception:
-            coro.close()
-            return
-        # consume the result so a failed pass never surfaces as an
-        # "exception was never retrieved" warning
-        fut.add_done_callback(
-            lambda f: f.exception() if not f.cancelled() else None
-        )
+            pass  # loop closed: nothing to reconcile against anymore
 
     async def _pubsub_reconcile(self) -> bool:
         """Single-writer pubsub registration reconciler: drives the

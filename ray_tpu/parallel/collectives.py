@@ -231,16 +231,14 @@ class CollectiveGroup:
         # out-of-place form allocated + wrote a fresh chunk per step,
         # doubling memory traffic on the host tier's scarcest resource
         # (all ranks time-slice the same cores)
+        # (safe unconditionally: every acc entry is a fresh writable
+        # copy/astype, and all ranks run the identical dtype pipeline,
+        # so received chunks always match the accumulator's dtype)
         for step in range(n - 1):
             _send_chunk(acc[(r - step) % n])
             recv_idx = (r - step - 1) % n
-            recv = _recv_chunk()
             tgt = acc[recv_idx]
-            if (tgt.flags.writeable
-                    and np.can_cast(recv.dtype, tgt.dtype, "same_kind")):
-                reduce_pair(tgt, recv, out=tgt)
-            else:
-                acc[recv_idx] = reduce_pair(tgt, recv)
+            reduce_pair(tgt, _recv_chunk(), out=tgt)
         # allgather: circulate the reduced chunks
         for step in range(n - 1):
             _send_chunk(acc[(r - step + 1) % n])
