@@ -170,6 +170,13 @@ def bench_serve_llm() -> None:
         on_tpu = bool(lines) and lines[-1].strip() == "tpu"
 
     if on_tpu:
+        # max_batch 16 measured BEST through the full data plane even
+        # though bare generate keeps scaling (B=16/32/64 -> 1847/2622/
+        # 3163 tok/s): at max_batch 32 / c=64 the batcher forms ragged
+        # pow-2 groups that serialize per cycle and queueing spikes
+        # (measured 1425 tok/s, +34% overhead, p99 3.0 s vs 1453,
+        # +5.5%, p99 0.72 s at 16) — batched-decode throughput only
+        # helps serving if the batcher can actually FILL the batches
         model_size, prompt_len, n_new, max_batch = "llama1b4", 128, 32, 16
         levels = (1, 8, 32)
         metric = "serve_llama1b4_tokens_per_sec"
