@@ -127,7 +127,7 @@ def bench_llama_lora() -> None:
     )
 
 
-def bench_serve_llm() -> None:
+def bench_serve_llm(continuous: bool = False) -> None:
     """BASELINE config #5 analog: a Llama replica behind serve, driven
     through the FULL data plane (HTTP proxy -> pow-2 router -> replica
     -> @serve.batch -> KV-cached generate), closed-loop clients at
@@ -143,6 +143,12 @@ def bench_serve_llm() -> None:
     level / bare generate tokens/s) / 0.85 — i.e. 1.0 means exactly
     the <=15%-overhead target for a full serving data plane; >1.0
     means the data plane costs less than that.
+
+    `continuous=True` serves the SAME workload through the
+    continuous-batching engine (`serve/llm_engine.py`, the vLLM-on-Ray
+    pattern): requests join a resident decode batch mid-flight, so the
+    denominator stays the gather-config's bare ceiling and vs_baseline
+    directly shows the scheduling win.
     """
     import concurrent.futures as cf
     import statistics
@@ -176,41 +182,69 @@ def bench_serve_llm() -> None:
         # pow-2 groups that serialize per cycle and queueing spikes
         # (measured 1425 tok/s, +34% overhead, p99 3.0 s vs 1453,
         # +5.5%, p99 0.72 s at 16) — batched-decode throughput only
-        # helps serving if the batcher can actually FILL the batches
+        # helps serving if the batcher can actually FILL the batches.
+        # The continuous engine has no such limit: slots stay full.
         model_size, prompt_len, n_new, max_batch = "llama1b4", 128, 32, 16
-        levels = (1, 8, 32)
-        metric = "serve_llama1b4_tokens_per_sec"
+        levels = (1, 8, 32, 64) if continuous else (1, 8, 32)
+        metric = ("serve_llama1b4_cb_tokens_per_sec" if continuous
+                  else "serve_llama1b4_tokens_per_sec")
     else:
         model_size, prompt_len, n_new, max_batch = "tiny", 16, 8, 8
         levels = (1, 4, 8)
-        metric = "serve_llm_tokens_per_sec_cpu"
+        metric = ("serve_llm_cb_tokens_per_sec_cpu" if continuous
+                  else "serve_llm_tokens_per_sec_cpu")
 
     import ray_tpu as rt
     from ray_tpu import serve
-    from ray_tpu.examples.serve_llm import LlamaService
+    from ray_tpu.examples.serve_llm import (
+        ContinuousLlamaService,
+        LlamaService,
+    )
 
     rt.init(num_workers=4, num_cpus=16)
     try:
-        app = LlamaService.options(
-            num_replicas=1, autoscaling_config=None,
-            max_ongoing_requests=64, health_check_timeout_s=120.0,
-        ).bind(model_size=model_size, max_new_tokens=n_new,
-               max_batch_size=max_batch,
-               jax_platform=(None if on_tpu else "cpu"))
+        if continuous:
+            app = ContinuousLlamaService.options(
+                num_replicas=1, autoscaling_config=None,
+                max_ongoing_requests=256,
+                health_check_timeout_s=120.0,
+            ).bind(model_size=model_size, max_new_tokens=n_new,
+                   slots=(32 if on_tpu else 4),
+                   chunk=(8 if on_tpu else 2),
+                   # ring sized to the workload (prompt + budget +
+                   # chunk slack), NOT the model's max_seq_len — an
+                   # oversized ring taxes every decode step
+                   max_len=prompt_len + n_new + (8 if on_tpu else 2) + 8,
+                   jax_platform=(None if on_tpu else "cpu"))
+        else:
+            app = LlamaService.options(
+                num_replicas=1, autoscaling_config=None,
+                max_ongoing_requests=64, health_check_timeout_s=120.0,
+            ).bind(model_size=model_size, max_new_tokens=n_new,
+                   max_batch_size=max_batch,
+                   jax_platform=(None if on_tpu else "cpu"))
         handle = serve.run(app, name="llm", route_prefix="/llm",
                            timeout_s=900.0)
 
-        # Bare in-replica baseline at each pow-2 bucket size: measures
-        # the no-serve ceiling AND pre-compiles every [bucket, T] shape
-        # the padded batcher can produce, so timing never sees XLA.
-        bare = {}
-        b = 1
-        while b <= max_batch:
-            bare[b] = handle.bench_direct.remote(
-                b, prompt_len, n_new, iters=(3 if on_tpu else 2)
-            ).result(timeout_s=1800.0)
-            b *= 2
-        bare_tok_s = bare[max_batch]["tokens_per_sec"]
+        # Bare in-replica baseline: the no-serve ceiling the overhead
+        # is computed against.  Gather mode also pre-compiles every
+        # [bucket, T] shape its padded batcher can produce; the
+        # continuous engine compiles its own programs on first use
+        # (warmed below), so one baseline batch size suffices there.
+        if continuous:
+            bare_tok_s = handle.bench_direct.remote(
+                max_batch, prompt_len, n_new,
+                iters=(3 if on_tpu else 2),
+            ).result(timeout_s=1800.0)["tokens_per_sec"]
+        else:
+            bare = {}
+            b = 1
+            while b <= max_batch:
+                bare[b] = handle.bench_direct.remote(
+                    b, prompt_len, n_new, iters=(3 if on_tpu else 2)
+                ).result(timeout_s=1800.0)
+                b *= 2
+            bare_tok_s = bare[max_batch]["tokens_per_sec"]
 
         host, port = serve.http_address()
         url = f"http://{host}:{port}/llm"
@@ -278,7 +312,9 @@ def main() -> None:
     import argparse
 
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", choices=["gpt2", "llama_lora", "serve_llm"],
+    p.add_argument("--config",
+                   choices=["gpt2", "llama_lora", "serve_llm",
+                            "serve_llm_cb"],
                    default="gpt2")
     args = p.parse_args()
     if args.config == "llama_lora":
@@ -286,6 +322,9 @@ def main() -> None:
         return
     if args.config == "serve_llm":
         bench_serve_llm()
+        return
+    if args.config == "serve_llm_cb":
+        bench_serve_llm(continuous=True)
         return
     bench_gpt2()
 

@@ -25,6 +25,69 @@ from ray_tpu import serve
 MODEL_SIZES = ("tiny", "llama1b4", "llama2_7b", "llama3_8b")
 
 
+def _build_model(model_size: str, seed: int):
+    """Shared (cfg, params) constructor for both deployments: one
+    place owns the size table and the bf16 serving cast."""
+    import jax
+
+    from ray_tpu.models import llama
+
+    if model_size not in MODEL_SIZES:
+        raise ValueError(f"model_size must be one of {MODEL_SIZES}")
+    cfg = {
+        "tiny": llama.LlamaConfig.tiny,
+        # the per-chip serving unit for a 16 GB v5e-1 (same 1.4B
+        # class as the llama_lora train bench); bigger models shard
+        # over a mesh, the replica stays the per-host unit
+        "llama1b4": lambda: llama.LlamaConfig(
+            vocab_size=32000, max_seq_len=1024, dim=2048, n_layers=22,
+            n_heads=16, n_kv_heads=16, intermediate=5632,
+        ),
+        "llama2_7b": llama.LlamaConfig.llama2_7b,
+        "llama3_8b": llama.LlamaConfig.llama3_8b,
+    }[model_size]()
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    if model_size != "tiny":
+        # serving decode is weight-read bound: bf16 weights halve
+        # HBM footprint and double effective decode bandwidth
+        import jax.numpy as jnp
+
+        params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    return cfg, params
+
+
+def _bench_generate(cfg, params, batch: int, prompt_len: int,
+                    max_new_tokens: int, iters: int) -> dict:
+    """Bare `llama.generate` timing in the calling process — the
+    no-serve baseline both deployments' bench_direct expose; one body
+    so the overhead metric can never desynchronize between them."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0,
+        cfg.vocab_size, dtype=jnp.int32,
+    )
+    np.asarray(llama.generate(
+        cfg, params, prompt, max_new_tokens
+    ))  # warmup: compiles prefill + decode; host read = sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.asarray(llama.generate(cfg, params, prompt, max_new_tokens))
+    dt = time.perf_counter() - t0
+    return {
+        "tokens_per_sec": batch * max_new_tokens * iters / dt,
+        "seconds_per_iter": dt / iters,
+        "batch": batch,
+    }
+
+
+
 @serve.deployment(
     max_ongoing_requests=32,
     autoscaling_config={"min_replicas": 1, "max_replicas": 2,
@@ -52,30 +115,8 @@ class LlamaService:
 
         from ray_tpu.models import llama
 
-        if model_size not in MODEL_SIZES:
-            raise ValueError(f"model_size must be one of {MODEL_SIZES}")
         self._llama = llama
-        self.cfg = {
-            "tiny": llama.LlamaConfig.tiny,
-            # the per-chip serving unit for a 16 GB v5e-1 (same 1.4B
-            # class as the llama_lora train bench); bigger models shard
-            # over a mesh, the replica stays the per-host unit
-            "llama1b4": lambda: llama.LlamaConfig(
-                vocab_size=32000, max_seq_len=1024, dim=2048, n_layers=22,
-                n_heads=16, n_kv_heads=16, intermediate=5632,
-            ),
-            "llama2_7b": llama.LlamaConfig.llama2_7b,
-            "llama3_8b": llama.LlamaConfig.llama3_8b,
-        }[model_size]()
-        self.params = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
-        if model_size != "tiny":
-            # serving decode is weight-read bound: bf16 weights halve
-            # HBM footprint and double effective decode bandwidth
-            import jax.numpy as jnp
-
-            self.params = jax.tree.map(
-                lambda p: p.astype(jnp.bfloat16), self.params
-            )
+        self.cfg, self.params = _build_model(model_size, seed)
         self.max_new_tokens = max_new_tokens
         # request clamp: each pow-2 generation-length bucket is its own
         # compiled program AND its own KV-cache footprint, so the
@@ -179,34 +220,10 @@ class LlamaService:
 
     def bench_direct(self, batch: int, prompt_len: int,
                      max_new_tokens: int, iters: int = 3) -> dict:
-        """Bare `llama.generate` timing measured IN the replica process
-        (the chip owner) — the no-serve baseline the serve data-plane
-        overhead is computed against.  Returns generated-token
-        throughput after one warmup/compile iteration."""
-        import time
-
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-
-        prompt = jax.random.randint(
-            jax.random.PRNGKey(0), (batch, prompt_len), 0,
-            self.cfg.vocab_size, dtype=jnp.int32,
-        )
-        np.asarray(self._llama.generate(
-            self.cfg, self.params, prompt, max_new_tokens
-        ))  # warmup: compiles prefill + decode step; host read = sync
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            np.asarray(self._llama.generate(
-                self.cfg, self.params, prompt, max_new_tokens
-            ))
-        dt = time.perf_counter() - t0
-        return {
-            "tokens_per_sec": batch * max_new_tokens * iters / dt,
-            "seconds_per_iter": dt / iters,
-            "batch": batch,
-        }
+        """Bare `llama.generate` baseline in the replica process (the
+        chip owner); shared body with the continuous deployment."""
+        return _bench_generate(self.cfg, self.params, batch,
+                               prompt_len, max_new_tokens, iters)
 
     async def __call__(self, request):
         body = request.json() if request.body() else {}
@@ -214,6 +231,76 @@ class LlamaService:
         n_new = int(body.get("max_new_tokens", self.max_new_tokens))
         result = await self.generate(tokens, n_new)
         return {"tokens": result}
+
+
+@serve.deployment(
+    max_ongoing_requests=256,
+)
+class ContinuousLlamaService:
+    """Continuous-batching variant (reference capability: the
+    vLLM-on-Ray serving pattern): requests join a RESIDENT decode
+    batch mid-flight via `serve.llm_engine.LlamaEngine` instead of
+    gather-batching whole generations — the decode batch stays full,
+    so weight reads amortize over every active sequence.  Measured
+    nearly 2x the gather-batched throughput at the same shapes
+    (PERF.md round 5)."""
+
+    def __init__(self, model_size: str = "tiny", max_new_tokens: int = 16,
+                 seed: int = 0, slots: int = 32, chunk: int = 8,
+                 max_len: Optional[int] = None,
+                 jax_platform: Optional[str] = None):
+        import jax
+
+        if jax_platform:
+            jax.config.update("jax_platforms", jax_platform)
+
+        from ray_tpu.serve.llm_engine import LlamaEngine
+
+        cfg, params = _build_model(model_size, seed)
+        # SIZE THE RING TO THE WORKLOAD: every decode step attends
+        # over all max_len cache slots of every slot row, so an
+        # oversized ring taxes each step (and slots x max_len x layers
+        # of HBM) regardless of occupancy — a 1024-ring at 32 slots is
+        # 5.9 GB of cache on a 1.4B model vs 1.1 GB for a 192-ring
+        self.engine = LlamaEngine(
+            cfg, params, slots=slots, chunk=chunk, max_len=max_len
+        )
+        self.max_new_tokens = max_new_tokens
+        self.max_new_tokens_limit = max_new_tokens
+
+    async def generate(self, token_lists, max_new_tokens=None):
+        import asyncio
+
+        n_new = (max_new_tokens if max_new_tokens is not None
+                 else self.max_new_tokens)
+        n_new = max(1, min(int(n_new), self.max_new_tokens_limit))
+        futs = [
+            asyncio.wrap_future(self.engine.submit(list(t), n_new))
+            for t in token_lists
+        ]
+        return list(await asyncio.gather(*futs))
+
+    async def __call__(self, request):
+        body = request.json() if request.body() else {}
+        n_new = int(body.get("max_new_tokens", self.max_new_tokens))
+        return {"tokens": await self.generate(body["tokens"], n_new)}
+
+    def engine_stats(self):
+        return self.engine.stats()
+
+    def bench_direct(self, batch: int, prompt_len: int,
+                     max_new_tokens: int, iters: int = 3) -> dict:
+        """Bare gather-generate baseline in the engine's process (the
+        engine idles between requests, so the chip is free); shared
+        body with LlamaService."""
+        return _bench_generate(self.engine.cfg, self.engine.params,
+                               batch, prompt_len, max_new_tokens, iters)
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass
 
 
 def build_app(model_size: str = "tiny", max_new_tokens: int = 16):

@@ -359,6 +359,14 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
                 cache, pos):
     """One token of autoregressive decoding against the KV cache.
 
+    SYNC CONTRACT with `decode_step_vec`: the vector-position variant
+    duplicates this body on purpose — delegating would put its
+    masked-select cache write (a full cache read+write per step) on
+    this scalar hot path, which `generate`'s fused scan rides.  Any
+    numerics change here must land in both;
+    `tests/test_llm_engine.py::test_decode_step_vec_matches_scalar_pos`
+    fails on divergence.
+
     token [B] int32, pos scalar (current sequence length) ->
     (logits [B, vocab], updated cache).  Static shapes throughout (the
     cache is max_len-sized and masked by position), so the step compiles
@@ -402,6 +410,95 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
             preferred_element_type=jnp.float32,
         ) * scale  # [B,H,1,M] f32
         s = jnp.where(valid.transpose(0, 3, 1, 2), s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhom,bmhd->bohd", w.astype(cfg.dtype), vv,
+            preferred_element_type=jnp.float32,
+        )
+        o = o.astype(cfg.dtype).reshape(B, 1, H * hd)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+
+        h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype)
+        up = _apply(h2, layer["w_up"], cfg.dtype)
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        return x1 + down, (kc, vc)
+
+    x = x.astype(cfg.dtype)
+    x, (k_cache, v_cache) = lax.scan(
+        body, x, (dict(params["blocks"]), k_cache, v_cache)
+    )
+    x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
+    logits = (x[:, 0, :] @ params["lm_head"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), (k_cache, v_cache)
+
+
+def _rope_at(x, theta: float, pos_b):
+    """Rotary embedding for ONE decode step at PER-ROW positions:
+    x [B, 1, H, hd], pos_b [B] int32 — the continuous-batching form,
+    where every batch slot sits at its own sequence length."""
+    B, T, H, hd = x.shape
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = pos_b.astype(jnp.float32)[:, None] * freqs[None, :]  # [B, half]
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
+def decode_step_vec(cfg: LlamaConfig, params: Dict, token: jax.Array,
+                    cache, pos):
+    """One decode step with PER-ROW positions (continuous batching:
+    every slot advances at its own length; reference capability: the
+    vLLM-on-Ray serving pattern's step-level scheduling).
+
+    token [B] int32, pos [B] int32 (current length per row) ->
+    (logits [B, vocab] f32, updated cache).  Same math as
+    `decode_step` restricted to equal positions; rows are independent,
+    so a slot's tokens are identical to what a dedicated `generate`
+    would produce.  Deliberately duplicates `decode_step`'s body (see
+    its SYNC CONTRACT note): the masked-select write here must not tax
+    the scalar path, and the parity test pins the two together."""
+    k_cache, v_cache = cache  # [L, B, M, KV, hd]
+    B = token.shape[0]
+    M = k_cache.shape[2]
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    group = H // KV
+
+    x = params["tok_emb"].astype(cfg.dtype)[token][:, None, :]  # [B,1,d]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    # per-row causal mask over cache slots: [B, M]
+    valid = jnp.arange(M)[None, :] <= pos[:, None]
+    # per-row write mask for the cache update.  A masked SELECT, not a
+    # batched scatter: `.at[arange(B), pos].set(...)` lowers to a
+    # general scatter that TPU executes catastrophically slowly inside
+    # the layer scan (measured ~30x the whole step's bandwidth cost);
+    # the select is one dense read+write of the cache the step already
+    # reads anyway.
+    write = (jnp.arange(M)[None, :] == pos[:, None])[:, :, None, None]
+
+    def body(x, inputs):
+        layer, kc, vc = inputs  # kc/vc [B, M, KV, hd]
+        h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
+        q = _apply(h, layer["wq"], cfg.dtype)
+        k = _apply(h, layer["wk"], cfg.dtype)
+        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _rope_at(q.reshape(B, 1, H, hd), cfg.rope_theta, pos)
+        k_new = _rope_at(k.reshape(B, 1, KV, hd), cfg.rope_theta, pos)
+        v_new = v.reshape(B, 1, KV, hd)
+        kc = jnp.where(write, k_new.astype(kc.dtype), kc)
+        vc = jnp.where(write, v_new.astype(vc.dtype), vc)
+        kk, vv = kc, vc
+        if group > 1:
+            kk = jnp.repeat(kk, group, axis=2)
+            vv = jnp.repeat(vv, group, axis=2)
+        s = jnp.einsum(
+            "bohd,bmhd->bhom", q, kk,
+            preferred_element_type=jnp.float32,
+        ) * scale  # [B,H,1,M] f32
+        s = jnp.where(valid[:, None, None, :], s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum(
             "bhom,bmhd->bohd", w.astype(cfg.dtype), vv,

@@ -1,0 +1,338 @@
+"""Continuous-batching LLM engine: step-level request scheduling.
+
+Reference capability: the vLLM-on-Ray serving pattern (what the
+reference ecosystem deploys behind Ray Serve for LLMs) — new requests
+join a RESIDENT decode batch mid-flight instead of waiting for the
+current batch to finish, so the decode batch stays full and weight
+reads amortize over every active sequence.  Gather-batching
+(`@serve.batch` + `llama.generate`) serializes prefill+decode per
+gathered group and idles slots as sequences finish; measured on v5e-1
+this engine nearly doubles served throughput at the same model/shapes
+(PERF.md round 5).
+
+TPU-native design points:
+- STATIC shapes end-to-end: a fixed slot count, a fixed max_len ring
+  of KV cache, per-row positions (`llama.decode_step_vec`), pow-2
+  prompt-length buckets for the prefill program — the whole serving
+  life runs on a handful of compiled programs.
+- CHUNKED stepping: `chunk` decode steps run inside one compiled
+  `lax.scan` per dispatch, so per-dispatch overhead (large on a
+  remote-tunnel device, nonzero everywhere) amortizes over
+  chunk x slots tokens; finish detection happens at chunk granularity
+  and surplus tokens are truncated host-side.
+- ONE host transfer per chunk (the emitted token block), never
+  per token.
+
+The engine is model-specific to the in-tree Llama (the only decoder
+family here); the scheduling core (slots/admission/chunking) is the
+reusable part.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+# per-tick phase timing to stdout (the tool that found the
+# per-admission host read and the unoverlapped chunk sync)
+_TRACE = os.environ.get("RT_LLM_ENGINE_TRACE", "") not in ("", "0")
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+class LlamaEngine:
+    """Resident continuous-batching decode engine.
+
+    submit() is thread-safe and returns a `concurrent.futures.Future`
+    resolving to the generated token ids (greedy — identical to what a
+    dedicated `llama.generate` would produce for the same prompt)."""
+
+    def __init__(self, cfg, params, *, slots: int = 32,
+                 max_len: Optional[int] = None, chunk: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        self._jax, self._jnp, self._llama = jax, jnp, llama
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_len = int(max_len or cfg.max_seq_len)
+        self.chunk = chunk
+
+        L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self._k = jnp.zeros((L, slots, self.max_len, KV, hd), cfg.dtype)
+        self._v = jnp.zeros_like(self._k)
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        self._tok = jnp.zeros((slots,), jnp.int32)
+
+        # one compiled chunk-stepper for the engine's whole life
+        def _chunk_fn(params, k, v, tok, pos):
+            def body(carry, _):
+                tok, kv, pos = carry[0], (carry[1], carry[2]), carry[3]
+                logits, (k2, v2) = llama.decode_step_vec(
+                    cfg, params, tok, kv, pos
+                )
+                nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # clamp: idle/finished slots must never walk their
+                # position past the cache ring
+                pos2 = jnp.minimum(pos + 1, self.max_len - 1)
+                return (nt, k2, v2, pos2), nt
+
+            tok_in = tok  # pre-chunk tokens: a freshly admitted
+            # slot's FIRST token (from prefill) — emitting it here
+            # means admission never needs its own device->host read
+            # (one ~100 ms round trip PER REQUEST on a remote tunnel)
+            (tok, k, v, pos), toks = jax.lax.scan(
+                body, (tok, k, v, pos), None, length=chunk
+            )
+            # [1 + chunk, slots]: row 0 = pre-chunk tokens
+            return k, v, tok, pos, jnp.concatenate(
+                [tok_in[None], toks], axis=0
+            )
+
+        self._chunk_step = jax.jit(_chunk_fn, donate_argnums=(1, 2))
+        # per prompt-length-bucket prefill (compiles per bucket)
+        self._prefill_cache: Dict[int, object] = {}
+
+        def _write_slot(k, v, k1, v1, slot, pos0, tok0, pos, tok):
+            # k1/v1 [L, 1, max_len, KV, hd] -> batch slot `slot`
+            k = jax.lax.dynamic_update_slice(
+                k, k1.astype(k.dtype), (0, slot, 0, 0, 0)
+            )
+            v = jax.lax.dynamic_update_slice(
+                v, v1.astype(v.dtype), (0, slot, 0, 0, 0)
+            )
+            pos = pos.at[slot].set(pos0)
+            tok = tok.at[slot].set(tok0)
+            return k, v, pos, tok
+
+        self._write_slot = jax.jit(_write_slot, donate_argnums=(0, 1))
+
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._free: List[int] = list(range(slots))
+        # slot -> dict(fut, out, want)
+        self._active: Dict[int, Dict] = {}
+        self._running = True
+        self._pending_toks = None  # deferred-harvest chunk (see _loop)
+        self._chunk_seq = 0  # dispatch counter: requests are tagged
+        # with the first chunk that can contain their tokens, so the
+        # deferred harvest of an OLDER chunk never credits a slot's
+        # new occupant with its previous occupant's tokens
+        self._thread = threading.Thread(
+            target=self._loop, name="llm-engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- public surface ------------------------------------------------
+    def submit(self, prompt_ids: List[int], max_new_tokens: int) -> Future:
+        limit = self.max_len - 1
+        if not prompt_ids or len(prompt_ids) >= limit:
+            f: Future = Future()
+            f.set_exception(ValueError(
+                f"prompt length must be in [1, {limit - 1}]"
+            ))
+            return f
+        n_new = max(1, min(int(max_new_tokens), limit - len(prompt_ids)))
+        fut: Future = Future()
+        with self._wake:
+            if not self._running:
+                fut.set_exception(RuntimeError("engine is shut down"))
+                return fut
+            self._queue.append((list(prompt_ids), n_new, fut))
+            self._wake.notify()
+        return fut
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "active": len(self._active),
+                "queued": len(self._queue),
+                "free_slots": len(self._free),
+            }
+
+    def shutdown(self):
+        with self._wake:
+            self._running = False
+            self._wake.notify()
+        self._thread.join(timeout=10)
+        with self._lock:
+            for req in list(self._active.values()):
+                if not req["fut"].done():
+                    req["fut"].cancel()
+            for _, _, fut in self._queue:
+                if not fut.done():
+                    fut.cancel()
+            self._active.clear()
+            self._queue.clear()
+
+    # -- engine loop ---------------------------------------------------
+    def _prefill_for(self, bucket: int):
+        fn = self._prefill_cache.get(bucket)
+        if fn is None:
+            jax, jnp, llama = self._jax, self._jnp, self._llama
+
+            def _pf(params, prompt):  # prompt [1, bucket]
+                # full-sequence logits (not llama.prefill's last-pos
+                # form): the prompt is right-padded to the bucket, so
+                # the real continuation logit lives at position T-1.
+                # Garbage KV rows written for pad positions stay masked
+                # (pos starts at T) and are overwritten as decoding
+                # advances through them.
+                logits, (ks, vs) = llama.forward(
+                    self.cfg, params, prompt, return_kv=True
+                )
+                pad = [(0, 0), (0, 0), (0, self.max_len - bucket),
+                       (0, 0), (0, 0)]
+                return logits[0], jnp.pad(ks, pad), jnp.pad(vs, pad)
+
+            fn = self._prefill_cache[bucket] = jax.jit(_pf)
+        return fn
+
+    def _admit(self, prompt: List[int], n_new: int, fut: Future):
+        jnp = self._jnp
+        slot = self._free.pop()
+        T = len(prompt)
+        # pow-2 length buckets: RIGHT-pad (the scheme depends on it —
+        # causal prefill keeps positions 0..T-1 correct, the pad tail's
+        # garbage KV is masked by the starting pos and overwritten as
+        # decoding advances)
+        bucket = min(_next_pow2(T), self.max_len - 1)
+        padded = prompt + [0] * (bucket - T)
+        logits, k1, v1 = self._prefill_for(bucket)(
+            self.params, jnp.asarray([padded], jnp.int32)
+        )
+        # first generated token comes from the LAST REAL prompt
+        # position; it STAYS on device — the next chunk emits it in its
+        # pre-chunk token row, so admission costs only async dispatches
+        tok0 = jnp.argmax(logits[T - 1], axis=-1).astype(jnp.int32)
+        self._k, self._v, self._pos, self._tok = self._write_slot(
+            self._k, self._v, k1, v1, slot, jnp.asarray(T, jnp.int32),
+            tok0, self._pos, self._tok,
+        )
+        self._active[slot] = {
+            "fut": fut, "out": [], "want": n_new,
+            "since": self._chunk_seq + 1,  # first chunk with its steps
+        }
+
+    def _harvest(self, toks_host: np.ndarray, seq: int):
+        """toks_host [1 + chunk, slots] from dispatch `seq` (row 0 =
+        pre-chunk tokens): append per active slot, finish those that
+        reached their budget.  Slots admitted after `seq` was
+        dispatched are skipped — their tokens start in a later chunk.
+        A request's FIRST chunk contributes from row 0 (its prefill
+        token rode along); later chunks from row 1."""
+        done = []
+        for slot, req in self._active.items():
+            if req["since"] > seq:
+                continue
+            start = 0 if req["since"] == seq else 1
+            need = req["want"] - len(req["out"])
+            if need > 0:
+                req["out"].extend(
+                    int(t) for t in toks_host[start:start + need, slot]
+                )
+            if len(req["out"]) >= req["want"]:
+                done.append(slot)
+        for slot in done:
+            req = self._active.pop(slot)
+            self._free.append(slot)
+            if not req["fut"].done():
+                req["fut"].set_result(req["out"][:req["want"]])
+
+    def _loop(self):
+        while True:
+            with self._wake:
+                while (self._running and not self._active
+                       and not (self._queue and self._free)):
+                    self._wake.wait()
+                if not self._running:
+                    return
+                admissions = []
+                # bound by the FREE SLOTS, not just the cap: _admit
+                # consumes a slot per entry after this loop.  The cap
+                # keeps one straggler admission from starving active
+                # slots of decode ticks, but filling MATTERS — an
+                # engine below full occupancy wastes its whole premise
+                budget = min(16, len(self._free))
+                while self._queue and len(admissions) < budget:
+                    admissions.append(self._queue.popleft())
+            try:
+                t0 = _time.perf_counter()
+                for prompt, n_new, fut in admissions:
+                    with self._lock:
+                        self._admit(prompt, n_new, fut)
+                t1 = _time.perf_counter()
+                with self._lock:
+                    have_active = bool(self._active)
+                toks = None
+                if have_active:
+                    self._k, self._v, self._tok, self._pos, toks = (
+                        self._chunk_step(
+                            self.params, self._k, self._v, self._tok,
+                            self._pos,
+                        )
+                    )
+                    self._chunk_seq += 1
+                # OVERLAP: harvest the PREVIOUS chunk's tokens while
+                # the current chunk computes — the device->host read is
+                # round-trip latency (~90 ms through a remote tunnel,
+                # ~half the synced chunk wall time), and the dispatch
+                # above is async, so the read rides under the compute.
+                # Cost: finish detection lags one chunk.
+                t2 = _time.perf_counter()
+                if self._pending_toks is not None:
+                    p_toks, p_seq = self._pending_toks
+                    toks_host = np.asarray(p_toks)
+                    with self._lock:
+                        self._harvest(toks_host, p_seq)
+                self._pending_toks = (
+                    (toks, self._chunk_seq) if toks is not None else None
+                )
+                if _TRACE:
+                    t3 = _time.perf_counter()
+                    with self._lock:
+                        na, nf = len(self._active), len(self._free)
+                    print(f"tick adm={len(admissions)} "
+                          f"admit={1e3*(t1-t0):.0f} "
+                          f"dispatch={1e3*(t2-t1):.0f} "
+                          f"read+harvest={1e3*(t3-t2):.0f}ms "
+                          f"active={na} free={nf}", flush=True)
+            except Exception as e:  # engine must not die silently
+                self._pending_toks = None
+                with self._lock:
+                    for slot, req in list(self._active.items()):
+                        if not req["fut"].done():
+                            req["fut"].set_exception(e)
+                    # admissions popped from the queue but not (yet)
+                    # registered in _active would otherwise hang their
+                    # callers forever
+                    for _p, _n, fut in admissions:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    self._active.clear()
+                    self._free = list(range(self.slots))
+                # the failed tick may have DONATED k/v without ever
+                # rebinding them — rebuild the device state or every
+                # later dispatch dies on invalid donated buffers
+                jnp = self._jnp
+                self._k = jnp.zeros(
+                    (self.cfg.n_layers, self.slots, self.max_len,
+                     self.cfg.n_kv_heads, self.cfg.head_dim),
+                    self.cfg.dtype,
+                )
+                self._v = jnp.zeros_like(self._k)
+                self._pos = jnp.zeros((self.slots,), jnp.int32)
+                self._tok = jnp.zeros((self.slots,), jnp.int32)
