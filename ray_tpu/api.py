@@ -189,6 +189,12 @@ def shutdown():
         rt = get_runtime()
         rt.shutdown()
         set_runtime(None)
+    # circuit-breaker state is per-cluster-session: replica/worker ids
+    # can recur across init cycles in one process, and a stale open
+    # breaker must not eject a fresh session's healthy peers
+    from ray_tpu.core import rpc as _rpc
+
+    _rpc.reset_breakers()
     proc = _session.pop("noded_proc", None)
     if proc is not None:
         proc.terminate()
@@ -264,6 +270,14 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def options(self, **opts) -> "RemoteFunction":
+        """Per-call overrides (reference: `.options()` on remote
+        functions).  Notable keys: `num_cpus`/`num_tpus`/`resources`,
+        `max_retries`, `retry_exceptions`, `num_returns`, scheduling
+        strategies — and `timeout_s`, an END-TO-END deadline: the call
+        (including retries and any nested `.remote()` calls it makes,
+        which inherit the remaining budget) fails with
+        `DeadlineExceededError` once the budget is spent."""
+        _validate_timeout_s(opts)
         merged = dict(self._options)
         merged.update(opts)
         return RemoteFunction(self._fn, merged)
@@ -273,6 +287,21 @@ class RemoteFunction:
             f"Remote function cannot be called directly; use "
             f"{self.__name__}.remote()"
         )
+
+
+def _validate_timeout_s(opts: Dict[str, Any]) -> None:
+    """Reject a bad deadline at `.options()` time — failing at the call
+    site beats failing inside the submit path."""
+    t = opts.get("timeout_s")
+    if t is not None:
+        try:
+            ok = float(t) > 0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise ValueError(
+                f"timeout_s must be a positive number of seconds, got {t!r}"
+            )
 
 
 class ActorMethod:
@@ -313,8 +342,12 @@ class ActorMethod:
     def options(self, num_returns: Optional[int] = None, **opts):
         """Per-call overrides (reference: actor method `.options()`);
         `max_retries` additionally opts the call's returns into lineage
-        reconstruction (same gate as max_task_retries on the actor).
-        Chained calls merge, like RemoteFunction/ActorClass options."""
+        reconstruction (same gate as max_task_retries on the actor),
+        and `timeout_s` sets an end-to-end deadline on the call (fails
+        with `DeadlineExceededError` when spent, propagated into nested
+        calls).  Chained calls merge, like RemoteFunction/ActorClass
+        options."""
+        _validate_timeout_s(opts)
         return ActorMethod(
             self._handle, self._name,
             self._num_returns if num_returns is None else num_returns,

@@ -47,11 +47,24 @@ class Config:
     object_store_eviction_watermark: float = 1.0
 
     # ---- scheduling --------------------------------------------------
-    #: delay before a failed task is retried (reference
-    #: task_retry_delay_ms, `ray_config_def.h:410`)
+    #: FLOOR of the retry backoff schedule (legacy knob; reference
+    #: task_retry_delay_ms, `ray_config_def.h:410`).  Retries now pace
+    #: with capped exponential backoff + full jitter (core/retry.py);
+    #: this keeps its historical meaning as the minimum delay.
     task_retry_delay_ms: int = 0
     #: default max retries for tasks (reference default 3)
     task_max_retries: int = 3
+    #: backoff base: retry k sleeps uniform(0, min(cap, base * 2**k))
+    task_retry_backoff_base_ms: int = 50
+    #: backoff cap: no single retry waits longer than this
+    task_retry_backoff_max_ms: int = 5000
+    #: retry-budget bucket size (tokens; one retry spends one token).
+    #: Bounds the retry BURST under correlated failures — when the
+    #: bucket drains, failures go final instead of resubmitting.
+    task_retry_budget_cap: float = 64.0
+    #: tokens refilled per successful task completion (caps steady-state
+    #: retry amplification at this fraction of the success rate)
+    task_retry_budget_refill: float = 0.5
     #: ship worker task/actor prints to the owning driver's stderr
     #: (reference: log_monitor.py tail -> driver stdout); files under
     #: the session dir remain the durable copy either way
@@ -121,6 +134,13 @@ class Config:
     #: giving up and exiting (reference: `ray_config_def.h`
     #: gcs_rpc_server_reconnect_timeout_s)
     controller_reconnect_timeout_s: float = 60.0
+    #: per-peer-address circuit breaker (core/rpc.py): consecutive
+    #: connection failures before the breaker opens and the address is
+    #: skipped by reconnect/lease/router paths
+    breaker_failure_threshold: int = 5
+    #: how long an open breaker rejects before allowing a half-open
+    #: probe toward the address
+    breaker_cooldown_s: float = 2.0
 
     # ---- rpc ---------------------------------------------------------
     #: max message size on the control plane

@@ -1,0 +1,86 @@
+"""Retry pacing primitives: jittered exponential backoff + retry budget.
+
+Reference analog: the reference paces task resubmission with a flat
+`task_retry_delay_ms` (`ray_config_def.h:410`) — under correlated
+failures (a partition, a crashing node) that shape synchronizes
+retries into storms.  The two primitives here are the standard fixes:
+
+- **Capped exponential backoff with full jitter** (the AWS
+  architecture-blog schedule): attempt k sleeps
+  `uniform(0, min(cap, base * 2**k))`, floored at the legacy
+  `task_retry_delay_ms` for back-compat.  Full jitter decorrelates
+  retries from independent callers; the cap bounds caller wait.
+- **Retry budget** (Finagle's `RetryBudget`): a token bucket refilled
+  by *successes*, drained one token per retry.  When failures are
+  correlated (everything failing at once), the bucket drains and the
+  runtime degrades to fail-fast instead of multiplying offered load by
+  `max_retries`.  Steady-state retry amplification is bounded by the
+  refill ratio; a burst is bounded by the bucket cap.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+
+def backoff_delay_s(
+    attempt: int,
+    *,
+    base_s: float,
+    cap_s: float,
+    floor_s: float = 0.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Delay before retry number `attempt` (0-based): full-jitter
+    exponential backoff, capped at `cap_s`, floored at `floor_s`."""
+    if attempt < 0:
+        attempt = 0
+    # 2**attempt can overflow to inf-ish ranges fast; clamp the exponent
+    ceiling = min(cap_s, base_s * (2 ** min(attempt, 32)))
+    r = rng.random() if rng is not None else random.random()
+    return max(floor_s, r * ceiling)
+
+
+class RetryBudget:
+    """Token-bucket retry budget: retries spend, successes refill.
+
+    `try_acquire()` takes one token (False when empty — the caller
+    should fail fast instead of retrying); `record_success()` adds
+    `refill` tokens up to `cap`.  Thread-safe: spenders are completion
+    handlers on the io thread, refillers can be any caller path.
+    """
+
+    def __init__(self, cap: float, refill: float, initial: Optional[float] = None):
+        self.cap = float(cap)
+        self.refill = float(refill)
+        self._tokens = self.cap if initial is None else float(initial)
+        self._lock = threading.Lock()
+        self._spent = 0  # lifetime retries granted (observability)
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            self._spent += 1
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.refill)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    @property
+    def retries_granted(self) -> int:
+        with self._lock:
+            return self._spent
+
+    def __repr__(self):
+        return (f"RetryBudget(tokens={self.tokens:.1f}/{self.cap:.0f}, "
+                f"granted={self.retries_granted})")

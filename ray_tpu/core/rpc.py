@@ -168,6 +168,138 @@ class ConnectionLost(RpcError):
     pass
 
 
+class CircuitBreaker:
+    """Per-peer-address circuit breaker (reference analog: gRPC
+    subchannel backoff + envoy-style outlier ejection — a peer that
+    keeps failing stops being dialed for a cooldown).
+
+    States: closed (all traffic) -> open after `failure_threshold`
+    CONSECUTIVE failures (no traffic) -> half-open once `cooldown_s`
+    elapses (probe traffic allowed; one success closes, one failure
+    re-opens with a fresh cooldown).  The half-open probe is
+    non-exclusive — any caller admitted during half-open is a probe —
+    so a probe lost to pow-2 replica sampling can never wedge the
+    breaker (an exclusive-probe design stalls when its one admitted
+    caller is abandoned).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 2.0):
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+        self._touched = 0.0  # board-eviction recency (breaker_for)
+
+    def allow(self) -> bool:
+        """True when a call toward this address may be attempted now.
+        Transitions open -> half_open when the cooldown has elapsed."""
+        import time as _time
+
+        with self._lock:
+            if self._state == self.OPEN:
+                if _time.monotonic() - self._opened_at >= self.cooldown_s:
+                    self._state = self.HALF_OPEN
+                    return True
+                return False
+            return True
+
+    def record_failure(self) -> None:
+        import time as _time
+
+        with self._lock:
+            self._failures += 1
+            if (self._state == self.HALF_OPEN
+                    or self._failures >= self.failure_threshold):
+                self._state = self.OPEN
+                self._opened_at = _time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def __repr__(self):
+        return f"CircuitBreaker({self.state}, failures={self._failures})"
+
+
+# process-wide breaker board, keyed by a peer-address string (e.g.
+# "actor:<node>:<worker>", "lease:<socket>", "serve:<app>:<dep>:<rid>")
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+# Hard bound on board size.  Live peers evict their own breakers
+# (drop_breaker on lease close / actor retirement / replica removal),
+# but a peer that dies before EVER connecting has no close event — e.g.
+# a lease socket whose worker crashed pre-accept is never re-granted,
+# so nothing would drop it.  At the cap, least-recently-touched CLOSED
+# breakers go first; open/half-open ones encode active ejection state
+# and are never evicted by pressure.
+_BREAKER_BOARD_CAP = 1024
+
+
+def _evict_stale_locked() -> None:
+    closed = sorted(
+        (a for a, b in _breakers.items()
+         if b.state == CircuitBreaker.CLOSED),
+        key=lambda a: _breakers[a]._touched,
+    )
+    for addr in closed[: max(0, len(_breakers) - _BREAKER_BOARD_CAP)]:
+        del _breakers[addr]
+
+
+def breaker_for(address: str) -> CircuitBreaker:
+    """The (lazily created) breaker guarding one peer address; tuned by
+    `breaker_failure_threshold` / `breaker_cooldown_s` in the config."""
+    import time as _time
+
+    with _breakers_lock:
+        br = _breakers.get(address)
+        if br is None:
+            try:
+                from ray_tpu.core.config import get_config
+
+                cfg = get_config()
+                threshold = cfg.breaker_failure_threshold
+                cooldown = cfg.breaker_cooldown_s
+            except Exception:
+                threshold, cooldown = 5, 2.0
+            br = _breakers[address] = CircuitBreaker(threshold, cooldown)
+            if len(_breakers) > _BREAKER_BOARD_CAP:
+                _evict_stale_locked()
+        br._touched = _time.monotonic()
+        return br
+
+
+def drop_breaker(address: str) -> None:
+    """Evict one breaker (its peer left the system: a replica removed
+    from a routing table, a retired worker socket) so the board stays
+    bounded by LIVE addresses and a later reuse of the same id can't
+    inherit stale open state."""
+    with _breakers_lock:
+        _breakers.pop(address, None)
+
+
+def reset_breakers() -> None:
+    """Forget all breaker state (tests / full-cluster restart).  Each
+    breaker is also reset IN PLACE: callers that cached the object
+    (router replica tables) observe closed state instead of routing on
+    a stale open breaker until they re-resolve from the board."""
+    with _breakers_lock:
+        for br in _breakers.values():
+            br.record_success()
+        _breakers.clear()
+
+
 class RemoteError(RpcError):
     """Handler raised; carries the remote exception."""
 

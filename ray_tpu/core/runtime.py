@@ -28,14 +28,17 @@ batched flush — this is what makes >10k tasks/s feasible in Python.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import os
+import random
 import sys
 import threading
 import time
 import traceback
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -44,6 +47,7 @@ from ray_tpu.core import rpc, serialization as ser
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.retry import RetryBudget, backoff_delay_s
 from ray_tpu.core.task_spec import (
     STREAMING,
     ActorCreationSpec,
@@ -84,6 +88,16 @@ def _creation_site() -> str:
                 _STDLIB_PREFIX):
             return f"{fn}:{f.lineno} in {f.name}"
     return ""
+
+# Ambient end-to-end deadline of the task currently executing in this
+# context: a ContextVar (not a thread-local) because async actors
+# interleave many tasks on ONE io-loop thread — each asyncio task gets
+# its own context copy, so a nested `.remote()` inherits exactly its
+# parent's budget and never a concurrent neighbor's.  Pool threads set
+# it at task start (overwrite, even to None), so reuse can't leak one.
+_ambient_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_ambient_deadline", default=None
+)
 
 _INLINE = "inline"
 _SHM = "shm"
@@ -177,6 +191,14 @@ class _PendingTask:
     # (inner_id, owner) pairs: foreign refs serialized into this task's
     # args, transit-pinned until the task's FINAL completion
     transit: List[Tuple[bytes, tuple]] = field(default_factory=list)
+    # retries already granted for this task (drives the backoff
+    # exponent and the failure message's attempt accounting)
+    attempts: int = 0
+    # owner-side deadline watchdog (asyncio TimerHandle), cancelled at
+    # FINAL completion so the loop doesn't hold a live timer for the
+    # full timeout_s of every already-finished call; survives retries
+    # (the deadline covers the whole lineage)
+    deadline_timer: Optional[object] = None
 
 
 # Process-wide per-actor sequence numbers: every caller path (handles,
@@ -201,9 +223,11 @@ def next_actor_seq(aid: bytes, group: Optional[str] = None) -> int:
 class _Lease:
     """One leased worker with pipelined pushes."""
 
-    __slots__ = ("worker_id", "conn", "in_flight", "assigned", "idle_token")
+    __slots__ = ("worker_id", "conn", "in_flight", "assigned", "idle_token",
+                 "socket_path")
 
-    def __init__(self, worker_id: str, conn: rpc.Connection):
+    def __init__(self, worker_id: str, conn: rpc.Connection,
+                 socket_path: str = ""):
         self.worker_id = worker_id
         self.conn = conn
         self.in_flight = 0
@@ -211,6 +235,9 @@ class _Lease:
         # bumped each time the lease goes idle; lets the delayed-return
         # timer detect an intervening busy period and stand down
         self.idle_token = 0
+        # breaker-board key material: the breaker for a retired socket
+        # is dropped on close so the board stays bounded by live peers
+        self.socket_path = socket_path
 
 
 class _LeasePool:
@@ -341,6 +368,17 @@ class Runtime:
         # runtime-env dedication (worker mode): hash applied, if any
         self._applied_env_hash: Optional[str] = None
         self._shutdown = False
+        # retry pacing: one budget per runtime (retries spend, successes
+        # refill — core/retry.py) and a seeded jitter rng so chaos tests
+        # replay deterministically under a fixed RT_RETRY_JITTER_SEED
+        self._retry_budget = RetryBudget(
+            cap=self.cfg.task_retry_budget_cap,
+            refill=self.cfg.task_retry_budget_refill,
+        )
+        _seed = os.environ.get("RT_RETRY_JITTER_SEED")
+        self._retry_rng = random.Random(int(_seed) if _seed else None)
+        # actor-reconnect backoff state: aid -> consecutive dial failures
+        self._actor_connect_attempts: Dict[bytes, int] = {}
         from ray_tpu.core.task_events import TaskEventBuffer
 
         self.task_events = TaskEventBuffer()
@@ -520,9 +558,26 @@ class Runtime:
             raise
         try:
             return fut.result(timeout)
-        except TimeoutError:
+        except (TimeoutError, _FutureTimeoutError) as e:
+            # both spellings: before 3.11 concurrent.futures.TimeoutError
+            # is NOT the builtin TimeoutError.  When the CORO itself
+            # raised a timeout-flavored error (DeadlineExceeded on a ref,
+            # user TimeoutError), surface it untouched; only an expired
+            # WAIT becomes GetTimeoutError.  `fut.done()` alone can't
+            # distinguish the two — the coro may complete in the window
+            # between the wait expiring and this handler running — so
+            # check whether `e` is actually the future's outcome.
+            if fut.done():
+                coro_err = fut.exception()
+                if coro_err is e:
+                    raise
+                if coro_err is not None:
+                    raise coro_err
+                return fut.result()  # completed during the race window
             fut.cancel()
-            raise exc.GetTimeoutError(f"timed out after {timeout}s")
+            raise exc.GetTimeoutError(
+                f"timed out after {timeout}s", timeout_s=timeout
+            )
 
     # ------------------------------------------------------------------
     # cancellation (reference: CoreWorker::CancelTask + the executor's
@@ -771,7 +826,18 @@ class Runtime:
                 for b in primed:  # drop unconsumed entries (cancel/error)
                     self._primed_replies.pop(b, None)
 
-        vals.extend(self._run(_get_all(), timeout=timeout))
+        try:
+            vals.extend(self._run(_get_all(), timeout=timeout))
+        except exc.GetTimeoutError as e:
+            if e.object_id is None:
+                # attach the first still-pending ref: the one the
+                # caller was actually stuck on
+                for r in rest:
+                    st = self.objects.get(r.binary())
+                    if st is None or not st.ready.is_set():
+                        e.object_id = r.id
+                        break
+            raise
         return vals[0] if single else vals
 
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
@@ -818,6 +884,7 @@ class Runtime:
             name=options.get("name", getattr(fn, "__name__", "task")),
             runtime_env=renv,
             env_hash=env_hash,
+            deadline_s=self._effective_deadline(options),
         )
         from ray_tpu.util import tracing as _tracing
 
@@ -842,10 +909,82 @@ class Runtime:
                     if rc:
                         rc.submitted += 1
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
+        if spec.deadline_s is not None:
+            self._arm_deadline(spec)
         self._push_or_queue(spec)
         if num_returns == STREAMING:
             return ObjectRefGenerator(spec.task_id.binary(), self)
         return refs
+
+    # ------------------------------------------------------------------
+    # end-to-end deadlines (`.options(timeout_s=...)`)
+    # ------------------------------------------------------------------
+    def _effective_deadline(self, options) -> Optional[float]:
+        """Absolute monotonic deadline for a new submission: the
+        caller's explicit timeout_s combined (min) with the AMBIENT
+        deadline of the task currently executing in this thread — so
+        nested `.remote()` calls inherit the shrinking budget of their
+        parent (gRPC-style deadline propagation)."""
+        deadline = None
+        timeout_s = options.get("timeout_s")
+        if timeout_s is not None:
+            timeout_s = float(timeout_s)
+            if timeout_s <= 0:
+                raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+            deadline = time.monotonic() + timeout_s
+        ambient = _ambient_deadline.get()
+        if ambient is not None:
+            deadline = ambient if deadline is None else min(deadline, ambient)
+        return deadline
+
+    def _arm_deadline(self, spec: TaskSpec):
+        """Owner-side watchdog: when the deadline passes with the task
+        still pending, fail it with DeadlineExceededError — the caller
+        gets an answer even when the executor side is partitioned away
+        and no failure result will ever arrive."""
+        tid = spec.task_id.binary()
+        deadline = spec.deadline_s
+
+        def _arm():
+            handle = self.loop.call_later(
+                max(0.0, deadline - time.monotonic()),
+                self._deadline_fire, tid,
+            )
+            with self._state_lock:
+                pt = self.pending_tasks.get(tid)
+            if pt is None:
+                handle.cancel()  # completed before the watchdog armed
+            else:
+                pt.deadline_timer = handle
+
+        try:
+            self.loop.call_soon_threadsafe(_arm)
+        except RuntimeError:
+            pass  # loop closed (teardown race)
+
+    def _deadline_fire(self, tid: bytes):
+        with self._state_lock:
+            pt = self.pending_tasks.get(tid)
+            if pt is None:
+                return  # completed in time
+            dl = pt.spec.deadline_s
+            if dl is None or time.monotonic() < dl:
+                return
+            pt.retries_left = 0  # an expired task never retries
+            attempts = pt.attempts
+            spec = pt.spec
+        err = exc.DeadlineExceededError(
+            f"task {spec.name!r} exceeded its deadline "
+            f"(timeout_s elapsed; {attempts} retries were attempted); "
+            f"the caller gave up, so the task will not be resubmitted",
+        )
+        envelope = ser.serialize_to_bytes(err, tag=ser.TAG_ERROR)
+        self._complete_task(TaskResult(
+            task_id=spec.task_id, status="error", error=envelope,
+        ))
+        # best-effort: tell whoever holds the work to stop running it
+        task = asyncio.ensure_future(self._cancel_remote(tid, spec, False))
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
 
     def _export_function(self, fn) -> Tuple[bytes, Optional[bytes]]:
         # keyed by id(fn) with the FUNCTION PINNED in the entry AND an
@@ -1191,14 +1330,25 @@ class Runtime:
                         self.noded.send("submit_task", s)
                     return
                 worker_id, socket_path = reply
+                breaker = rpc.breaker_for(f"lease:{socket_path}")
+                if not breaker.allow():
+                    # a worker whose socket keeps failing: hand the
+                    # lease back and let the daemon grant another
+                    # (paced so a re-grant of the same worker can't
+                    # spin this loop hot during the cooldown)
+                    self.noded.send("return_lease", {"worker_id": worker_id})
+                    await asyncio.sleep(0.05)
+                    continue
                 try:
                     conn = await rpc.connect_unix(
                         socket_path, handler=self._handle, name=f"lease-{worker_id[:8]}"
                     )
                 except Exception:
+                    breaker.record_failure()
                     self.noded.send("return_lease", {"worker_id": worker_id})
                     continue
-                lease = _Lease(worker_id, conn)
+                breaker.record_success()
+                lease = _Lease(worker_id, conn, socket_path=socket_path)
                 with self._state_lock:
                     pool.leases[worker_id] = lease
                     self._conn_lease[conn] = (pool, lease)
@@ -1233,6 +1383,11 @@ class Runtime:
             pool, lease = entry
             pool.leases.pop(lease.worker_id, None)
             specs = list(lease.assigned.values())
+        if lease.socket_path:
+            # the worker is gone and its socket path won't be re-granted
+            # (a replacement worker gets a fresh one): evict its breaker
+            # so the board stays bounded under worker churn
+            rpc.drop_breaker(f"lease:{lease.socket_path}")
         for spec in specs:
             self._complete_task(
                 TaskResult(task_id=spec.task_id, status="worker_died")
@@ -1412,6 +1567,7 @@ class Runtime:
             name=f"{handle._class_name}.{method_name}",
             actor_id=handle._actor_id,
             seq_no=handle._next_seq(group),
+            deadline_s=self._effective_deadline(options),
         )
         from ray_tpu.util import tracing as _tracing
 
@@ -1445,6 +1601,8 @@ class Runtime:
             if handle._address is not None:
                 self._actor_addr.setdefault(aid, tuple(handle._address))
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
+        if spec.deadline_s is not None:
+            self._arm_deadline(spec)
         self._push_actor_task(aid, spec)
         if num_returns == STREAMING:
             return ObjectRefGenerator(spec.task_id.binary(), self)
@@ -1488,8 +1646,20 @@ class Runtime:
                 if info is None or info["state"] != "ALIVE":
                     self._fail_actor_queue(aid, info)
                     return
+            old_addr = addr
             addr = tuple(info["address"])
             self._actor_addr[aid] = addr
+            if old_addr is not None and tuple(old_addr) != addr:
+                # restarted actor landed on a new worker: the retired
+                # address never comes back, so evict its breaker
+                rpc.drop_breaker(f"actor:{old_addr[0]}:{old_addr[1]}")
+            breaker = rpc.breaker_for(f"actor:{addr[0]}:{addr[1]}")
+            if not breaker.allow():
+                # breaker open: don't even dial — the backoff path below
+                # retries after the half-open cooldown
+                raise rpc.ConnectionLost(
+                    f"circuit breaker open for actor address {addr}"
+                )
             sock = await self.noded.call(
                 "resolve_worker_socket",
                 {"node_id": addr[0], "worker_id": addr[1]},
@@ -1498,9 +1668,16 @@ class Runtime:
                 # remote node without reachable socket: relay via noded
                 self._drain_actor_queue_via_noded(aid, addr)
                 return
-            conn = await rpc.connect_unix(
-                sock, handler=self._handle, name=f"actor-{aid.hex()[:8]}"
-            )
+            try:
+                conn = await rpc.connect_unix(
+                    sock, handler=self._handle, name=f"actor-{aid.hex()[:8]}"
+                )
+            except Exception:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
+            with self._state_lock:
+                self._actor_connect_attempts.pop(aid, None)
             conn.on_close = lambda c: self._on_actor_conn_closed(aid, c)
             with self._state_lock:
                 self._actor_conns[aid] = conn
@@ -1515,8 +1692,19 @@ class Runtime:
                 conn.send_threadsafe("execute_task", s)
         except Exception:
             # stale address or races with restart: retry while callers
-            # still have queued work
-            await asyncio.sleep(0.2)
+            # still have queued work — through the capped jittered
+            # backoff schedule, NOT a fixed-delay redial loop (a dead
+            # address would otherwise be hammered at 5 Hz forever)
+            with self._state_lock:
+                attempts = self._actor_connect_attempts.get(aid, 0)
+                self._actor_connect_attempts[aid] = attempts + 1
+            await asyncio.sleep(backoff_delay_s(
+                attempts,
+                base_s=self.cfg.task_retry_backoff_base_ms / 1000.0,
+                cap_s=self.cfg.task_retry_backoff_max_ms / 1000.0,
+                floor_s=0.2,  # the historical fixed redial delay
+                rng=self._retry_rng,
+            ))
             with self._state_lock:
                 retry = bool(self._actor_queue.get(aid))
             if retry and not self._shutdown:
@@ -1548,6 +1736,10 @@ class Runtime:
         with self._state_lock:
             q = self._actor_queue.pop(aid, None)
             specs = list(q) if q else []
+            dead_addr = self._actor_addr.pop(aid, None)
+        if dead_addr is not None:
+            # terminal death: the address is retired with the actor
+            rpc.drop_breaker(f"actor:{dead_addr[0]}:{dead_addr[1]}")
         for s in specs:
             self._complete_task(
                 TaskResult(task_id=s.task_id, status="error", error=envelope)
@@ -1592,6 +1784,12 @@ class Runtime:
             if pt is None:
                 return acks
             if result.status == "ok":
+                # successes refill the retry budget (core/retry.py):
+                # steady progress re-earns the right to retry
+                self._retry_budget.record_success()
+                if pt.deadline_timer is not None:
+                    # Handle.cancel() only sets a flag — safe off-loop
+                    pt.deadline_timer.cancel()
                 self.task_events.record(
                     result.task_id.binary(), pt.spec.name, "FINISHED",
                     duration=(result.execution_info or {}).get("duration"),
@@ -1643,22 +1841,66 @@ class Runtime:
             )
             if pt.spec.actor_id is not None and result.status == "worker_died":
                 retriable = pt.spec.max_retries > 0
+            resubmit = False
+            retry_delay = 0.0
+            override_err: Optional[BaseException] = None
             if retriable and pt.retries_left > 0:
-                pt.retries_left -= 1
-                self.pending_tasks[result.task_id.binary()] = pt
-                logger.info(
-                    "retrying task %s (%d retries left)",
-                    pt.spec.task_id.hex(),
-                    pt.retries_left,
+                now = time.monotonic()
+                deadline = pt.spec.deadline_s
+                # capped exponential backoff with full jitter; the
+                # legacy task_retry_delay_ms is the floor (core/retry.py)
+                retry_delay = backoff_delay_s(
+                    pt.attempts,
+                    base_s=self.cfg.task_retry_backoff_base_ms / 1000.0,
+                    cap_s=self.cfg.task_retry_backoff_max_ms / 1000.0,
+                    floor_s=self.cfg.task_retry_delay_ms / 1000.0,
+                    rng=self._retry_rng,
                 )
-                resubmit = True
-            else:
-                resubmit = False
+                if deadline is not None and now + retry_delay >= deadline:
+                    # the caller's budget would expire during the
+                    # backoff: fail fast instead of re-queueing work
+                    # nobody is waiting for
+                    override_err = exc.DeadlineExceededError(
+                        f"task {pt.spec.name!r} failed "
+                        f"({result.status}) and its deadline leaves no "
+                        f"room to retry ({pt.attempts} retries were "
+                        f"attempted); failing fast"
+                    )
+                elif not self._retry_budget.try_acquire():
+                    # correlated-failure regime: the budget is drained,
+                    # so degrade to fail-fast instead of amplifying load
+                    override_err = exc.TaskError(
+                        f"task {pt.spec.name!r} failed "
+                        f"({result.status}) and the runtime retry "
+                        f"budget is exhausted after "
+                        f"{pt.attempts + 1} attempts "
+                        f"({pt.attempts} retries granted); failing "
+                        f"fast instead of amplifying load",
+                        cause_type="RetryBudgetExhausted",
+                    )
+                else:
+                    pt.retries_left -= 1
+                    pt.attempts += 1
+                    self.pending_tasks[result.task_id.binary()] = pt
+                    logger.info(
+                        "retrying task %s in %.0f ms (%d retries left)",
+                        pt.spec.task_id.hex(),
+                        retry_delay * 1000.0,
+                        pt.retries_left,
+                    )
+                    resubmit = True
+            if not resubmit:
+                if pt.deadline_timer is not None:
+                    pt.deadline_timer.cancel()
                 self.task_events.record(
                     result.task_id.binary(), pt.spec.name, "FAILED",
                     error=result.status,
                 )
-                if result.error is not None:
+                if override_err is not None:
+                    envelope = ser.serialize_to_bytes(
+                        override_err, tag=ser.TAG_ERROR
+                    )
+                elif result.error is not None:
                     envelope = result.error
                 elif pt.spec.actor_id is not None:
                     envelope = ser.serialize_to_bytes(
@@ -1692,7 +1934,6 @@ class Runtime:
                     self._stream_reg_acks.pop(result.task_id.binary(), ())
                 )
         if resubmit:
-            delay = self.cfg.task_retry_delay_ms / 1000.0
             spec = pt.spec
 
             def _resend():
@@ -1701,8 +1942,15 @@ class Runtime:
                 else:
                     self._push_or_queue(spec)
 
-            if delay > 0:
-                self.loop.call_later(delay, _resend)
+            if retry_delay > 0:
+                # _complete_task runs on io AND submitter threads;
+                # call_later is only loop-thread-safe, so hop in
+                try:
+                    self.loop.call_soon_threadsafe(
+                        self.loop.call_later, retry_delay, _resend
+                    )
+                except RuntimeError:
+                    pass  # loop closed mid-teardown
             else:
                 _resend()
         return acks
@@ -2459,12 +2707,16 @@ class Runtime:
     async def stream_wait_done(self, tid: bytes):
         """Await completion of a streaming task (ok or error); used by
         watchers (e.g. serve's router queue-len tracking) that must not
-        race the consumer."""
+        race the consumer.  Returns the stream's terminal error envelope
+        (None on clean completion) — read off the held stream object, so
+        a consumer popping the stream can't hide the error from the
+        watcher (the router's breaker classification depends on it)."""
         with self._state_lock:
             stream = self._streams.get(tid)
         if stream is None:
-            return
+            return None
         await stream.done.wait()
+        return stream.error
 
     async def _stream_next_async(self, tid: bytes):
         while True:
@@ -3018,6 +3270,22 @@ class Runtime:
                 "owner": spec.owner,
             })
             return
+        if spec.deadline_expired():
+            # the caller's budget is spent (the wire re-anchored the
+            # remaining budget to this clock): reply the typed error
+            # without running work nobody is waiting for
+            envelope = ser.serialize_to_bytes(
+                exc.DeadlineExceededError(
+                    f"task {spec.name!r} deadline expired before execution"
+                ),
+                tag=ser.TAG_ERROR,
+            )
+            conn.send("task_result", {
+                "result": TaskResult(task_id=spec.task_id, status="error",
+                                     error=envelope),
+                "owner": spec.owner,
+            })
+            return
         started = getattr(self, "_started_tasks", None)
         if started is None:
             started = self._started_tasks = set()
@@ -3051,6 +3319,9 @@ class Runtime:
             }
             loop = asyncio.get_running_loop()
             self._task_local.task_id = spec.task_id
+            # ambient deadline: nested .remote() calls made by the user
+            # code inherit the parent's remaining budget
+            _ambient_deadline.set(spec.deadline_s)
 
             from ray_tpu.util import tracing as _tracing
 
@@ -3090,6 +3361,7 @@ class Runtime:
                         from ray_tpu.core.log_stream import log_ctx_var
 
                         self._task_local.task_id = spec.task_id
+                        _ambient_deadline.set(spec.deadline_s)
                         _log_tok = log_ctx_var.set((spec.owner, spec.name))
                         try:
                             with _tracing.execution_span(spec.name, trace_ctx):
@@ -3118,6 +3390,7 @@ class Runtime:
                     from ray_tpu.core.log_stream import log_ctx_var
 
                     self._task_local.task_id = spec.task_id
+                    _ambient_deadline.set(spec.deadline_s)
                     _log_tok = log_ctx_var.set((spec.owner, spec.name))
                     # registered for mid-execution cancellation
                     # (_h_cancel_task async-raises into this thread);

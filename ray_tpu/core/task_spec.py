@@ -159,6 +159,31 @@ class TaskSpec:
     # env_hash precomputed at submit so daemons never re-hash
     runtime_env: Optional[Dict[str, Any]] = None
     env_hash: Optional[str] = None
+    # end-to-end deadline (`.options(timeout_s=...)`), as an ABSOLUTE
+    # local `time.monotonic()` instant.  Monotonic clocks don't travel:
+    # the wire carries `deadline_remaining_s` (budget left at encode
+    # time) and the decoder re-anchors to its own clock, so every relay
+    # hop shrinks the budget by its own transit time — gRPC-style
+    # deadline propagation.
+    deadline_s: Optional[float] = None
+
+    @property
+    def deadline_remaining_s(self) -> Optional[float]:
+        """Budget remaining right now (wire representation of the
+        deadline; recomputed at every encode, so retries/relays carry
+        the honestly-shrunk budget)."""
+        if self.deadline_s is None:
+            return None
+        import time
+
+        return self.deadline_s - time.monotonic()
+
+    def deadline_expired(self) -> bool:
+        if self.deadline_s is None:
+            return False
+        import time
+
+        return time.monotonic() >= self.deadline_s
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == STREAMING:
@@ -168,6 +193,18 @@ class TaskSpec:
     @property
     def is_streaming(self) -> bool:
         return self.num_returns == STREAMING
+
+
+def task_spec_from_wire(**fields) -> "TaskSpec":
+    """Wire-decode constructor: converts the on-wire remaining budget
+    back into an absolute deadline on THIS process's monotonic clock."""
+    remaining = fields.pop("deadline_remaining_s", None)
+    spec = TaskSpec(**fields)
+    if remaining is not None:
+        import time
+
+        spec.deadline_s = time.monotonic() + remaining
+    return spec
 
 
 @dataclass

@@ -320,12 +320,15 @@ def register_core_schemas():
                       ["kind", "node_id", "soft", "pg_id",
                        "pg_bundle_index", "pg_capture_child_tasks",
                        "label_hard", "label_soft", "label_routed"])
+    # `deadline_remaining_s` is a computed property (budget left at
+    # encode time); the construct hook re-anchors it to the decoder's
+    # monotonic clock (gRPC-style deadline propagation)
     registry.register(_ts.TaskSpec, [
         "task_id", "function_id", "function_blob", "args", "kwargs",
         "num_returns", "owner", "resources", "max_retries",
         "retry_exceptions", "strategy", "name", "actor_id", "seq_no",
-        "trace_ctx", "runtime_env", "env_hash",
-    ])
+        "trace_ctx", "runtime_env", "env_hash", "deadline_remaining_s",
+    ], construct=_ts.task_spec_from_wire)
     registry.register(_ts.ActorCreationSpec, [
         "actor_id", "class_id", "class_blob", "init_args", "init_kwargs",
         "owner", "resources", "max_restarts", "max_task_retries",

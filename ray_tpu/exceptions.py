@@ -71,7 +71,25 @@ class TaskCancelledError(RayTpuError):
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
-    """`get(timeout=...)` expired."""
+    """`get(timeout=...)` expired.
+
+    Carries the timeout that expired and (when known) the object id the
+    caller was waiting on, so handlers can log/retry the specific ref
+    instead of a bare "timed out" string.
+    """
+
+    def __init__(self, message: str = "", timeout_s=None, object_id=None):
+        super().__init__(message)
+        self.timeout_s = timeout_s
+        self.object_id = object_id
+
+
+class DeadlineExceededError(GetTimeoutError):
+    """An end-to-end task deadline (`.options(timeout_s=...)`) expired:
+    the caller has given up, so the runtime fails fast instead of
+    re-queueing/retrying work nobody is waiting for (reference analog:
+    gRPC deadline propagation).  Subclasses GetTimeoutError so existing
+    `except GetTimeoutError` call sites keep working."""
 
 
 class NodeDiedError(RayTpuError):

@@ -18,6 +18,7 @@ io loop from inside it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu as rt
@@ -163,11 +164,18 @@ class DeploymentResponse:
     """Future-like result of a handle call (reference:
     `serve/handle.py` DeploymentResponse)."""
 
-    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict):
+    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict,
+                 timeout_s: Optional[float] = None):
         self._router = router
         self._method = method
         self._args = args
         self._kwargs = kwargs
+        # handle-level timeout_s, anchored at CALL time so the budget
+        # covers assignment queueing too; propagated into the replica
+        # task's end-to-end deadline by the router
+        self._deadline_s = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
         self._lock = threading.Lock()
         self._ref = None
         # Eager submission off the runtime's io loop (drivers, sync
@@ -194,7 +202,8 @@ class DeploymentResponse:
                     for k, v in self._kwargs.items()
                 }
                 self._ref = self._router.assign_request(
-                    self._method, args, kwargs
+                    self._method, args, kwargs,
+                    deadline_s=self._deadline_s,
                 )
         return self._ref
 
@@ -213,7 +222,8 @@ class DeploymentResponse:
                     await _await_ready(v)
                 kwargs[k] = v
             ref = await self._router.assign_request_async(
-                self._method, tuple(args), kwargs
+                self._method, tuple(args), kwargs,
+                deadline_s=self._deadline_s,
             )
             with self._lock:
                 if self._ref is None:
@@ -224,8 +234,14 @@ class DeploymentResponse:
     def result(self, timeout_s: Optional[float] = None) -> Any:
         """Blocking resolution; must not be called from inside an async
         replica method — `await` the response there instead (same rule
-        as the reference's handle API)."""
+        as the reference's handle API).  Without an explicit timeout,
+        a handle-level `options(timeout_s=...)` budget bounds the wait."""
         ref = self._ensure_submitted()
+        if timeout_s is None and self._deadline_s is not None:
+            # slack past the deadline so the owner-side watchdog's
+            # typed DeadlineExceededError lands on the ref before this
+            # get's generic wait-timeout fires
+            timeout_s = max(0.001, self._deadline_s - time.monotonic()) + 0.25
         return rt.get(ref, timeout=timeout_s)
 
     def __await__(self):
@@ -259,11 +275,17 @@ class DeploymentResponseGenerator:
     DeploymentResponseGenerator): iterating yields the values the
     replica's generator produces, incrementally."""
 
-    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict):
+    def __init__(self, router: Router, method: str, args: tuple, kwargs: dict,
+                 timeout_s: Optional[float] = None):
         self._router = router
         self._method = method
         self._args = args
         self._kwargs = kwargs
+        # same anchoring as DeploymentResponse: the handle-level budget
+        # covers assignment AND the replica generator's execution
+        self._deadline_s = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
         self._gen = None  # ObjectRefGenerator once submitted
         self._lock = threading.Lock()
         if not _on_runtime_loop():
@@ -282,7 +304,8 @@ class DeploymentResponseGenerator:
                     for k, v in self._kwargs.items()
                 }
                 self._gen = self._router.assign_request(
-                    self._method, args, kwargs, streaming=True
+                    self._method, args, kwargs, streaming=True,
+                    deadline_s=self._deadline_s,
                 )
         return self._gen
 
@@ -301,7 +324,8 @@ class DeploymentResponseGenerator:
                     await _await_ready(v)
                 kwargs[k] = v
             gen = await self._router.assign_request_async(
-                self._method, tuple(args), kwargs, streaming=True
+                self._method, tuple(args), kwargs, streaming=True,
+                deadline_s=self._deadline_s,
             )
             with self._lock:
                 if self._gen is None:
@@ -337,11 +361,13 @@ class _HandleMethod:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 _model_id: str = "", _stream: bool = False):
+                 _model_id: str = "", _stream: bool = False,
+                 _timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._model_id = _model_id
         self._stream = _stream
+        self._timeout_s = _timeout_s
 
     def _call(self, method: str, args: tuple, kwargs: dict):
         if self._model_id:
@@ -350,8 +376,10 @@ class DeploymentHandle:
             kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         router = _router_for(self.app_name, self.deployment_name)
         if self._stream:
-            return DeploymentResponseGenerator(router, method, args, kwargs)
-        return DeploymentResponse(router, method, args, kwargs)
+            return DeploymentResponseGenerator(router, method, args, kwargs,
+                                               timeout_s=self._timeout_s)
+        return DeploymentResponse(router, method, args, kwargs,
+                                  timeout_s=self._timeout_s)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._call("__call__", args, kwargs)
@@ -362,8 +390,19 @@ class DeploymentHandle:
         return _HandleMethod(self, name)
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None, **_opts) -> "DeploymentHandle":
-        if multiplexed_model_id is None and stream is None:
+                stream: Optional[bool] = None,
+                timeout_s: Optional[float] = None,
+                **_opts) -> "DeploymentHandle":
+        """`timeout_s` sets an end-to-end budget per call made through
+        the returned handle: replica assignment, execution (propagated
+        into the task's deadline, inherited by nested calls), and
+        `.result()` all charge against it; when spent, the call fails
+        with `DeadlineExceededError`."""
+        from ray_tpu.api import _validate_timeout_s
+
+        _validate_timeout_s({"timeout_s": timeout_s})
+        if multiplexed_model_id is None and stream is None \
+                and timeout_s is None:
             return self
         return DeploymentHandle(
             self.deployment_name, self.app_name,
@@ -371,13 +410,15 @@ class DeploymentHandle:
                        if multiplexed_model_id is not None
                        else self._model_id),
             _stream=self._stream if stream is None else stream,
+            _timeout_s=(self._timeout_s if timeout_s is None
+                        else timeout_s),
         )
 
     def __reduce__(self):
         return (
             DeploymentHandle,
             (self.deployment_name, self.app_name, self._model_id,
-             self._stream),
+             self._stream, self._timeout_s),
         )
 
     def __repr__(self):

@@ -20,19 +20,26 @@ import asyncio
 import random
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import ray_tpu as rt
+from ray_tpu import exceptions as _exc
+from ray_tpu.core import rpc as _rpc
 
 
 class _ReplicaInfo:
-    __slots__ = ("replica_id", "handle", "max_ongoing", "local_inflight")
+    __slots__ = ("replica_id", "handle", "max_ongoing", "local_inflight",
+                 "breaker")
 
-    def __init__(self, replica_id: str, handle, max_ongoing: int):
+    def __init__(self, replica_id: str, handle, max_ongoing: int,
+                 breaker=None):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
         self.local_inflight = 0
+        # resolved once at table install: _try_pick runs per request
+        # and must not take the process-wide breaker-board lock
+        self.breaker = breaker
 
 
 class Router:
@@ -101,11 +108,27 @@ class Router:
                 for rid, (handle, max_ongoing) in table["replicas"].items():
                     info = self._replicas.get(rid)
                     if info is None:
-                        info = _ReplicaInfo(rid, handle, max_ongoing)
+                        info = _ReplicaInfo(
+                            rid, handle, max_ongoing,
+                            breaker=_rpc.breaker_for(self._breaker_key(rid)),
+                        )
                     else:
                         info.handle = handle
                         info.max_ongoing = max_ongoing
+                        # re-resolve from the board: reset_breakers()
+                        # (rt.shutdown) replaces board entries, and a
+                        # router surviving the cycle must not keep
+                        # routing on an orphaned stale breaker
+                        info.breaker = _rpc.breaker_for(
+                            self._breaker_key(rid)
+                        )
                     new[rid] = info
+                # replicas that left the table take their breakers with
+                # them: keeps the board bounded by live addresses and a
+                # redeploy reusing the id starts with a clean breaker
+                for rid in self._replicas:
+                    if rid not in new:
+                        _rpc.drop_breaker(self._breaker_key(rid))
                 self._replicas = new
                 self._version = table["version"]
             self._last_refresh = time.monotonic()
@@ -201,22 +224,42 @@ class Router:
         self._install_table(table)
 
     # -- replica choice ----------------------------------------------
+    def _breaker_key(self, replica_id: str) -> str:
+        """Per-replica circuit-breaker address (core/rpc.py breaker
+        board): replicas behind an open breaker are skipped by routing
+        until the half-open cooldown admits a probe."""
+        return f"serve:{self._app}:{self._deployment}:{replica_id}"
+
     def _try_pick(self, affinity_key: str = ""):
         with self._lock:
-            cands = list(self._replicas.values())
+            # an open breaker ejects the replica from the candidate set;
+            # in half-open, allow() admits probe traffic (non-exclusive,
+            # so a probe lost to pow-2 sampling can't wedge the breaker)
+            cands = [
+                r for r in self._replicas.values()
+                if r.breaker is None or r.breaker.allow()
+            ]
             if not cands:
                 return None
             if affinity_key:
                 # model multiplexing: consistent choice per model id so
                 # each model stays resident on one replica instead of
                 # thrashing every LRU (reference: the pow-2 scheduler's
-                # multiplex-aware candidate ranking)
-                cands.sort(key=lambda r: r.replica_id)
+                # multiplex-aware candidate ranking).  Hash over the
+                # FULL table, not the breaker-filtered candidates: a
+                # breaker opening on one replica must divert only the
+                # models resident THERE, not remap (and re-load) every
+                # model in the deployment on each open/half-open flap.
                 import zlib
 
-                pick = cands[zlib.adler32(affinity_key.encode()) % len(cands)]
-                if pick.local_inflight >= pick.max_ongoing:
-                    pick = None  # saturated: fall through to pow-2
+                table = sorted(self._replicas.values(),
+                               key=lambda r: r.replica_id)
+                pick = table[zlib.adler32(affinity_key.encode())
+                             % len(table)]
+                if pick not in cands or \
+                        pick.local_inflight >= pick.max_ongoing:
+                    # broken or saturated: fall through to pow-2
+                    pick = None
                 if pick is not None:
                     pick.local_inflight += 1
                     return pick
@@ -231,20 +274,41 @@ class Router:
             return None
 
     def _submit(self, info: _ReplicaInfo, method_name, args, kwargs,
-                streaming: bool = False):
+                streaming: bool = False,
+                deadline_s: Optional[float] = None):
         # args flattened to top-level task args so ObjectRefs among them
         # (composed responses) are materialized by the runtime before
         # the replica method runs
-        if streaming:
-            out = info.handle.handle_request_streaming.remote(
-                method_name, *args, **kwargs
-            )
-        else:
-            out = info.handle.handle_request.remote(method_name, *args, **kwargs)
+        target = (info.handle.handle_request_streaming if streaming
+                  else info.handle.handle_request)
+        if deadline_s is not None:
+            # handle-level timeout_s becomes the task's end-to-end
+            # deadline: the replica call (and anything it fans out to)
+            # fails with DeadlineExceededError once the budget is spent
+            remaining = deadline_s - time.monotonic()
+            if remaining <= 0:
+                with self._lock:  # release the slot _try_pick reserved
+                    info.local_inflight = max(0, info.local_inflight - 1)
+                raise _exc.DeadlineExceededError(
+                    f"request to {self._deployment} expired before "
+                    f"submission", timeout_s=0.0,
+                )
+            target = target.options(timeout_s=remaining)
+        out = target.remote(method_name, *args, **kwargs)
 
         t0 = time.monotonic()
 
-        def _done():
+        def _done(outcome: str):
+            breaker = info.breaker
+            if breaker is not None:
+                if outcome == "failure":
+                    breaker.record_failure()
+                elif outcome == "success":
+                    breaker.record_success()
+                # "neutral" (deadline expiry): a request that burned its
+                # budget proves nothing about reachability either way —
+                # recording success here would reset the consecutive
+                # count and let a black-holed replica dodge ejection
             now = time.monotonic()
             with self._lock:
                 info.local_inflight = max(0, info.local_inflight - 1)
@@ -269,67 +333,111 @@ class Router:
         # queue-len tracker on reply) — watch completion on the io loop
         import asyncio
 
-        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.core.runtime import _error_from_envelope, get_runtime
 
         rt_ = get_runtime()
 
+        def _classify(envelope) -> str:
+            """Breaker outcome of an error envelope.  Replica-unreachable
+            classes are failures; a deadline expiry is neutral (proves
+            nothing about reachability); user exceptions (TaskError) are
+            successes — a deployment that raises on bad input is
+            healthy."""
+            try:
+                err = _error_from_envelope(envelope)
+            except Exception:
+                return "success"
+            if isinstance(err, (
+                _exc.ActorDiedError, _exc.ActorUnavailableError,
+                _exc.WorkerCrashedError, _exc.NodeDiedError,
+                _rpc.ConnectionLost,
+            )):
+                return "failure"
+            if isinstance(err, _exc.DeadlineExceededError):
+                return "neutral"
+            return "success"
+
         async def _watch():
+            outcome = "success"
             try:
                 if streaming:
-                    await rt_.stream_wait_done(out.task_id)
+                    # terminal error envelope (None on clean end): a
+                    # replica dying mid-stream must trip the breaker,
+                    # not record a success
+                    env = await rt_.stream_wait_done(out.task_id)
+                    if env is not None:
+                        outcome = _classify(env)
                 else:
                     st = rt_.objects.get(out.binary())
                     if st is not None:
                         await st.ready.wait()
+                        if st.error is not None:
+                            outcome = _classify(st.error)
             finally:
-                _done()
+                _done(outcome)
 
         asyncio.run_coroutine_threadsafe(_watch(), rt_.loop)
         return out
 
+    def _assign_timeout(self, deadline_s, timeout_s) -> TimeoutError:
+        """Assignment-wait expiry: a handle-level deadline surfaces as
+        the documented DeadlineExceededError; the legacy default wait
+        keeps its plain TimeoutError shape."""
+        if deadline_s is not None:
+            return _exc.DeadlineExceededError(
+                f"no available replica for {self._deployment} before the "
+                f"handle's timeout_s budget expired"
+            )
+        return TimeoutError(
+            f"no available replica for {self._deployment} "
+            f"within {timeout_s}s"
+        )
+
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0, streaming: bool = False):
+                       timeout_s: float = 30.0, streaming: bool = False,
+                       deadline_s: Optional[float] = None):
         """Pick a replica and submit; returns the reply ObjectRef (or
-        ObjectRefGenerator when streaming)."""
+        ObjectRefGenerator when streaming).  `deadline_s` (absolute
+        monotonic, from the handle's `timeout_s`) bounds BOTH replica
+        assignment and — propagated into the task spec — execution."""
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
         affinity = kwargs.get(MODEL_ID_KWARG, "")
-        deadline = time.monotonic() + timeout_s
+        deadline = deadline_s if deadline_s is not None \
+            else time.monotonic() + timeout_s
         backoff = 0.005
         while True:
             self._refresh()
             info = self._try_pick(affinity)
             if info is not None:
                 return self._submit(info, method_name, args, kwargs,
-                                    streaming=streaming)
+                                    streaming=streaming,
+                                    deadline_s=deadline_s)
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no available replica for {self._deployment} "
-                    f"within {timeout_s}s"
-                )
+                raise self._assign_timeout(deadline_s, timeout_s)
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.25)
             self._refresh(force=True)
 
     async def assign_request_async(self, method_name: str, args: tuple,
                                    kwargs: dict, timeout_s: float = 30.0,
-                                   streaming: bool = False):
+                                   streaming: bool = False,
+                                   deadline_s: Optional[float] = None):
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
         affinity = kwargs.get(MODEL_ID_KWARG, "")
-        deadline = time.monotonic() + timeout_s
+        deadline = deadline_s if deadline_s is not None \
+            else time.monotonic() + timeout_s
         backoff = 0.005
         while True:
             await self._refresh_async()
             info = self._try_pick(affinity)
             if info is not None:
                 return self._submit(info, method_name, args, kwargs,
-                                    streaming=streaming)
+                                    streaming=streaming,
+                                    deadline_s=deadline_s)
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no available replica for {self._deployment} "
-                    f"within {timeout_s}s"
-                )
+                raise self._assign_timeout(deadline_s, timeout_s)
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 0.25)
             await self._refresh_async(force=True)

@@ -12,6 +12,8 @@ import pytest
 
 import ray_tpu as rt
 
+pytestmark = pytest.mark.chaos
+
 
 @pytest.fixture(scope="module")
 def cluster():
@@ -37,6 +39,120 @@ def test_task_storm_survives_worker_kills(cluster):
     assert results == [i * 3 for i in range(300)]
     killed = rt.get(kill_run, timeout=30)
     assert killed, "chaos run killed nothing — test proved nothing"
+    rt.kill(killer)
+
+
+def test_serve_router_skips_open_breaker_and_recovers():
+    """(c) a replica behind an open circuit breaker is ejected from the
+    router's candidate set; after the cooldown a half-open probe admits
+    traffic again and a success re-closes the breaker.  Router-level
+    and deterministic — no cluster needed."""
+    from ray_tpu.core import rpc
+    from ray_tpu.serve.router import Router
+
+    rpc.reset_breakers()
+    router = Router("dep", "app")
+    router._install_table({
+        "version": 1, "incarnation": "i1",
+        "replicas": {"r1": (None, 100), "r2": (None, 100)},
+    })
+    br = rpc.breaker_for(router._breaker_key("r1"))
+    try:
+        for _ in range(br.failure_threshold):
+            br.record_failure()
+        assert br.state == rpc.CircuitBreaker.OPEN
+
+        picks = set()
+        for _ in range(20):
+            info = router._try_pick()
+            assert info is not None, "healthy replica must stay pickable"
+            picks.add(info.replica_id)
+            info.local_inflight -= 1
+        assert picks == {"r2"}, "open breaker must eject r1"
+
+        # fast-forward the cooldown: the next allow() is the half-open
+        # probe, so r1 re-enters the candidate set
+        with br._lock:
+            br._opened_at -= br.cooldown_s + 1.0
+        picks = set()
+        for _ in range(200):
+            info = router._try_pick()
+            picks.add(info.replica_id)
+            info.local_inflight -= 1
+            if "r1" in picks:
+                break
+        assert "r1" in picks, "half-open probe must admit r1 again"
+        assert br.state == rpc.CircuitBreaker.HALF_OPEN
+        br.record_success()  # the probe succeeded
+        assert br.state == rpc.CircuitBreaker.CLOSED
+    finally:
+        rpc.reset_breakers()
+
+
+def test_serve_requests_flow_around_open_breaker(cluster):
+    """End-to-end: with one of two replicas behind an open breaker,
+    every request still succeeds through the healthy replica, and the
+    half-open probe restores the ejected one."""
+    from ray_tpu import serve
+    from ray_tpu.core import rpc
+    from ray_tpu.serve.handle import _router_for
+
+    @serve.deployment(num_replicas=2)
+    def who(request=None):
+        import os
+
+        return os.getpid()
+
+    h = serve.run(who.bind(), name="whoapp", route_prefix="/whoapp")
+    try:
+        assert h.remote().result(timeout_s=30) > 0  # warm: table cached
+        router = _router_for("whoapp", "who")
+        rid = sorted(router._replicas)[0]
+        br = rpc.breaker_for(router._breaker_key(rid))
+        # wide cooldown so the "stays open" phase can't race into
+        # half-open on a slow machine; recovery below rewinds manually
+        br.cooldown_s = 60.0
+        for _ in range(br.failure_threshold):
+            br.record_failure()
+        assert br.state == rpc.CircuitBreaker.OPEN
+        # every request succeeds via the healthy replica; the tripped
+        # breaker sees no traffic, so it stays open
+        for _ in range(8):
+            assert h.remote().result(timeout_s=30) > 0
+        assert br.state == rpc.CircuitBreaker.OPEN
+        # cooldown elapses -> half-open probe -> a success re-closes it
+        with br._lock:
+            br._opened_at -= br.cooldown_s + 1.0
+        deadline = time.time() + 30
+        while br.state != rpc.CircuitBreaker.CLOSED and time.time() < deadline:
+            h.remote().result(timeout_s=30)
+            time.sleep(0.05)
+        assert br.state == rpc.CircuitBreaker.CLOSED
+    finally:
+        rpc.reset_breakers()
+        serve.shutdown()
+
+
+@pytest.mark.slow
+def test_task_storm_long_duration_soak(cluster):
+    """Long-duration soak (out of tier-1, marker: slow): sustained
+    worker kills for 30s under a retriable task storm.  Completes
+    without retry-budget exhaustion because steady successes keep
+    refilling the bucket — the budget only bites when failures are
+    correlated and progress stops."""
+    from ray_tpu.testing import WorkerKiller
+
+    @rt.remote(max_retries=16)
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    killer = WorkerKiller.options(num_cpus=0).remote(interval_s=0.5, seed=7)
+    kill_run = killer.run.remote(duration_s=30.0)
+    refs = [work.remote(i) for i in range(1200)]
+    assert rt.get(refs, timeout=600) == list(range(1200))
+    killed = rt.get(kill_run, timeout=60)
+    assert killed, "soak killed nothing — test proved nothing"
     rt.kill(killer)
 
 

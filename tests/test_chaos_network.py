@@ -22,6 +22,8 @@ import pytest
 import ray_tpu as rt
 from ray_tpu.core import rpc
 
+pytestmark = pytest.mark.chaos
+
 
 @pytest.fixture()
 def chaos_cluster(monkeypatch):
@@ -196,6 +198,105 @@ def test_lease_connection_kill_mid_flight_retries(quiet_cluster):
     vals = rt.get(refs, timeout=120)
     t.join()
     assert vals == [i + 1 for i in range(30)]
+
+
+@pytest.mark.chaos
+def test_deadline_under_partition_fails_fast():
+    """Acceptance: a task submitted with `.options(timeout_s=2.0)` that
+    fans out to nested tasks raises DeadlineExceededError at the driver
+    in < 4s wall clock under an injected partition, with no further
+    resubmissions of its lineage afterward and total retries bounded by
+    the configured budget."""
+    import ray_tpu.exceptions as exc
+    from ray_tpu.core.runtime import get_runtime
+
+    if rt.is_initialized():
+        rt.shutdown()
+    os.environ["RT_RETRY_JITTER_SEED"] = "17"  # deterministic backoff
+    rt.init(num_workers=2, num_cpus=4)
+    chaos = rpc.NetworkChaos(seed=13)
+    rpc.set_chaos(chaos)
+    try:
+
+        def _leaf(i):
+            time.sleep(0.02)
+            return i
+
+        def _fanout(n):
+            leaf = rt.remote(num_cpus=0)(_leaf)
+            return sum(rt.get([leaf.remote(i) for i in range(n)],
+                              timeout=30))
+
+        fanout = rt.remote(num_cpus=0)(_fanout)
+        # healthy warm-up establishes leases so the partition has
+        # in-flight state to strand
+        assert rt.get(fanout.options(timeout_s=30).remote(3),
+                      timeout=60) == 3
+
+        r = get_runtime()
+        granted_before = r._retry_budget.retries_granted
+        # one-sided partition: results from leased workers never arrive
+        chaos.partition("lease")
+        t0 = time.monotonic()
+        ref = fanout.options(timeout_s=2.0).remote(3)
+        tid = ref.id.task_id().binary()
+        with pytest.raises(exc.DeadlineExceededError):
+            rt.get(ref, timeout=10)
+        elapsed = time.monotonic() - t0
+        # timeout_s + well under one backoff cap (5s default)
+        assert elapsed < 4.0, f"deadline surfaced after {elapsed:.1f}s"
+        # the lineage is dead: no resubmission now or later
+        assert tid not in r.pending_tasks
+        time.sleep(0.5)
+        assert tid not in r.pending_tasks
+        # retry attempts across the run bounded by the budget
+        assert (r._retry_budget.retries_granted - granted_before
+                <= r.cfg.task_retry_budget_cap)
+    finally:
+        chaos.heal()
+        rpc.set_chaos(None)
+        os.environ.pop("RT_RETRY_JITTER_SEED", None)
+        rt.shutdown()
+
+
+@pytest.mark.chaos
+def test_retry_budget_exhaustion_stops_resubmission(tmp_path):
+    """An always-failing task with a tiny retry budget stops
+    resubmitting when the bucket drains, and the final TaskError
+    records the attempts made."""
+    import ray_tpu.exceptions as exc
+
+    if rt.is_initialized():
+        rt.shutdown()
+    rt.init(num_workers=2, num_cpus=4, _system_config={
+        "task_retry_budget_cap": 2.0,
+        "task_retry_budget_refill": 0.0,
+        "task_retry_backoff_base_ms": 5,
+        "task_retry_backoff_max_ms": 20,
+    })
+    marker = str(tmp_path / "attempts.log")
+    try:
+
+        def _always_fails(path):
+            with open(path, "a") as f:
+                f.write("x")
+            raise RuntimeError("boom")
+
+        always_fails = rt.remote(
+            max_retries=10, retry_exceptions=True, num_cpus=0
+        )(_always_fails)
+        with pytest.raises(exc.TaskError) as ei:
+            rt.get(always_fails.remote(marker), timeout=60)
+        msg = str(ei.value)
+        assert "retry budget" in msg
+        assert "3 attempts" in msg and "2 retries" in msg
+        time.sleep(0.5)  # would-be extra resubmissions get time to run
+        with open(marker) as f:
+            executions = len(f.read())
+        # 1 initial + exactly the 2 budget-funded retries
+        assert executions == 3, f"saw {executions} executions"
+    finally:
+        rt.shutdown()
 
 
 def test_serve_request_path_under_delay(chaos_cluster):
