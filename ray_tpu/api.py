@@ -81,7 +81,12 @@ def init(
 
         if address is None:
             from ray_tpu.core.node_launcher import launch_noded
+            from ray_tpu.shm import sweep_stale_segments
 
+            # reap segments orphaned by hard-killed prior clusters
+            # before this one sizes its own store (daemon boot sweeps
+            # too — this covers drivers racing the daemon's first boot)
+            sweep_stale_segments()
             session_dir = _make_session_dir()
             proc, info = launch_noded(
                 session_dir,

@@ -74,7 +74,8 @@ def _gce_metadata(key: str) -> Optional[str]:
             if resp.status == 200:
                 return resp.read().decode().strip() or None
             return None
-    except Exception:
+    except Exception as e:
+        logger.debug("GCE metadata probe failed (%s); not on TPU VM", e)
         _metadata_dead = True
     return None
 

@@ -205,7 +205,10 @@ class Controller:
                 try:
                     await node.conn.call("ping", None, timeout=period * threshold)
                     misses[node.node_id] = 0
-                except Exception:
+                except Exception as e:
+                    logger.debug(
+                        "health ping to %s missed (%s)", node.node_id[:8], e
+                    )
                     misses[node.node_id] = misses.get(node.node_id, 0) + 1
                     if misses[node.node_id] >= threshold:
                         await self._mark_node_dead(node, "health check failed")
@@ -249,8 +252,10 @@ class Controller:
             if not conn.closed:
                 try:
                     conn.send("publish", {"channel": channel, "msg": msg})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug(
+                        "publish to %s subscriber dropped: %s", channel, e
+                    )
 
     async def handle_subscribe(self, payload, conn):
         subs = self._subscribers.setdefault(payload["channel"], [])

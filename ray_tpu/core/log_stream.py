@@ -65,7 +65,9 @@ class _TeeStream(io.TextIOBase):
             s = str(s)
         try:
             self._pass.write(s)
-        except Exception:
+        except (OSError, ValueError):
+            # closed/broken passthrough; logging here would recurse
+            # into this very tee, so drop the passthrough copy only
             pass
         ctx = _current_ctx()
         if ctx is None:
@@ -83,8 +85,8 @@ class _TeeStream(io.TextIOBase):
     def flush(self):
         try:
             self._pass.flush()
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # closed/broken passthrough (see write)
         tid = threading.get_ident()
         with self._lock:
             rest = self._buf.pop(tid, "")
@@ -125,8 +127,11 @@ def _ship(ctx, stream: str, lines):
                 },
                 "want_reply": False,
             })
-        except Exception:
-            return  # owner/daemon unreachable: file-only from here
+        except Exception:  # rtlint: disable=RT005
+            # owner/daemon unreachable: degrade to file-only.  This IS
+            # the log-shipping path — logging the failure would recurse
+            # straight back into this tee.
+            return
 
 
 def install_worker_tee():

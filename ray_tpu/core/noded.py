@@ -84,7 +84,13 @@ class NodeDaemon:
         self.is_head = is_head
         self.node_id = NodeID.random().hex()
         self.node_name = node_name or self.node_id[:8]
-        self.shm_name = f"/rt_{os.path.basename(session_dir)}_{self.node_id[:8]}"
+        # the ".<pid>" suffix marks this daemon as the segment's owner
+        # so a later boot can reap it if we die without unlinking
+        # (shm.sweep_stale_segments)
+        self.shm_name = (
+            f"/rt_{os.path.basename(session_dir)}_{self.node_id[:8]}"
+            f".{os.getpid()}"
+        )
         self.socket_path = os.path.join(session_dir, f"noded_{self.node_id[:8]}.sock")
 
         ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 4)
@@ -149,6 +155,11 @@ class NodeDaemon:
     # startup
     # ------------------------------------------------------------------
     async def start(self):
+        # daemon boot doubles as the host's janitor: segments owned by
+        # hard-killed sessions would otherwise eat /dev/shm forever
+        from ray_tpu import shm as _shm
+
+        _shm.sweep_stale_segments()
         cap = self.cfg.object_store_memory
         if cap <= 0:
             cap = _default_store_capacity()
@@ -274,7 +285,8 @@ class NodeDaemon:
                 await self._connect_controller()
                 logger.info("reconnected to controller")
                 return
-            except Exception:
+            except Exception as e:
+                logger.debug("controller reconnect attempt failed: %s", e)
                 await asyncio.sleep(1.0)
         logger.error(
             "controller unreachable for %.0fs; daemon shutting down",
@@ -411,8 +423,8 @@ class NodeDaemon:
                     )
 
                     get_container_runtime().kill_booting(token)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("kill_booting(%s) failed: %s", token, e)
                 proc.kill()
             await asyncio.sleep(0.2)
         if token in self._booting_tokens:
@@ -529,7 +541,9 @@ class NodeDaemon:
                 try:
                     (await self._node_conn(strat.node_id)).send("submit_task", spec)
                     return
-                except Exception:
+                except Exception as e:
+                    logger.debug("forward to node %s failed: %s",
+                                 strat.node_id[:8], e)
                     if not strat.soft:
                         result = TaskResult(task_id=spec.task_id, status="worker_died")
                         await self._route_to_owner(spec.owner, "task_result", result)
@@ -634,6 +648,7 @@ class NodeDaemon:
                     container=((q[0].env_hash, head) if head else None)
                 )
             except Exception as e:
+                logger.debug("worker spawn for env failed: %s", e)
                 # the env cannot be materialized on this host (no
                 # podman/docker, bad image): fail the queued tasks of
                 # that env with the cause — retrying every tick would
@@ -671,7 +686,8 @@ class NodeDaemon:
             from ray_tpu.core.container import container_section
 
             return container_section(getattr(spec, "runtime_env", None))
-        except Exception:
+        except Exception as e:
+            logger.debug("resolving container section failed: %s", e)
             return None
 
     def _find_worker_for(self, spec: TaskSpec) -> Optional[WorkerState]:
@@ -820,8 +836,8 @@ class NodeDaemon:
                 self.controller_conn.send(
                     "report_node_load", self._load_sync_payload(report)
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("load report dropped: %s", e)
 
     # RaySyncer-style delta sync (reference: `ray_syncer.h:88`): send
     # only fields that changed since the last report, a bare-version
@@ -877,7 +893,9 @@ class NodeDaemon:
                     break
                 try:
                     view = self.store.get(id_bytes, timeout_ms=0)
-                except Exception:
+                except Exception as e:
+                    logger.debug("spill candidate %s not gettable: %s",
+                                 id_bytes.hex()[:12], e)
                     continue
                 try:
                     data = bytes(view)
@@ -918,9 +936,12 @@ class NodeDaemon:
             if not self.store.contains(id_bytes):
                 try:
                     self.store.put(id_bytes, data, allow_evict=False)
-                except Exception:
-                    return False  # still pressured; caller retries after
-                    # the next spill pass frees room
+                except Exception as e:
+                    # still pressured; caller retries after the next
+                    # spill pass frees room
+                    logger.debug("restore of %s blocked: %s",
+                                 id_bytes.hex()[:12], e)
+                    return False
             self._spilled.pop(id_bytes, None)
             try:
                 os.remove(path)
@@ -957,7 +978,8 @@ class NodeDaemon:
                     return await w.conn.call(
                         "cancel_task", {"task_id": task_id}, timeout=10
                     )
-                except Exception:
+                except Exception as e:
+                    logger.debug("cancel_task relay failed: %s", e)
                     return {"cancelled": False}
         if not payload.get("forwarded"):
             reply = await self._fanout_once(
@@ -1009,8 +1031,8 @@ class NodeDaemon:
                 self.controller_conn.send(
                     "report_pending_demand", {"resources": demand}
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("pending-demand report dropped: %s", e)
             return  # stays queued
         for i, s in enumerate(self.task_queue):
             if s is spec:
@@ -1048,7 +1070,9 @@ class NodeDaemon:
         )
         try:
             w.conn.send("set_accel_env", env)
-        except Exception:
+        except Exception as e:
+            logger.debug("set_accel_env send to %s failed: %s",
+                         w.worker_id[:8], e)
             return False
         return True
 
@@ -1066,7 +1090,9 @@ class NodeDaemon:
         )
         try:
             await w.conn.call("set_accel_env", env, timeout=10)
-        except Exception:
+        except Exception as e:
+            logger.debug("set_accel_env call to %s failed: %s",
+                         w.worker_id[:8], e)
             return False
         return True
 
@@ -1139,8 +1165,9 @@ class NodeDaemon:
             if chip_mismatch or env_mismatch:
                 try:
                     os.kill(w.pid, signal.SIGKILL)
-                except Exception:
-                    pass
+                except (OSError, ProcessLookupError) as e:
+                    logger.debug("killing mismatched worker %d: %s",
+                                 w.pid, e)
                 return
 
     async def handle_request_lease(self, payload, conn):
@@ -1196,6 +1223,7 @@ class NodeDaemon:
                     container=((env_hash, container) if container else None)
                 )
             except Exception as e:
+                logger.debug("worker spawn failed: %s", e)
                 # surface spawn failures (no podman on host, bad image)
                 # to the caller: the driver fails the queued tasks with
                 # a runtime-env error instead of retrying forever
@@ -1221,7 +1249,8 @@ class NodeDaemon:
                     "resolve_worker_socket",
                     {"node_id": node_id, "worker_id": payload["worker_id"]},
                 )
-            except Exception:
+            except Exception as e:
+                logger.debug("resolve_worker_socket relay failed: %s", e)
                 return None
         w = self.workers.get(payload["worker_id"])
         return w.socket_path if w else None
@@ -1292,8 +1321,11 @@ class NodeDaemon:
         async def _one(w):
             try:
                 s = await w.conn.call("memory_summary", {}, timeout=5)
-            except Exception:
-                return None  # process died/hung mid-listing
+            except Exception as e:
+                # process died/hung mid-listing
+                logger.debug("memory_summary from %s failed: %s",
+                             w.worker_id[:8], e)
+                return None
             s["worker_id"] = w.worker_id
             s["worker_kind"] = w.kind
             return s
@@ -1315,8 +1347,8 @@ class NodeDaemon:
                 "used": self.store.used,
                 "capacity": self.store.capacity,
             }
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("store stats unavailable: %s", e)
         return {
             "node_id": self.node_id,
             "store": store,
@@ -1368,6 +1400,7 @@ class NodeDaemon:
             else:
                 out = await w.conn.call("dump_stacks", None, timeout=10)
         except Exception as e:
+            logger.debug("profile of %s failed: %s", w.worker_id[:8], e)
             return {"error": str(e)}
         return {"stacks": out, "pid": w.pid, "mode": mode}
 
@@ -1380,7 +1413,8 @@ class NodeDaemon:
         returns that reply; otherwise fire-and-forget to all."""
         try:
             nodes = await self.controller_conn.call("get_nodes", None)
-        except Exception:
+        except Exception as e:
+            logger.debug("fanout get_nodes failed: %s", e)
             return None
         payload = {**payload, "forwarded": True}
         for n in nodes or []:
@@ -1394,8 +1428,8 @@ class NodeDaemon:
                 reply = await c.call(method, payload, timeout=timeout)
                 if done is not None and done(reply):
                     return reply
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("fanout %s to a peer failed: %s", method, e)
         return None
 
     async def handle_force_cancel_task(self, payload, conn):
@@ -1429,8 +1463,8 @@ class NodeDaemon:
             if tid in w.in_flight and w.conn and not w.conn.closed:
                 try:
                     w.conn.send("stream_cancel", {"task_id": tid})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("stream_cancel to worker failed: %s", e)
                 return
         if payload.get("forwarded"):
             return  # one hop only: every daemon has now checked locally
@@ -1521,6 +1555,7 @@ class NodeDaemon:
                 await self._pull_into_store(id_bytes, node_id)
                 fut.set_result(True)
             except Exception as e:
+                logger.debug("pull of %s failed: %s", id_bytes.hex()[:12], e)
                 fut.set_exception(e)
             finally:
                 self._pulls.pop(id_bytes, None)
@@ -1585,8 +1620,9 @@ class NodeDaemon:
                 if not sealed:
                     try:
                         self.store.delete(id_bytes)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("dropping unsealed %s: %s",
+                                     id_bytes.hex()[:12], e)
         finally:
             self._release_pull(size)
 
@@ -1631,7 +1667,9 @@ class NodeDaemon:
                     return {"size": buf.nbytes}
                 finally:
                     self.store.release(id_bytes)
-            except Exception:
+            except Exception as e:
+                logger.debug("object %s not in store (%s); trying "
+                             "spilled copy", id_bytes.hex()[:12], e)
                 if attempt or not await asyncio.get_running_loop().run_in_executor(
                     None, self._restore_spilled, id_bytes
                 ):
@@ -1642,9 +1680,11 @@ class NodeDaemon:
         for attempt in (0, 1):
             try:
                 buf = self.store.get(id_bytes, timeout_ms=0)
-            except Exception:
+            except Exception as e:
                 # the object may have been spilled mid-transfer (it is
                 # unpinned between chunk fetches): restore and retry
+                logger.debug("chunk source %s not pinned (%s); "
+                             "restoring", id_bytes.hex()[:12], e)
                 if attempt or not await asyncio.get_running_loop(
                 ).run_in_executor(None, self._restore_spilled, id_bytes):
                     return None
@@ -1721,6 +1761,7 @@ class NodeDaemon:
         except TimeoutError:
             return {"status": "timeout"}
         except Exception as e:
+            logger.debug("channel write failed: %s", e)
             return {"status": "error", "error": str(e)}
 
     async def handle_chan_remote_close(self, payload, conn):
@@ -1740,20 +1781,22 @@ class NodeDaemon:
         # inline so they can never queue behind stalled ring writes
         try:
             self.store.chan_close(payload["chan"])
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("chan_close failed: %s", e)
         if not close_only:
             try:
                 self.store.chan_delete(payload["chan"])
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("chan_delete failed: %s", e)
         return {"status": "ok"}
 
     async def handle_fetch_object(self, payload, conn):
         id_bytes = payload["id"]
         try:
             buf = self.store.get(id_bytes, timeout_ms=0)
-        except Exception:
+        except Exception as e:
+            logger.debug("meta source %s not in store (%s); trying "
+                         "spilled copy", id_bytes.hex()[:12], e)
             restored = await asyncio.get_running_loop().run_in_executor(
                 None, self._restore_spilled, id_bytes
             )
@@ -1761,7 +1804,9 @@ class NodeDaemon:
                 return None
             try:
                 buf = self.store.get(id_bytes, timeout_ms=0)
-            except Exception:
+            except Exception as e:
+                logger.debug("restored %s still not gettable: %s",
+                             id_bytes.hex()[:12], e)
                 return None
         try:
             max_bytes = payload.get("max_bytes")
@@ -1789,8 +1834,9 @@ class NodeDaemon:
         try:
             c = await self._node_conn(node_id)
             c.send("free_object", {"id": payload["id"]})
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("free_object forward to %s failed: %s",
+                         node_id[:8], e)
 
     # ------------------------------------------------------------------
     # actors
@@ -1843,6 +1889,7 @@ class NodeDaemon:
                             if actor_container else None
                         ))
                     except Exception as e:
+                        logger.debug("actor worker spawn failed: %s", e)
                         for k, v in demand.items():
                             self.available[k] = (
                                 self.available.get(k, 0.0) + v
@@ -1867,6 +1914,8 @@ class NodeDaemon:
                 self.available[k] = self.available.get(k, 0.0) + v
             return {"ok": False, "error": f"actor __init__ failed: {e}"}
         except Exception as e:
+            logger.debug("actor __init__ crashed on %s: %s",
+                         target.worker_id[:8], e)
             self._on_worker_dead(target, f"actor init crashed: {e}")
             return {"ok": False, "error": f"actor __init__ failed: {e}"}
         if not reply.get("ok"):
@@ -1943,8 +1992,9 @@ class NodeDaemon:
             if w.proc is not None or w.kind == "worker":
                 try:
                     os.kill(w.pid, signal.SIGKILL)
-                except Exception:
-                    pass
+                except (OSError, ProcessLookupError) as e:
+                    logger.debug("killing worker %d at shutdown: %s",
+                                 w.pid, e)
         if self.unix_server:
             await self.unix_server.stop()
         if self.tcp_server:
@@ -1961,7 +2011,8 @@ def _default_store_capacity() -> int:
 
         free = shutil.disk_usage("/dev/shm").free
         return max(256 * 1024 * 1024, int(free * 0.3))
-    except Exception:
+    except Exception as e:
+        logger.debug("sizing /dev/shm failed (%s); using 1GiB default", e)
         return 1024 * 1024 * 1024
 
 

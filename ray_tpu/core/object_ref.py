@@ -70,7 +70,9 @@ class ObjectRef:
             from ray_tpu.core import runtime as _rt
 
             _rt.on_ref_deleted(self)
-        except Exception:
+        except Exception:  # rtlint: disable=RT005
+            # interpreter teardown: modules may be half-collected and
+            # even logging can be gone — silence is the only option
             pass
 
     def __hash__(self):

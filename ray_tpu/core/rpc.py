@@ -29,13 +29,13 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-import pickle
 import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from ray_tpu.core import wire
 from ray_tpu.core.serialization import dumps_oob as _dumps_oob
+from ray_tpu.core.serialization import loads as _loads_oob
 
 logger = logging.getLogger(__name__)
 
@@ -271,7 +271,9 @@ def breaker_for(address: str) -> CircuitBreaker:
                 cfg = get_config()
                 threshold = cfg.breaker_failure_threshold
                 cooldown = cfg.breaker_cooldown_s
-            except Exception:
+            except Exception as e:
+                logger.debug("config unavailable for breaker (%s); "
+                             "using defaults", e)
                 threshold, cooldown = 5, 2.0
             br = _breakers[address] = CircuitBreaker(threshold, cooldown)
             if len(_breakers) > _BREAKER_BOARD_CAP:
@@ -360,7 +362,8 @@ def decode_payload(codec: int, blob, require_schema: bool):
                 "peer sent a pickled (non-schema) control frame and this "
                 "endpoint runs with wire_require_schema"
             )
-        return pickle.loads(blob)
+        # the one audited unpickle chokepoint (core/serialization.loads)
+        return _loads_oob(blob)
     raise RpcError(f"unknown payload codec {codec}")
 
 
@@ -389,7 +392,9 @@ class Connection:
                 require_schema = bool(
                     getattr(get_config(), "wire_require_schema", False)
                 )
-            except Exception:
+            except Exception as e:
+                logger.debug("config unavailable (%s); pickle frames "
+                             "allowed on %s", e, name)
                 require_schema = False
         self.require_schema = require_schema
         self._ids = itertools.count(1)
@@ -476,7 +481,8 @@ class Connection:
             self._outbox.clear()
         try:
             self.writer.write(batch)
-        except Exception:
+        except Exception as e:
+            logger.debug("write to %s failed: %s", self.name, e)
             self._teardown(ConnectionLost(f"write to {self.name} failed"))
 
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
@@ -584,13 +590,16 @@ class Connection:
                     self._enqueue(msg_id, REPLY, method, result)
                 except Exception as pe:
                     # unpicklable result: the caller must not hang
+                    logger.debug("reply to %s unpicklable: %r", method, pe)
                     self._enqueue(msg_id, REPLY, "__error__",
                                   RpcError(f"unpicklable reply from {method}: {pe!r}"))
         except Exception as e:
             if msg_id is not None:
                 try:
                     self._enqueue(msg_id, REPLY, "__error__", e)
-                except Exception:
+                except Exception as pe:
+                    logger.debug("error reply to %s unpicklable: %r",
+                                 method, pe)
                     self._enqueue(msg_id, REPLY, "__error__",
                                   RpcError(f"{method} failed: {e!r}"))
             else:
@@ -607,13 +616,13 @@ class Connection:
         self._pending.clear()
         try:
             self.writer.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("closing writer for %s: %s", self.name, e)
         if self.on_close:
             try:
                 self.on_close(self)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("on_close hook for %s failed: %s", self.name, e)
 
     async def close(self):
         if self._recv_task:

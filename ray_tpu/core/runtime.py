@@ -453,7 +453,8 @@ class Runtime:
                     *self._controller_addr, handler=self._handle,
                     name="controller",
                 )
-            except Exception:
+            except Exception as e:
+                logger.debug("controller connect failed: %s", e)
                 await asyncio.sleep(1.0)
                 continue
             conn.on_close = self._on_controller_lost
@@ -502,8 +503,8 @@ class Runtime:
             # (best-effort: owners also clean up on connection loss)
             try:
                 await self._flush_ref_events(immediate=True)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("final ref-event flush failed: %s", e)
             for timer in list(self._lease_timers):
                 timer.cancel()
             self._lease_timers.clear()
@@ -514,8 +515,8 @@ class Runtime:
                 try:
                     self.controller.send("report_task_events", {"events": events})
                     await asyncio.sleep(0.05)  # let the write flush
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("final task-event report dropped: %s", e)
             if self._server:
                 await self._server.stop()
             for conn in list(self._conn_lease):
@@ -529,8 +530,8 @@ class Runtime:
 
         try:
             asyncio.run_coroutine_threadsafe(_close(), self.loop).result(timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("io-loop close incomplete: %s", e)
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._io_thread.join(timeout=5)
         self._exec_pool.shutdown(wait=False)
@@ -540,8 +541,8 @@ class Runtime:
             for id_bytes in self._held_pins:
                 try:
                     self.store.release(id_bytes)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("releasing pin at shutdown: %s", e)
             self._held_pins.clear()
             self.store.close()
 
@@ -662,7 +663,8 @@ class Runtime:
                     return
                 # nobody is RUNNING it: it may still sit in a daemon
                 # queue — fall through to the drop path below
-            except Exception:
+            except Exception as e:
+                logger.debug("cancel probe failed: %s", e)
                 return
         if spec.actor_id is not None and not conns:
             # connection still being established: wait briefly so the
@@ -681,13 +683,13 @@ class Runtime:
                 )
                 if reply and reply.get("cancelled"):
                     return
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("cancel_task on executor failed: %s", e)
         # not found on any executor (e.g. queued in noded): best-effort
         try:
             await self.noded.call("cancel_task", {"task_id": task_id})
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("cancel_task via noded failed: %s", e)
 
     def _fail_cancelled(self, task_id: bytes, spec: TaskSpec):
         envelope = ser.serialize_to_bytes(
@@ -775,8 +777,8 @@ class Runtime:
                         raise
                     try:
                         self.noded_call("spill_now", None, timeout=10)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("spill_now nudge failed: %s", e)
                     time.sleep(0.05)
             ser.write_chunks(chunks, dest)
             del dest
@@ -1148,7 +1150,8 @@ class Runtime:
                 ).add_done_callback(
                     lambda f: f.exception() if not f.cancelled() else None
                 )
-            except Exception:
+            except Exception as e:
+                logger.debug("scheduling ref-event flush failed: %s", e)
                 with self._ref_event_lock:
                     self._ref_event_flush_scheduled = False
 
@@ -1175,8 +1178,10 @@ class Runtime:
                             },
                             "want_reply": False,
                         })
-                    except Exception:
-                        break  # daemon gone: owner cleanup handles it
+                    except Exception as e:
+                        # daemon gone: owner cleanup handles it
+                        logger.debug("ref-event batch dropped: %s", e)
+                        break
 
     def _pool_for(self, spec: TaskSpec) -> _LeasePool:
         demand = spec.resources.as_dict()
@@ -1290,7 +1295,8 @@ class Runtime:
                          "container": getattr(pool, "container", None)},
                         timeout=60,
                     )
-                except Exception:
+                except Exception as e:
+                    logger.debug("lease request failed: %s", e)
                     await asyncio.sleep(0.1)
                     continue
                 if reply is None:
@@ -1343,7 +1349,9 @@ class Runtime:
                     conn = await rpc.connect_unix(
                         socket_path, handler=self._handle, name=f"lease-{worker_id[:8]}"
                     )
-                except Exception:
+                except Exception as e:
+                    logger.debug("lease socket connect to %s failed: %s",
+                                 worker_id[:8], e)
                     breaker.record_failure()
                     self.noded.send("return_lease", {"worker_id": worker_id})
                     continue
@@ -1690,7 +1698,8 @@ class Runtime:
                     assigned[s.task_id.binary()] = s
             for s in specs:
                 conn.send_threadsafe("execute_task", s)
-        except Exception:
+        except Exception as e:
+            logger.debug("actor task push failed: %s", e)
             # stale address or races with restart: retry while callers
             # still have queued work — through the capped jittered
             # backoff schedule, NOT a fixed-delay redial loop (a dead
@@ -1771,8 +1780,8 @@ class Runtime:
                     self.controller.send(
                         "report_task_events", {"events": events}
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("task-event report dropped: %s", e)
 
     def _complete_task(self, result: TaskResult) -> list:
         """Returns the pending ACK futures of contained-borrow
@@ -1996,8 +2005,8 @@ class Runtime:
         if not self._shutdown:
             try:
                 self.store.release(id_bytes)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("pin release failed: %s", e)
 
     async def _read_shm(self, ref: ObjectRef, node_id: Optional[str]):
         try:
@@ -2054,8 +2063,10 @@ class Runtime:
                     "payload": {"ids": chunk},
                     "want_reply": True,
                 })
-            except Exception:
-                return  # degraded: per-ref path covers this chunk
+            except Exception as e:
+                # degraded: per-ref path covers this chunk
+                logger.debug("batched owner fetch failed: %s", e)
+                return
             for id_b, rep in zip(chunk, replies):
                 # not-yet-ready objects come back "pending" so one slow
                 # producer can't hold its chunk's reply hostage; the
@@ -2298,8 +2309,8 @@ class Runtime:
                     else:
                         for method, p in chunk:
                             self._queue_ref_event(owner, method, p)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("borrow registration dropped: %s", e)
         if recorded:
             self._contained_in.setdefault(container_id, []).extend(recorded)
 
@@ -2343,15 +2354,15 @@ class Runtime:
             if st.node_id == self.node_id:
                 try:
                     self.store.delete(id_bytes)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("freeing local object: %s", e)
             else:
                 try:
                     self.noded.send_threadsafe(
                         "free_remote", {"id": id_bytes, "node_id": st.node_id}
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("free_remote dropped: %s", e)
 
     # ------------------------------------------------------------------
     # kv / controller passthroughs
@@ -2411,8 +2422,9 @@ class Runtime:
             # cleanup below (the queue must not stay 'desired'), then
             # surface the cancellation
             cancelled = e
-        except Exception:
-            pass  # judged below by whether registration actually landed
+        except Exception as e:
+            # judged below by whether registration actually landed
+            logger.debug("subscribe attempt errored: %s", e)
         with self._state_lock:
             registered = (
                 cancelled is None and channel in self._pubsub_registered
@@ -2474,8 +2486,9 @@ class Runtime:
 
         try:
             self.loop.call_soon_threadsafe(_cb)
-        except Exception:
-            pass  # loop closed: nothing to reconcile against anymore
+        except Exception as e:
+            # loop closed: nothing to reconcile against anymore
+            logger.debug("pubsub reconcile not scheduled: %s", e)
 
     async def _pubsub_reconcile(self) -> bool:
         """Single-writer pubsub registration reconciler: drives the
@@ -2549,8 +2562,9 @@ class Runtime:
                         with self._state_lock:
                             self._pubsub_uncertain.add(ch)
                         raise
-                    except Exception:
-                        pass  # best-effort; closed conns get pruned
+                    except Exception as e:
+                        # best-effort; closed conns get pruned
+                        logger.debug("unsubscribe failed: %s", e)
                     # one attempt resolves the uncertainty either way:
                     # a failed unsubscribe on a live conn is rare, and
                     # retrying it forever would spin this pass
@@ -2599,8 +2613,8 @@ class Runtime:
                 try:
                     conn.send("transit_release",
                               {"task_id": result.task_id.binary()})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("transit_release dropped: %s", e)
 
     async def _maybe_return_lease(self, pool: _LeasePool, lease: _Lease):
         """Idle lease handling: keep the worker warm for a grace period
@@ -2660,8 +2674,8 @@ class Runtime:
             self._conn_lease.pop(lease.conn, None)
         try:
             self.noded.send("return_lease", {"worker_id": lease.worker_id})
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("return_lease dropped: %s", e)
         await lease.conn.close()
 
     async def _h_stream_item(self, payload, conn):
@@ -2777,12 +2791,12 @@ class Runtime:
             try:
                 conn.send("stream_cancel", {"task_id": task_id})
                 return
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("stream_cancel to executor failed: %s", e)
         try:
             self.noded.send("stream_cancel", {"task_id": task_id})
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("stream_cancel via noded failed: %s", e)
 
     async def _h_stream_cancel(self, payload, conn):
         """Executor side: mark the stream abandoned; _stream_out stops
@@ -2906,12 +2920,12 @@ class Runtime:
             self._worker_log_lines.append((name, pid, stream, line))
             try:
                 out.write(f"({name} pid={pid}) {line}\n")
-            except Exception:
-                return
+            except (OSError, ValueError):
+                return  # driver stdout closed/redirected away
         try:
             out.flush()
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # driver stdout closed/redirected away
 
     async def _h_transit_release(self, payload, conn):
         """The owner of a task's returns has registered its contained
@@ -3234,7 +3248,8 @@ class Runtime:
             blob = await self.controller.call(
                 "kv_get", {"key": "driver:sys_path"}
             )
-        except Exception:
+        except Exception as e:
+            logger.debug("driver sys_path fetch failed: %s", e)
             return False
         if not blob:
             return False
@@ -3320,8 +3335,10 @@ class Runtime:
             loop = asyncio.get_running_loop()
             self._task_local.task_id = spec.task_id
             # ambient deadline: nested .remote() calls made by the user
-            # code inherit the parent's remaining budget
-            _ambient_deadline.set(spec.deadline_s)
+            # code inherit the parent's remaining budget.  Overwrite by
+            # design — every task sets it at start (even to None), so a
+            # reset token would only restore a NEIGHBOR's budget.
+            _ambient_deadline.set(spec.deadline_s)  # rtlint: disable=RT006
 
             from ray_tpu.util import tracing as _tracing
 
@@ -3352,8 +3369,8 @@ class Runtime:
                         try:
                             sys.stdout.flush()
                             sys.stderr.flush()
-                        except Exception:
-                            pass
+                        except (OSError, ValueError):
+                            pass  # stream closed mid-teardown
                         log_ctx_var.reset(_log_tok)
                 else:
 
@@ -3361,7 +3378,8 @@ class Runtime:
                         from ray_tpu.core.log_stream import log_ctx_var
 
                         self._task_local.task_id = spec.task_id
-                        _ambient_deadline.set(spec.deadline_s)
+                        # overwrite-by-design: see the async path above
+                        _ambient_deadline.set(spec.deadline_s)  # rtlint: disable=RT006
                         _log_tok = log_ctx_var.set((spec.owner, spec.name))
                         try:
                             with _tracing.execution_span(spec.name, trace_ctx):
@@ -3373,8 +3391,8 @@ class Runtime:
                             try:
                                 sys.stdout.flush()
                                 sys.stderr.flush()
-                            except Exception:
-                                pass
+                            except (OSError, ValueError):
+                                pass  # stream closed mid-teardown
                             log_ctx_var.reset(_log_tok)
 
                     # sync methods of a named group run on that group's
@@ -3390,7 +3408,8 @@ class Runtime:
                     from ray_tpu.core.log_stream import log_ctx_var
 
                     self._task_local.task_id = spec.task_id
-                    _ambient_deadline.set(spec.deadline_s)
+                    # overwrite-by-design: see the async path above
+                    _ambient_deadline.set(spec.deadline_s)  # rtlint: disable=RT006
                     _log_tok = log_ctx_var.set((spec.owner, spec.name))
                     # registered for mid-execution cancellation
                     # (_h_cancel_task async-raises into this thread);
@@ -3413,8 +3432,8 @@ class Runtime:
                             try:
                                 sys.stdout.flush()
                                 sys.stderr.flush()
-                            except Exception:
-                                pass
+                            except (OSError, ValueError):
+                                pass  # stream closed mid-teardown
                             log_ctx_var.reset(_log_tok)
                             # after this pop no NEW cancel can be
                             # delivered (raise and pop share the lock)
@@ -3479,14 +3498,16 @@ class Runtime:
         await self._await_borrow_acks()
         try:
             conn.send("task_result", {"result": result, "owner": spec.owner})
-        except Exception:
+        except Exception as e:
             # origin went away: route via the node daemon
+            logger.debug("direct task_result failed (%s); routing via "
+                         "noded", e)
             try:
                 self.noded.send(
                     "task_done", {"result": result, "owner": spec.owner}
                 )
-            except Exception:
-                pass
+            except Exception as e2:
+                logger.debug("task_done via noded also failed: %s", e2)
 
     async def _await_borrow_acks(self, timeout: float = 10.0):
         # SNAPSHOT, don't drain: with concurrent tasks in one worker
@@ -3498,10 +3519,10 @@ class Runtime:
         for f in acks:
             try:
                 await asyncio.wait_for(asyncio.wrap_future(f), timeout)
-            except Exception:
+            except Exception as e:
                 # owner unreachable: proceed — the caller-side pin falls
                 # back to the (pre-existing) unprotected window
-                pass
+                logger.debug("borrow ACK not confirmed: %s", e)
         with self._state_lock:
             self._pending_borrow_acks = [
                 f for f in self._pending_borrow_acks if not f.done()
@@ -3536,8 +3557,10 @@ class Runtime:
                        "owner": spec.owner}
             try:
                 conn.send("stream_item", payload)
-            except Exception:
+            except Exception as e:
                 # origin conn gone: route via the node daemon
+                logger.debug("direct stream_item failed (%s); routing "
+                             "via noded", e)
                 self.noded.send("task_stream", payload)
 
         if inspect.isasyncgen(value):
@@ -3590,8 +3613,8 @@ class Runtime:
                     raise
                 try:
                     await self.noded.call("spill_now", None, timeout=10)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("spill_now nudge failed: %s", e)
                 await asyncio.sleep(0.05)
 
     async def _package_returns(self, spec: TaskSpec, value) -> List[Tuple]:
@@ -3725,7 +3748,9 @@ class ObjectRefGenerator:
         # (exhausted streams already popped it — this is a no-op then)
         try:
             self._rt.stream_release(self._tid)
-        except Exception:
+        except Exception:  # rtlint: disable=RT005
+            # __del__ during interpreter teardown: logging itself may
+            # already be torn down
             pass
 
     def __repr__(self):
@@ -3817,8 +3842,8 @@ def on_ref_deserialized(ref: ObjectRef):
                     # list during its prune, and a bare append could be
                     # lost to that assignment
                     rt._pending_borrow_acks.append(fut)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("borrow ACK registration failed: %s", e)
         else:
             # drivers don't forward refs in results: the registration
             # needs no ACK, so it rides the coalesced channel (a 10k-ref

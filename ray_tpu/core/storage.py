@@ -39,6 +39,8 @@ import sqlite3
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu.core import serialization
+
 logger = logging.getLogger(__name__)
 
 Snapshot = Dict[str, Any]
@@ -135,7 +137,9 @@ class SqliteStoreClient(StoreClient):
             row = c.execute(
                 "SELECT data FROM snapshot WHERE id = 1"
             ).fetchone()
-        return pickle.loads(row[0]) if row else None
+        # local trusted file, but unpickling still routes through the
+        # audited chokepoint (core/serialization.loads)
+        return serialization.loads(row[0]) if row else None
 
     def save(self, snapshot: Snapshot) -> None:
         blob = pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)

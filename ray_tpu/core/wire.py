@@ -163,7 +163,8 @@ def _exc_allowed(module: str, qualname: str) -> Optional[type]:
 
         try:
             mod = importlib.import_module(module)
-        except Exception:
+        except Exception as e:
+            logger.debug("exception allowlist import %s failed: %s", module, e)
             return None
         t = mod
         for part in qualname.split("."):
@@ -267,8 +268,11 @@ def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
         if t is not None:
             try:
                 return t(*args), pos
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug(
+                    "rebuilding %s.%s%r failed (%s); degrading to RpcError",
+                    module, qualname, tuple(args), e,
+                )
         from ray_tpu.core import rpc as _rpc
 
         return _rpc.RpcError(f"{module}.{qualname}{tuple(args)!r}"), pos

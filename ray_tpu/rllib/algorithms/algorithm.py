@@ -73,8 +73,12 @@ class Algorithm(Trainable):
         path = checkpoint if isinstance(checkpoint, str) else None
         if path is None:
             return
+        from ray_tpu.core import serialization
+
         with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
-            self.set_state(pickle.load(f))
+            # local checkpoint, but decode still routes through the
+            # audited unpickle chokepoint
+            self.set_state(serialization.loads(f.read()))
 
     def get_state(self) -> Dict[str, Any]:
         raise NotImplementedError

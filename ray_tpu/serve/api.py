@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import inspect
 import json
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -22,6 +23,8 @@ from ray_tpu.serve.controller import (
     ServeController,
 )
 from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
 
 _state: Dict[str, Any] = {}
 _state_lock = threading.Lock()
@@ -159,7 +162,9 @@ def start(http_options: Optional[HTTPOptions] = None, *, proxy: bool = True,
         if c is not None:
             try:
                 rt.get(c.ping.remote(), timeout=10)
-            except Exception:
+            except Exception as e:
+                logger.debug("cached serve controller dead (%s); "
+                             "resetting serve state", e)
                 _state.clear()
                 from ray_tpu.serve import handle as _handle_mod
 
@@ -447,13 +452,15 @@ def shutdown():
     if controller is None:
         try:
             controller = rt.get_actor(CONTROLLER_NAME, CONTROLLER_NAMESPACE)
-        except Exception:
+        except Exception as e:
+            logger.debug("no serve controller to shut down: %s", e)
             controller = None
     fleet_proxies: List[Any] = []
     if proxy is None:
         try:  # legacy single-proxy deployments
             proxy = rt.get_actor("SERVE_PROXY", CONTROLLER_NAMESPACE)
-        except Exception:
+        except Exception as e:
+            logger.debug("no legacy proxy to shut down: %s", e)
             proxy = None
         # per-node fleet: resolvable from anywhere via the KV address
         # map even when the controller itself is unreachable
@@ -467,15 +474,16 @@ def shutdown():
                         fleet_proxies.append(rt.get_actor(
                             f"SERVE_PROXY::{nid}", CONTROLLER_NAMESPACE
                         ))
-                    except Exception:
-                        pass
-        except Exception:
-            pass
+                    except Exception as e:
+                        logger.debug("fleet proxy %s gone: %s", nid, e)
+        except Exception as e:
+            logger.debug("fleet proxy discovery failed: %s", e)
     if grpc_proxy is None:
         try:
             grpc_proxy = rt.get_actor("SERVE_GRPC_PROXY",
                                       CONTROLLER_NAMESPACE)
-        except Exception:
+        except Exception as e:
+            logger.debug("no grpc proxy to shut down: %s", e)
             grpc_proxy = None
     try:
         from ray_tpu.core.runtime import get_runtime, is_initialized
@@ -484,27 +492,27 @@ def shutdown():
             get_runtime().kv_del("serve:http_address")
             get_runtime().kv_del("serve:http_addresses")
             get_runtime().kv_del("serve:grpc_address")
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("clearing serve address keys failed: %s", e)
     for p in (proxy, grpc_proxy, *fleet_proxies):
         if p is not None:
             try:
                 rt.get(p.stop.remote(), timeout=5)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("proxy stop failed: %s", e)
             try:
                 rt.kill(p)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("proxy kill failed: %s", e)
     if controller is not None:
         try:
             rt.get(controller.shutdown.remote(), timeout=30)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("controller shutdown call failed: %s", e)
         try:
             rt.kill(controller)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("controller kill failed: %s", e)
     # clear the FT snapshot only once the controller is dead: its own
     # _checkpoint calls would recreate the key, and a timed-out teardown
     # must not leave a snapshot that resurrects deleted apps on the next
@@ -515,8 +523,8 @@ def shutdown():
 
         if is_initialized():
             get_runtime().kv_del(STATE_KV_KEY)
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("clearing serve FT snapshot failed: %s", e)
     from ray_tpu.serve import handle as _h
 
     _h._close_routers()

@@ -15,6 +15,7 @@ are safe, while the reconcile loop runs on a dedicated daemon thread.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import traceback
@@ -23,6 +24,8 @@ from typing import Any, Dict, List, Optional
 import ray_tpu as rt
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.replica import Replica
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 CONTROLLER_NAMESPACE = "serve"
@@ -183,18 +186,20 @@ class ServeController:
             traceback.print_exc()
 
     def _recover(self):
-        import pickle
-
+        from ray_tpu.core import serialization
         from ray_tpu.core.runtime import get_runtime
 
         try:
             blob = get_runtime().kv_get(STATE_KV_KEY)
-        except Exception:
+        except Exception as e:
+            logger.debug("FT snapshot unavailable (%s); cold start", e)
             return
         if not blob:
             return
         try:
-            state = pickle.loads(blob)
+            # checkpoint blobs only ever come from this controller, and
+            # decode routes through the audited unpickle chokepoint
+            state = serialization.loads(blob)
         except Exception:
             traceback.print_exc()
             return
@@ -228,8 +233,11 @@ class ServeController:
                                     f"SERVE_REPLICA::{rid}",
                                     CONTROLLER_NAMESPACE,
                                 )
-                            except Exception:
-                                continue  # gone: reconcile replaces it
+                            except Exception as e:
+                                logger.debug("replica %s not resolvable "
+                                             "(%s); reconcile replaces it",
+                                             rid, e)
+                                continue
                             ds.replicas[rid] = _ReplicaState(
                                 rid, handle, ds.config.max_ongoing_requests
                             )
@@ -359,8 +367,8 @@ class ServeController:
         for handle, _addr in proxies:
             try:
                 rt.kill(handle)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("killing proxy during shutdown: %s", e)
         self._checkpoint()
         return True
 
@@ -498,8 +506,9 @@ class ServeController:
                 "msg": {"app": app_name, "deployment": name,
                         "version": version, "deleted": deleted},
             })
-        except Exception:
-            pass  # routers still converge via their periodic refresh
+        except Exception as e:
+            # routers still converge via their periodic refresh
+            logger.debug("route-change publish dropped: %s", e)
 
     # -- per-node proxy fleet -----------------------------------------
     def ensure_proxies(self, host: str, port: int) -> Dict[str, tuple]:
@@ -542,7 +551,8 @@ class ServeController:
         host, port = opts
         try:
             nodes = get_runtime().controller_call("get_nodes")
-        except Exception:
+        except Exception as e:
+            logger.debug("get_nodes failed (%s); proxy fleet unchanged", e)
             return
         alive = {n["node_id"] for n in nodes if n.get("alive", True)}
         changed = False
@@ -554,19 +564,20 @@ class ServeController:
             changed = True
             try:
                 rt.kill(handle)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("killing proxy of dead node %s: %s", nid, e)
         # health-check the live fleet; a dead proxy actor is replaced
         for nid, (handle, _addr) in list(fleet.items()):
             try:
                 rt.get(handle.num_requests.remote(), timeout=10)
-            except Exception:
+            except Exception as e:
+                logger.debug("proxy on %s unhealthy (%s); replacing", nid, e)
                 del fleet[nid]
                 changed = True
                 try:
                     rt.kill(handle)
-                except Exception:
-                    pass
+                except Exception as e2:
+                    logger.debug("killing unhealthy proxy: %s", e2)
         for nid in alive - set(fleet):
             # the configured port goes to the FIRST proxy; the rest
             # bind ephemeral ports (nodes share a host in test
@@ -589,8 +600,8 @@ class ServeController:
                     first = sorted(addrs)[0]
                     kv.kv_put("serve:http_address",
                               _json.dumps(addrs[first]).encode())
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("publishing proxy addresses failed: %s", e)
 
     def _start_proxy(self, node_id: str, host: str, port: int):
         from ray_tpu.serve.proxy import HTTPProxy
@@ -609,7 +620,9 @@ class ServeController:
             return (handle, (host, bound))
         except ValueError:
             pass
-        except Exception:
+        except Exception as e:
+            logger.debug("adopting existing proxy on %s failed: %s",
+                         node_id, e)
             return None
         try:
             handle = (
@@ -682,7 +695,9 @@ class ServeController:
                         if r.state == STARTING:
                             r.state = RUNNING
                             changed = True
-                    except Exception:
+                    except Exception as e:
+                        logger.debug("replica %s failed health check: %s",
+                                     rid, e)
                         del ds.replicas[rid]
                         changed = True
                         self._kill_quietly(r)
@@ -750,15 +765,15 @@ class ServeController:
         try:
             ref = r.handle.drain.remote(timeout_s)
             rt.wait([ref], timeout=timeout_s + 1.0)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("drain of %s failed: %s", r.replica_id, e)
         self._kill_quietly(r)
 
     def _kill_quietly(self, r: _ReplicaState):
         try:
             rt.kill(r.handle)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("killing replica %s: %s", r.replica_id, e)
 
     # -- autoscaling --------------------------------------------------
     def _autoscale(self):
@@ -796,8 +811,8 @@ class ServeController:
                 for ref in done:
                     try:
                         total_ongoing += rt.get(ref)["ongoing"]
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("ongoing-count probe failed: %s", e)
             now = time.monotonic()
             # smooth over look_back_period_s (reference: the autoscaling
             # policy averages handle metrics over a look-back window) so

@@ -128,7 +128,9 @@ class GRPCProxy:
             value = await handle.remote(
                 Request("GRPC", method, {}, request or b"")
             )
-        except Exception as e:  # noqa: BLE001 — boundary to gRPC status
+        except Exception as e:  # rtlint: disable=RT005
+            # boundary to gRPC: ctx.abort() RAISES, surfacing e as the
+            # call's INTERNAL status — nothing is swallowed
             await ctx.abort(grpc.StatusCode.INTERNAL, str(e))
         if isinstance(value, Response) and not (
             200 <= value.status_code < 300

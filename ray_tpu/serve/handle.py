@@ -17,12 +17,15 @@ io loop from inside it.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, Optional
 
 import ray_tpu as rt
 from ray_tpu.serve.router import Router
+
+logger = logging.getLogger(__name__)
 
 _routers: Dict[tuple, Router] = {}
 _routers_lock = threading.Lock()
@@ -46,8 +49,8 @@ def _close_routers():
     if sub is not None:
         try:
             sub.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("closing route-watch subscription: %s", e)
 
 
 def _ensure_route_watcher():
@@ -74,7 +77,9 @@ def _route_watch_main():
         from ray_tpu.core.runtime import get_runtime
 
         sub = get_runtime().subscribe("serve:routes")
-    except Exception:
+    except Exception as e:
+        logger.debug("route-watch subscribe failed (%s); routers fall "
+                     "back to periodic refresh", e)
         with _routers_lock:
             if _route_watch.get("thread") is threading.current_thread():
                 _route_watch["thread"] = None
@@ -89,8 +94,8 @@ def _route_watch_main():
     if sub_stale is not None:
         try:
             sub_stale.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("closing stale route-watch subscription: %s", e)
         return
     _route_watch_loop(sub)
 
@@ -103,7 +108,9 @@ def _route_watch_loop(sub):
             msg = sub.next_message(timeout=1.0)
         except _q.Empty:
             continue
-        except Exception:
+        except Exception as e:
+            logger.debug("route-watch subscription broke (%s); exiting "
+                         "watcher", e)
             return
         if not isinstance(msg, dict):
             continue
@@ -115,8 +122,9 @@ def _route_watch_loop(sub):
         try:
             if msg.get("deleted") or msg.get("version", -1) > r._version:
                 r._refresh(force=True)
-        except Exception:
-            pass  # next push or periodic refresh retries
+        except Exception as e:
+            # next push or periodic refresh retries
+            logger.debug("pushed route refresh failed: %s", e)
 
 
 def _on_runtime_loop() -> bool:

@@ -30,6 +30,7 @@ reusable part.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time as _time
@@ -38,6 +39,8 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 # per-tick phase timing to stdout (the tool that found the
@@ -311,6 +314,8 @@ class LlamaEngine:
                           f"read+harvest={1e3*(t3-t2):.0f}ms "
                           f"active={na} free={nf}", flush=True)
             except Exception as e:  # engine must not die silently
+                logger.exception("llm engine tick failed; failing %d "
+                                 "active request(s)", len(self._active))
                 self._pending_toks = None
                 with self._lock:
                     for slot, req in list(self._active.items()):

@@ -30,8 +30,10 @@ MODEL_ID_KWARG = "__serve_model_id__"
 
 def _set_model_id(model_id: str):
     # contextvars are per-thread AND per-asyncio-task: the replica sets
-    # this on the exact thread/task that runs the user code
-    _current_model_id.set(model_id)
+    # this on the exact thread/task that runs the user code, and
+    # overwrites at every request start — a reset token would restore a
+    # PREVIOUS request's model id, which is exactly the leak to avoid
+    _current_model_id.set(model_id)  # rtlint: disable=RT006
 
 
 def get_multiplexed_model_id() -> str:

@@ -108,8 +108,8 @@ class HTTPProxy:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("closing http client connection: %s", e)
 
     async def _read_request(self, reader) -> Optional[Request]:
         try:

@@ -17,6 +17,7 @@ reference's asyncio router embedded in handles.
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import threading
 import time
@@ -25,6 +26,8 @@ from typing import Any, Dict, List, Optional
 import ray_tpu as rt
 from ray_tpu import exceptions as _exc
 from ray_tpu.core import rpc as _rpc
+
+logger = logging.getLogger(__name__)
 
 
 class _ReplicaInfo:
@@ -85,8 +88,8 @@ class Router:
                 from ray_tpu.core.runtime import get_runtime
 
                 get_runtime().loop.call_soon_threadsafe(task.cancel)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("cancelling deferred refresh: %s", e)
 
     # -- routing table maintenance ------------------------------------
     def _install_table(self, table):
@@ -195,8 +198,9 @@ class Router:
             return
         try:
             await self._refresh_async(force=True)
-        except Exception:
-            pass  # stats are advisory; the next refresh re-reports
+        except Exception as e:
+            # stats are advisory; the next refresh re-reports
+            logger.debug("deferred table refresh failed: %s", e)
 
     async def _refresh_async(self, force: bool = False):
         if not self._needs_refresh(force):
@@ -345,7 +349,9 @@ class Router:
             healthy."""
             try:
                 err = _error_from_envelope(envelope)
-            except Exception:
+            except Exception as e:
+                logger.debug("undecodable error envelope (%s); treating "
+                             "as user-level success", e)
                 return "success"
             if isinstance(err, (
                 _exc.ActorDiedError, _exc.ActorUnavailableError,

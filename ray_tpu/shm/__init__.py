@@ -10,10 +10,14 @@ offsets the C side hands back.
 from __future__ import annotations
 
 import ctypes
+import logging
 import mmap
 import os
+import re
 import subprocess
 import threading
+
+logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "shmstore.cc")
@@ -87,6 +91,60 @@ def _load():
                 getattr(lib, fn).argtypes = [p]
             _lib = lib
     return _lib
+
+
+# Store names carry their owning daemon pid as a ".<pid>" suffix
+# (noded appends it at creation) so a later boot can tell an orphan —
+# a segment whose owner was SIGKILLed before it could unlink — from a
+# live neighbor's store on the same host.
+_OWNER_SUFFIX_RE = re.compile(r"\.(\d+)$")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        # EPERM and friends: the pid exists but belongs to someone else
+        return True
+    return True
+
+
+def sweep_stale_segments(prefix: str = "rt_",
+                         shm_dir: str = "/dev/shm") -> list:
+    """Reap `/dev/shm/<prefix>*` segments whose owning session pid is
+    dead (VERDICT Weak #6: a SIGKILLed daemon never unlinks its store,
+    and leaked segments eat the shared host's shm budget forever).
+
+    Only segments carrying an owner-pid suffix are judged; anything
+    else (foreign naming schemes, pre-suffix legacy segments) is left
+    alone.  Returns the names removed."""
+    removed = []
+    try:
+        entries = os.listdir(shm_dir)
+    except OSError as e:
+        logger.debug("cannot list %s: %s", shm_dir, e)
+        return removed
+    for name in entries:
+        if not name.startswith(prefix):
+            continue
+        m = _OWNER_SUFFIX_RE.search(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid <= 0 or _pid_alive(pid):
+            continue
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+        except OSError as e:
+            logger.debug("could not reap stale segment %s: %s", name, e)
+            continue
+        removed.append(name)
+    if removed:
+        logger.info("reaped %d stale shm segment(s) from dead sessions: %s",
+                    len(removed), ", ".join(sorted(removed)))
+    return removed
 
 
 class ShmStoreError(Exception):

@@ -45,10 +45,10 @@ class Checkpoint:
         return ck
 
     def to_dict(self) -> Dict[str, Any]:
-        import pickle
+        from ray_tpu.core import serialization
 
         with open(os.path.join(self.path, "state.pkl"), "rb") as f:
-            return pickle.load(f)
+            return serialization.loads(f.read())
 
     # -- directory access ----------------------------------------------
     def to_directory(self, path: Optional[str] = None) -> str:

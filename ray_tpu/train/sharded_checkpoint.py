@@ -190,8 +190,10 @@ def load_sharded(dir_: str, target: Any) -> Any:
         manifest = json.load(f)
     aux: Dict[str, Any] = {}
     if os.path.exists(os.path.join(dir_, _AUX)):
+        from ray_tpu.core import serialization
+
         with open(os.path.join(dir_, _AUX), "rb") as f:
-            aux = pickle.load(f)
+            aux = serialization.loads(f.read())
     reader = _PieceReader(dir_, manifest.get("num_processes"))
 
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(target)

@@ -95,6 +95,7 @@ def test_put_get_roundtrip(cluster):
 
 
 def test_large_object_via_shm(cluster):
+    np.random.seed(0)
     arr = np.random.rand(512, 1024).astype(np.float32)
     ref = rt.put(arr)
     out = rt.get(ref)

@@ -255,3 +255,46 @@ def test_cluster_status_includes_task_summary(dash):
     assert status == 200
     doc = json.loads(body)
     assert "task_summary" in doc and isinstance(doc["task_summary"], dict)
+
+
+# ----------------------------------------------------------------------
+# SPA JS syntax gate (VERDICT Weak #7): the inline <script> blocks are
+# never executed by any tier-1 test, so typo-class breakage (stray
+# brace, unterminated template literal) would only surface as a blank
+# dashboard in production.  Tokenize them instead — no cluster needed.
+# ----------------------------------------------------------------------
+def _app_html():
+    import pathlib
+
+    return (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "ray_tpu" / "dashboard" / "app.html"
+    ).read_text()
+
+
+def test_spa_js_passes_syntax_gate():
+    from ray_tpu.lint.jscheck import check_js, extract_scripts
+
+    scripts = extract_scripts(_app_html())
+    assert scripts, "app.html lost its inline <script> block"
+    for start_line, src in scripts:
+        errs = check_js(src)
+        assert not errs, (
+            f"<script> at app.html:{start_line} has syntax errors "
+            f"(line numbers are script-relative): {errs}"
+        )
+
+
+def test_js_gate_catches_typo_classes():
+    """The gate must actually fail on the breakage it exists for."""
+    from ray_tpu.lint.jscheck import check_js, extract_scripts
+
+    _start, src = extract_scripts(_app_html())[0]
+    for mutation, expect in [
+        (src + "\nfunction broken() { if (x) {\n", "unclosed"),
+        (src + "\nconst t = `oops ${1+2;\n", "unclosed"),
+        (src + "\nconst s = 'unterminated;\nlet x = 1;", "unterminated"),
+        (src.replace("{", "[", 1), "mismatched"),
+    ]:
+        errs = check_js(mutation)
+        assert errs and any(expect in e for e in errs), (expect, errs[:3])

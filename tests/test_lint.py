@@ -1,0 +1,702 @@
+"""rtlint suite: per-rule positive/negative fixtures, suppressions,
+the baseline protocol, and the tier-1 repo gate.
+
+The gate test is the enforcement point for the runtime's concurrency /
+wire-safety / fault-tolerance contracts: it lints the WHOLE repo
+against the checked-in `lint_baseline.json` and fails on any finding
+not grandfathered there — so a new `pickle.loads` in `core/noded.py`
+or a `with lock: await ...` in `serve/router.py` fails tier-1.
+"""
+
+import os
+import pathlib
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import (
+    compare_to_baseline,
+    default_baseline_path,
+    lint_paths,
+    load_baseline,
+    rule_catalog,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _lint_snippet(tmp_path, code, rel="ray_tpu/core/mod.py", select=None):
+    """Write `code` at `rel` under a scratch root and lint it."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(code))
+    return lint_paths([str(p)], root=str(tmp_path), select=select)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ----------------------------------------------------------------------
+# one positive + one negative fixture per rule
+# ----------------------------------------------------------------------
+def test_rt001_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """,
+    )
+    assert _rules(out) == {"RT001"}
+
+
+def test_rt001_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+        import time
+
+        async def handler():
+            await asyncio.sleep(0.1)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, time.sleep, 0.1)
+
+        def sync_fn():
+            time.sleep(0.1)  # fine outside async
+        """,
+    )
+    assert "RT001" not in _rules(out)
+
+
+def test_rt001_nested_sync_def_exempt(tmp_path):
+    # a sync closure is typically shipped to an executor — not flagged
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            def work():
+                time.sleep(0.1)
+            return work
+        """,
+    )
+    assert "RT001" not in _rules(out)
+
+
+def test_rt002_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+        import threading
+
+        lock = threading.Lock()
+
+        async def handler():
+            with lock:
+                await asyncio.sleep(0.1)
+        """,
+    )
+    assert "RT002" in _rules(out)
+
+
+def test_rt002_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+        import threading
+
+        lock = threading.Lock()
+        alock = asyncio.Lock()
+
+        async def handler():
+            with lock:
+                x = 1  # no await while held
+            async with alock:
+                await asyncio.sleep(0.1)  # asyncio lock: fine
+        """,
+    )
+    assert "RT002" not in _rules(out)
+
+
+def test_rt003_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def two():
+            with b_lock:
+                with a_lock:
+                    pass
+        """,
+    )
+    assert "RT003" in _rules(out)
+
+
+def test_rt003_cross_module(tmp_path):
+    # the graph is global: each module alone is consistent, together
+    # they deadlock
+    (tmp_path / "ray_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "ray_tpu/m1.py").write_text(textwrap.dedent(
+        """
+        from ray_tpu.locks import a_lock, b_lock
+
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+        """
+    ))
+    (tmp_path / "ray_tpu/m2.py").write_text(textwrap.dedent(
+        """
+        from ray_tpu.locks import a_lock, b_lock
+
+        def two():
+            with b_lock:
+                with a_lock:
+                    pass
+        """
+    ))
+    out = lint_paths([str(tmp_path / "ray_tpu")], root=str(tmp_path))
+    assert "RT003" in _rules(out)
+
+
+def test_rt003_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def two():
+            with a_lock:
+                with b_lock:
+                    pass  # same global order: consistent
+        """,
+    )
+    assert "RT003" not in _rules(out)
+
+
+def test_rt004_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import pickle
+
+        def handle(blob):
+            return pickle.loads(blob)
+        """,
+        rel="ray_tpu/core/noded.py",
+    )
+    assert "RT004" in _rules(out)
+
+
+def test_rt004_negative(tmp_path):
+    # serialization.py is the audited chokepoint; tests/ may pickle
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import pickle
+
+        def loads(blob):
+            return pickle.loads(blob)
+        """,
+        rel="ray_tpu/core/serialization.py",
+    )
+    assert "RT004" not in _rules(out)
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import pickle
+
+        def test_roundtrip():
+            assert pickle.loads(pickle.dumps(1)) == 1
+        """,
+        rel="tests/test_x.py",
+    )
+    assert "RT004" not in _rules(out)
+
+
+def test_rt005_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        """,
+    )
+    assert "RT005" in _rules(out)
+
+
+def test_rt005_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def f():
+            try:
+                risky()
+            except Exception as e:
+                logger.debug("risky failed: %s", e)
+            try:
+                risky()
+            except KeyError:
+                pass  # narrow type: a legal fix
+            try:
+                risky()
+            except Exception:
+                raise
+        """,
+    )
+    assert "RT005" not in _rules(out)
+
+
+def test_rt006_positive_retry_loop(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        def f():
+            while True:
+                try:
+                    return connect()
+                except Exception:
+                    raise_if_done()
+                    time.sleep(0.2)
+        """,
+    )
+    assert "RT006" in _rules(out)
+
+
+def test_rt006_positive_token_drop(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import contextvars
+
+        deadline = contextvars.ContextVar("deadline", default=None)
+
+        def f(v):
+            deadline.set(v)
+        """,
+    )
+    assert "RT006" in _rules(out)
+
+
+def test_rt006_cross_module_token_drop(tmp_path):
+    # the ISSUE case: an rpc helper importing the runtime's ambient
+    # deadline ContextVar and dropping the reset token
+    (tmp_path / "ray_tpu/core").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "ray_tpu/core/runtime.py").write_text(textwrap.dedent(
+        """
+        import contextvars
+
+        _ambient_deadline = contextvars.ContextVar("d", default=None)
+        """
+    ))
+    (tmp_path / "ray_tpu/core/rpc.py").write_text(textwrap.dedent(
+        """
+        from ray_tpu.core.runtime import _ambient_deadline
+
+        def helper(v):
+            _ambient_deadline.set(v)
+
+        def careful(v):
+            tok = _ambient_deadline.set(v)
+            _ambient_deadline.reset(tok)
+        """
+    ))
+    out = lint_paths([str(tmp_path / "ray_tpu")], root=str(tmp_path))
+    rt6 = [f for f in out if f.rule == "RT006"]
+    assert len(rt6) == 1 and rt6[0].path == "ray_tpu/core/rpc.py"
+    # an imported non-ContextVar with a .set() method is not flagged
+    (tmp_path / "ray_tpu/core/rpc.py").write_text(textwrap.dedent(
+        """
+        from ray_tpu.core.config import settings
+
+        def helper(v):
+            settings.set(v)
+        """
+    ))
+    out = lint_paths([str(tmp_path / "ray_tpu")], root=str(tmp_path))
+    assert "RT006" not in _rules(out)
+
+
+def test_rt006_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import contextvars
+        import time
+
+        from ray_tpu.core.retry import backoff_delay_s
+
+        deadline = contextvars.ContextVar("deadline", default=None)
+
+        def f(v):
+            tok = deadline.set(v)
+            try:
+                pass
+            finally:
+                deadline.reset(tok)
+
+        def g():
+            for attempt in range(5):
+                try:
+                    return connect()
+                except Exception:
+                    log(attempt)
+                    time.sleep(backoff_delay_s(
+                        attempt, base_s=0.05, cap_s=2.0))
+        """,
+    )
+    assert "RT006" not in _rules(out)
+
+
+def test_rt007_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            print("tracing", x)
+            return x + np.random.rand()
+        """,
+    )
+    assert "RT007" in _rules(out)
+
+
+def test_rt007_donated_reuse(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make(f):
+            g = jax.jit(f, donate_argnums=(0,))
+
+            def run(buf):
+                y = g(buf)
+                return buf + y  # buf was donated: freed device memory
+            return run
+        """,
+    )
+    assert "RT007" in _rules(out)
+
+
+def test_rt007_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, key):
+            return x + jax.random.normal(key, x.shape)
+
+        def host_fn(x):
+            print("fine outside jit", x)
+            return jnp.sum(x)
+
+        def make(f):
+            g = jax.jit(f, donate_argnums=(0,))
+
+            def run(buf):
+                buf = g(buf)  # rebound: later use is the NEW buffer
+                return buf + 1
+            return run
+        """,
+    )
+    assert "RT007" not in _rules(out)
+
+
+def test_rt008_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import random
+
+        def test_thing():
+            assert random.randint(0, 10) >= 0
+        """,
+        rel="tests/test_x.py",
+    )
+    assert "RT008" in _rules(out)
+
+
+def test_rt008_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import random
+
+        random.seed(1234)
+
+        def test_thing():
+            assert random.randint(0, 10) >= 0
+        """,
+        rel="tests/test_x.py",
+    )
+    assert "RT008" not in _rules(out)
+    # non-test code is out of scope for RT008
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import random
+
+        def jitter():
+            return random.random()
+        """,
+        rel="ray_tpu/util/jitter.py",
+    )
+    assert "RT008" not in _rules(out)
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+def test_inline_suppression(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # rtlint: disable=RT001
+        """,
+    )
+    assert "RT001" not in _rules(out)
+
+
+def test_inline_suppression_is_rule_specific(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # rtlint: disable=RT005
+        """,
+    )
+    assert "RT001" in _rules(out)  # wrong rule id: not suppressed
+
+
+def test_file_suppression(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        # rtlint: disable-file=RT004
+        import pickle
+
+        def a(blob):
+            return pickle.loads(blob)
+
+        def b(blob):
+            return pickle.loads(blob)
+        """,
+        rel="ray_tpu/core/noded.py",
+    )
+    assert "RT004" not in _rules(out)
+
+
+def test_suppression_in_string_is_ignored(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        MSG = "rtlint: disable=RT001"
+
+        async def handler():
+            time.sleep(0.1)
+        """,
+    )
+    assert "RT001" in _rules(out)
+
+
+# ----------------------------------------------------------------------
+# baseline protocol
+# ----------------------------------------------------------------------
+def test_baseline_regression_detection(tmp_path):
+    code = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+    """
+    out = _lint_snippet(tmp_path, code)
+    assert len(out) == 1
+    # grandfathered: not new
+    new, shrunk = compare_to_baseline(out, {out[0].key: 1})
+    assert new == [] and shrunk == {}
+    # a SECOND violation in the same bucket is new
+    out2 = _lint_snippet(
+        tmp_path,
+        code + """
+    def g():
+        try:
+            risky()
+        except Exception:
+            pass
+    """,
+    )
+    new, _ = compare_to_baseline(out2, {out2[0].key: 1})
+    assert len(new) == 2  # the whole grown bucket surfaces
+    # burn-down shrinks the bucket: passes, reported as shrunk
+    new, shrunk = compare_to_baseline(out, {out[0].key: 2})
+    assert new == [] and shrunk == {out[0].key: (1, 2)}
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    out = _lint_snippet(tmp_path, "def broken(:\n")
+    assert _rules(out) == {"RT000"}
+
+
+# ----------------------------------------------------------------------
+# the tier-1 gate
+# ----------------------------------------------------------------------
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _repo_findings():
+    return tuple(lint_paths(
+        [str(REPO / "ray_tpu"), str(REPO / "tests")], root=str(REPO)
+    ))
+
+
+def test_repo_is_lint_clean_against_baseline():
+    """THE gate: any invariant violation not in lint_baseline.json
+    fails tier-1."""
+    findings = _repo_findings()
+    baseline = load_baseline(default_baseline_path())
+    new, _shrunk = compare_to_baseline(findings, baseline)
+    assert not new, (
+        "new rtlint finding(s) — fix them or (for a deliberate "
+        "exception) add an inline `# rtlint: disable=<RULE>` with a "
+        "rationale:\n" + "\n".join(str(f) for f in new)
+    )
+
+
+def test_baseline_has_no_core_or_serve_rt001_rt002_rt005():
+    """The burned-down invariants stay burned down: the baseline may
+    never re-grandfather RT001/RT002/RT005 debt in core/ or serve/."""
+    baseline = load_baseline(default_baseline_path())
+    offenders = [
+        k
+        for k in baseline
+        if k.split("::")[1] in ("RT001", "RT002", "RT005")
+        and (
+            k.startswith("ray_tpu/core/") or k.startswith("ray_tpu/serve/")
+        )
+    ]
+    assert not offenders, offenders
+
+
+def test_baseline_never_grandfathers_parse_errors():
+    """RT000 means the file got ZERO invariant checking — it must not
+    be writable into the baseline."""
+    from ray_tpu.lint import Finding
+    from ray_tpu.lint.framework import render_baseline
+
+    doc = render_baseline(
+        [Finding("RT000", "ray_tpu/broken.py", 1, 0, "parse error")]
+    )
+    assert "RT000" not in doc
+
+
+def test_seeded_violations_fail_the_gate(tmp_path):
+    """Acceptance probe: a deliberate violation of each rule, planted
+    in a mirror of the real tree, is caught as NEW against the real
+    baseline (proving the gate can't be satisfied by line churn)."""
+    plants = {
+        "ray_tpu/core/noded.py": """
+            import pickle
+
+            def handle(blob):
+                return pickle.loads(blob)
+            """,
+        "ray_tpu/serve/router.py": """
+            import asyncio
+            import threading
+
+            lock = threading.Lock()
+
+            async def route():
+                with lock:
+                    await asyncio.sleep(0.1)
+            """,
+        "ray_tpu/core/runtime.py": """
+            import time
+
+            async def tick():
+                time.sleep(1)
+
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass
+            """,
+    }
+    findings = []
+    for rel, code in plants.items():
+        findings.extend(_lint_snippet(tmp_path, code, rel=rel))
+    assert {"RT001", "RT002", "RT004", "RT005"} <= _rules(findings)
+    baseline = load_baseline(default_baseline_path())
+    new, _ = compare_to_baseline(findings, baseline)
+    assert {f.rule for f in new} >= {"RT001", "RT002", "RT004", "RT005"}
+
+
+def test_rule_catalog_complete():
+    rules = [r for r, _n, _d in rule_catalog()]
+    assert rules == [f"RT00{i}" for i in range(1, 9)]
+
+
+def test_cli_runs_clean():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint"],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout

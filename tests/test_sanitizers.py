@@ -23,6 +23,12 @@ def test_shmstore_under_sanitizers():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "sanitizers clean" in proc.stdout
+    # all three passes actually ran
+    for pass_marker in ("== TSAN ==", "== ASAN+UBSAN ==", "== UBSAN =="):
+        assert pass_marker in proc.stdout, proc.stdout
     # sanity: a sanitizer report would have printed WARNING/ERROR
     assert "WARNING: ThreadSanitizer" not in proc.stdout + proc.stderr
     assert "ERROR: AddressSanitizer" not in proc.stdout + proc.stderr
+    # UBSAN reports print "runtime error:" (and the standalone pass
+    # traps via -fno-sanitize-recover, failing the returncode assert)
+    assert "runtime error:" not in proc.stdout + proc.stderr
