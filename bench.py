@@ -127,7 +127,7 @@ def bench_llama_lora() -> None:
     )
 
 
-def bench_serve_llm(continuous: bool = False) -> None:
+def bench_serve_llm(continuous: bool = False, replicas: int = 1) -> None:
     """BASELINE config #5 analog: a Llama replica behind serve, driven
     through the FULL data plane (HTTP proxy -> pow-2 router -> replica
     -> @serve.batch -> KV-cached generate), closed-loop clients at
@@ -149,6 +149,14 @@ def bench_serve_llm(continuous: bool = False) -> None:
     pattern): requests join a resident decode batch mid-flight, so the
     denominator stays the gather-config's bare ceiling and vs_baseline
     directly shows the scheduling win.
+
+    `replicas=N` (continuous mode) deploys N engine replicas behind the
+    queue-depth-aware router — the scale-out axis once one replica's
+    tick rate saturates a core (PERF.md: ~2,370 tok/s single-replica
+    ceiling).  Concurrency levels and request counts scale with N so
+    the fleet actually saturates; `vs_baseline` stays against ONE
+    bare-generate replica, so N-replica aggregate shows directly as
+    >1.
     """
     import concurrent.futures as cf
     import statistics
@@ -175,6 +183,9 @@ def bench_serve_llm(continuous: bool = False) -> None:
         lines = [ln for ln in probe.stdout.splitlines() if ln.strip()]
         on_tpu = bool(lines) and lines[-1].strip() == "tpu"
 
+    if replicas > 1 and not continuous:
+        raise ValueError("--replicas applies to the continuous "
+                         "(serve_llm_cb) config")
     if on_tpu:
         # max_batch 16 measured BEST through the full data plane even
         # though bare generate keeps scaling (B=16/32/64 -> 1847/2622/
@@ -193,6 +204,11 @@ def bench_serve_llm(continuous: bool = False) -> None:
         levels = (1, 4, 8)
         metric = ("serve_llm_cb_tokens_per_sec_cpu" if continuous
                   else "serve_llm_tokens_per_sec_cpu")
+    if replicas > 1:
+        # saturation needs proportional offered load; keep the ladder's
+        # lower rungs for the latency picture
+        levels = tuple(c * replicas for c in levels)
+        metric += f"_x{replicas}"
 
     import ray_tpu as rt
     from ray_tpu import serve
@@ -205,16 +221,18 @@ def bench_serve_llm(continuous: bool = False) -> None:
     try:
         if continuous:
             app = ContinuousLlamaService.options(
-                num_replicas=1, autoscaling_config=None,
+                num_replicas=replicas, autoscaling_config=None,
                 max_ongoing_requests=256,
                 health_check_timeout_s=120.0,
             ).bind(model_size=model_size, max_new_tokens=n_new,
                    slots=(32 if on_tpu else 4),
                    chunk=(8 if on_tpu else 2),
-                   # ring sized to the workload (prompt + budget +
-                   # chunk slack), NOT the model's max_seq_len — an
-                   # oversized ring taxes every decode step
+                   # max_len caps ONE sequence (prompt + budget + chunk
+                   # slack).  The KV cache is paged now, so this no
+                   # longer taxes per-step time — but it still sizes
+                   # the default pool budget (HBM)
                    max_len=prompt_len + n_new + (8 if on_tpu else 2) + 8,
+                   block_size=(16 if on_tpu else 8),
                    jax_platform=(None if on_tpu else "cpu"))
         else:
             app = LlamaService.options(
@@ -297,12 +315,16 @@ def bench_serve_llm(continuous: bool = False) -> None:
               f" serve overhead at best level: {1 - best / bare_tok_s:+.1%};"
               f" TTFT p50 {statistics.median(ttft) * 1e3:.0f} ms",
               file=sys.stderr)
-        print(json.dumps({
+        record = {
             "metric": metric,
             "value": round(best, 2),
             "unit": "tokens/s",
             "vs_baseline": round(best / bare_tok_s / 0.85, 4),
-        }))
+        }
+        if replicas > 1:
+            record["replicas"] = replicas
+            record["per_replica_tokens_per_sec"] = round(best / replicas, 2)
+        print(json.dumps(record))
     finally:
         serve.shutdown()
         rt.shutdown()
@@ -316,7 +338,13 @@ def main() -> None:
                    choices=["gpt2", "llama_lora", "serve_llm",
                             "serve_llm_cb"],
                    default="gpt2")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve_llm_cb only: deploy N engine replicas "
+                        "behind the queue-depth-aware router and "
+                        "saturate the fleet")
     args = p.parse_args()
+    if args.replicas > 1 and args.config != "serve_llm_cb":
+        p.error("--replicas applies only to --config serve_llm_cb")
     if args.config == "llama_lora":
         bench_llama_lora()
         return
@@ -324,7 +352,7 @@ def main() -> None:
         bench_serve_llm()
         return
     if args.config == "serve_llm_cb":
-        bench_serve_llm(continuous=True)
+        bench_serve_llm(continuous=True, replicas=args.replicas)
         return
     bench_gpt2()
 

@@ -103,6 +103,7 @@ class LlamaService:
 
     def __init__(self, model_size: str = "tiny", max_new_tokens: int = 16,
                  seed: int = 0, max_batch_size: int = 8,
+                 bucket_fill_timeout_s: Optional[float] = None,
                  jax_platform: Optional[str] = None):
         import jax
 
@@ -124,9 +125,17 @@ class LlamaService:
         # larger max_new_tokens at deploy time to allow longer asks)
         self.max_new_tokens_limit = max_new_tokens
         self._max_batch_size = max_batch_size
-        # instance-level batching config consumed by @serve.batch
+        # instance-level batching config consumed by @serve.batch.
+        # bucket_fill_timeout_s (opt-in): once a gathering batch sits
+        # at an upper pow-2 boundary, flush after this wait instead of
+        # letting stragglers re-pad it into the next bucket (the
+        # serialized 32+16 ragged pair that capped max_batch at 16 in
+        # PERF.md's serve sweep)
         self.__serve_batch_overrides__ = {
-            "_generate_batch": {"max_batch_size": max_batch_size},
+            "_generate_batch": {
+                "max_batch_size": max_batch_size,
+                "bucket_fill_timeout_s": bucket_fill_timeout_s,
+            },
         }
 
     @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
@@ -243,11 +252,15 @@ class ContinuousLlamaService:
     gather-batching whole generations — the decode batch stays full,
     so weight reads amortize over every active sequence.  Measured
     nearly 2x the gather-batched throughput at the same shapes
-    (PERF.md round 5)."""
+    (PERF.md round 5).  The engine's KV cache is PAGED (block pool +
+    radix prefix cache), so `max_len` only caps one sequence — an
+    over-provisioned pool costs HBM, not per-step time — and requests
+    sharing a prompt prefix (system prompts) skip its prefill."""
 
     def __init__(self, model_size: str = "tiny", max_new_tokens: int = 16,
                  seed: int = 0, slots: int = 32, chunk: int = 8,
-                 max_len: Optional[int] = None,
+                 max_len: Optional[int] = None, block_size: int = 16,
+                 kv_blocks: Optional[int] = None, prefix_cache: bool = True,
                  jax_platform: Optional[str] = None):
         import jax
 
@@ -257,13 +270,10 @@ class ContinuousLlamaService:
         from ray_tpu.serve.llm_engine import LlamaEngine
 
         cfg, params = _build_model(model_size, seed)
-        # SIZE THE RING TO THE WORKLOAD: every decode step attends
-        # over all max_len cache slots of every slot row, so an
-        # oversized ring taxes each step (and slots x max_len x layers
-        # of HBM) regardless of occupancy — a 1024-ring at 32 slots is
-        # 5.9 GB of cache on a 1.4B model vs 1.1 GB for a 192-ring
         self.engine = LlamaEngine(
-            cfg, params, slots=slots, chunk=chunk, max_len=max_len
+            cfg, params, slots=slots, chunk=chunk, max_len=max_len,
+            block_size=block_size, kv_blocks=kv_blocks,
+            prefix_cache=prefix_cache,
         )
         self.max_new_tokens = max_new_tokens
         self.max_new_tokens_limit = max_new_tokens
@@ -285,7 +295,11 @@ class ContinuousLlamaService:
         n_new = int(body.get("max_new_tokens", self.max_new_tokens))
         return {"tokens": await self.generate(body["tokens"], n_new)}
 
-    def engine_stats(self):
+    def stats(self):
+        """Queue-depth/TTFT/occupancy signals, piggybacked by the serve
+        replica onto health checks: the controller feeds `queue_depth`
+        into routing tables (queue-depth-aware pow-2 across replicas)
+        and the rest into /api/serve."""
         return self.engine.stats()
 
     def bench_direct(self, batch: int, prompt_len: int,

@@ -338,6 +338,78 @@ def merge_lora(cfg: LlamaConfig, params: Dict, lora: Dict) -> Dict:
 # ----------------------------------------------------------------------
 # KV-cached decoding (the serving inference path)
 # ----------------------------------------------------------------------
+def forward_with_prefix(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
+                        prefix_kv, prefix_len):
+    """Suffix forward over an existing prefix KV cache (radix prefix
+    reuse: the paged engine's cache-hit prefill path).
+
+    `tokens` [B, S] is the prompt SUFFIX, living at absolute positions
+    `prefix_len`..`prefix_len + S - 1`; `prefix_kv` = (k, v), each
+    [L, B, Pmax, KV, hd], the gathered (possibly padded) KV of the
+    shared prefix — columns at or beyond `prefix_len` are masked out,
+    so block-table padding rows cost nothing but FLOPs.  Returns
+    (full-suffix logits [B, S, vocab] f32, (k_suf, v_suf) each
+    [L, B, S, KV, hd]) — the suffix KV the caller writes into its own
+    cache blocks.
+
+    Numerics deliberately mirror `forward`'s dense path
+    (`plain_attention`: same einsum forms, same -1e30 mask, softmax in
+    the compute dtype) so a prefix-cached prefill produces the same
+    greedy tokens as the full-prompt prefill it replaces;
+    `tests/test_llm_engine.py` pins the equivalence.
+    """
+    pk, pv = prefix_kv  # [L, B, Pmax, KV, hd]
+    B, S = tokens.shape
+    Pmax = pk.shape[2]
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    group = H // KV
+    scale = hd ** -0.5
+
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    # column validity over the concatenated [Pmax + S] axis: live
+    # prefix columns, then causal self-attention within the suffix
+    cols = jnp.arange(Pmax + S)
+    prefix_ok = (cols < prefix_len) & (cols < Pmax)
+    suffix_causal = (
+        (cols[None, :] >= Pmax)
+        & ((cols[None, :] - Pmax) <= jnp.arange(S)[:, None])
+    )
+    mask = (prefix_ok[None, :] | suffix_causal)[None, None]  # [1,1,S,P+S]
+
+    def body(x, inputs):
+        layer, pk_l, pv_l = inputs  # pk_l/pv_l [B, Pmax, KV, hd]
+        h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
+        q = _apply(h, layer["wq"], cfg.dtype)
+        k = _apply(h, layer["wk"], cfg.dtype)
+        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _rope(q.reshape(B, S, H, hd), cfg.rope_theta, t0=prefix_len)
+        k_suf = _rope(k.reshape(B, S, KV, hd), cfg.rope_theta, t0=prefix_len)
+        v_suf = v.reshape(B, S, KV, hd)
+        kk = jnp.concatenate([pk_l.astype(cfg.dtype), k_suf], axis=1)
+        vv = jnp.concatenate([pv_l.astype(cfg.dtype), v_suf], axis=1)
+        if group > 1:  # GQA: each kv head serves `group` query heads
+            kk = jnp.repeat(kk, group, axis=2)
+            vv = jnp.repeat(vv, group, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        o = o.reshape(B, S, H * hd)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+
+        h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype)
+        up = _apply(h2, layer["w_up"], cfg.dtype)
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        return x1 + down, (k_suf, v_suf)
+
+    x = x.astype(cfg.dtype)
+    x, kv = lax.scan(body, x, (dict(params["blocks"]), pk, pv))
+    x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, kv
+
+
 def prefill(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
             max_len: int, mesh=None):
     """Process the prompt in one pass and build the KV cache.

@@ -40,6 +40,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.pallas_compat import compiler_params as _compiler_params
 from ray_tpu.parallel.ring_attention import plain_attention
 
 _NEG_INF = -1e30
@@ -130,7 +131,7 @@ def _build_fwd(causal, scale, block_q, block_k, n_k, interpret, dtype):
                 pltpu.VMEM((block_q,), jnp.float32),
                 pltpu.VMEM((block_q, D), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
             interpret=interpret,
@@ -189,7 +190,7 @@ def _build_bwd_dq(causal, scale, block_q, block_k, n_k, interpret, dtype):
             out_specs=pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
             interpret=interpret,
@@ -240,7 +241,7 @@ def _build_bwd_fused(causal, scale, T, interpret, dtype):
             in_specs=[spec, spec, spec, spec, vec, spec],
             out_specs=[spec, spec, spec],
             out_shape=[jax.ShapeDtypeStruct((BH, T_, D), q.dtype)] * 3,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel",),
             ),
             interpret=interpret,
@@ -315,7 +316,7 @@ def _build_bwd_dkv(causal, scale, block_q, block_k, n_q, interpret, dtype):
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
             interpret=interpret,

@@ -1,0 +1,14 @@
+"""Pallas API-surface compatibility shared by the TPU kernels."""
+
+from __future__ import annotations
+
+
+def compiler_params(**kwargs):
+    """Pallas TPU compiler params across the API rename (the class is
+    `CompilerParams` in newer JAX, `TPUCompilerParams` through 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

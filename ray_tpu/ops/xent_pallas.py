@@ -33,6 +33,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.pallas_compat import compiler_params as _compiler_params
+
 _NEG = -1e30
 
 
@@ -200,7 +202,7 @@ def _lse_tgt(x, w, targets, block_n, block_v):
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -237,7 +239,7 @@ def _bwd(block_n, block_v, res, g):
         out_specs=pl.BlockSpec((block_n, E), lambda n, v: (n, 0)),
         out_shape=jax.ShapeDtypeStruct((Np, E), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_n, E), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -256,7 +258,7 @@ def _bwd(block_n, block_v, res, g):
         out_specs=pl.BlockSpec((block_v, E), lambda v, n: (v, 0)),
         out_shape=jax.ShapeDtypeStruct((Vp, E), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_v, E), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),

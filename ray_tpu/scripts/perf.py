@@ -598,6 +598,119 @@ def measure_envelope_broadcast(n_nodes: int = 4, size_gb: float = 1.0,
         c.shutdown()
 
 
+# ----------------------------------------------------------------------
+# serve LLM engine: paged-KV tick trace + CB smoke (CPU tiny model)
+# ----------------------------------------------------------------------
+def _engine_run(eng, prompts, n_new: int) -> Dict[str, float]:
+    """Drive one engine through a closed workload; returns tok/s plus
+    the engine's per-tick counters — as DELTAS over the engine's state
+    at entry, so a warm-up run's work never inflates a measured row."""
+    base = eng.stats()
+    futs = [eng.submit(p, n_new) for p in prompts]
+    t0 = time.perf_counter()
+    for f in futs:
+        f.result(timeout=600)
+    wall = time.perf_counter() - t0
+    s = eng.stats()
+    hit = s["prefix_hit_tokens"] - base["prefix_hit_tokens"]
+    filled = s["prefill_tokens"] - base["prefill_tokens"]
+    return {
+        "tokens_per_sec": round(len(prompts) * n_new / wall, 1),
+        "wall_s": round(wall, 3),
+        "ticks": s["ticks"] - base["ticks"],
+        "tick_ema_ms": round(s["tick_ema_s"] * 1e3, 2),
+        "gather_blocks": s["gather_blocks"],
+        "prefill_calls": s["prefill_calls"] - base["prefill_calls"],
+        "prefill_tokens": filled,
+        "prefix_hit_tokens": hit,
+        "prefix_hit_rate": round(
+            hit / (hit + filled) if hit + filled else 0.0, 3
+        ),
+        "ttft_ema_ms": round(s["ttft_ema_s"] * 1e3, 1),
+    }
+
+
+def measure_engine_trace(*, requests: int = 24, n_new: int = 8,
+                         seed: int = 0) -> Dict[str, Dict[str, float]]:
+    """Paged-KV acceptance rows on the CPU tiny model (the per-chip
+    claims, measured without the serve stack in the way):
+
+    - `sized` vs `overprovisioned`: the same workload on a
+      workload-sized KV budget vs a ~1024-token budget.  With the old
+      per-slot ring, over-provisioning was a ~20x per-step tax
+      (PERF.md); with paged blocks the gather width tracks LIVE tokens,
+      so the two rows must run the same compiled programs (equal
+      `gather_blocks`) at near-equal throughput.
+    - `prefix_on` vs `prefix_off`: a shared-system-prompt workload with
+      the radix cache on/off — cached requests skip the shared
+      prefill, visible as fewer prefilled tokens and a lower TTFT.
+    - `serve_llm_cb_smoke`: the continuous-batching hot path's tok/s —
+      the tier-1 regression canary (`tests/test_perf_harness.py`).
+    """
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_engine import LlamaEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    out: Dict[str, Dict[str, float]] = {}
+
+    # -- pool-budget invariance (prompt 24 + 8 new = 32 live tokens) --
+    bs = 8  # engine block_size for every row below
+    prompts = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size, size=24)]
+        for _ in range(requests)
+    ]
+    for name, kw in (
+        ("sized", dict(max_len=48, kv_blocks=4 * 48 // bs)),
+        ("overprovisioned", dict(max_len=120, kv_blocks=1024 // bs)),
+    ):
+        eng = LlamaEngine(cfg, params, slots=4, chunk=4, block_size=bs,
+                          prefix_cache=False, **kw)
+        try:
+            _engine_run(eng, prompts[:4], n_new)  # warm compiles
+            out[name] = _engine_run(eng, prompts, n_new)
+            out[name]["kv_budget_tokens"] = kw["kv_blocks"] * bs
+        finally:
+            eng.shutdown()
+        print(f"engine[{name}]: " + ", ".join(
+            f"{k}={v}" for k, v in out[name].items()), flush=True)
+
+    # -- radix prefix reuse (shared 16-token system prompt) -----------
+    system = [int(x) for x in rng.integers(1, cfg.vocab_size, size=16)]
+    shared_prompts = [
+        system + [int(x) for x in rng.integers(1, cfg.vocab_size, size=6)]
+        for _ in range(requests)
+    ]
+    for name, pc in (("prefix_on", True), ("prefix_off", False)):
+        eng = LlamaEngine(cfg, params, slots=4, chunk=4, block_size=bs,
+                          max_len=48, prefix_cache=pc)
+        try:
+            _engine_run(eng, shared_prompts[:2], n_new)  # warm compiles
+            out[name] = _engine_run(eng, shared_prompts, n_new)
+        finally:
+            eng.shutdown()
+        print(f"engine[{name}]: " + ", ".join(
+            f"{k}={v}" for k, v in out[name].items()), flush=True)
+
+    # -- CB smoke: the default-config hot path, one number ------------
+    eng = LlamaEngine(cfg, params, slots=4, chunk=4, block_size=bs,
+                      max_len=48)
+    try:
+        # warm both prefill paths: the repeated prompt takes the radix
+        # suffix-prefill route, so its compile stays out of the timing
+        _engine_run(eng, prompts[:4] + prompts[:1], n_new)
+        out["serve_llm_cb_smoke"] = _engine_run(eng, prompts, n_new)
+    finally:
+        eng.shutdown()
+    print("engine[serve_llm_cb_smoke]: " + ", ".join(
+        f"{k}={v}" for k, v in out["serve_llm_cb_smoke"].items()),
+        flush=True)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -619,6 +732,12 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                    help="also measure host ring-allreduce bus bandwidth")
     p.add_argument("--busbw-world", type=int, default=2)
     p.add_argument("--busbw-mb", type=int, default=16)
+    p.add_argument("--engine-trace", action="store_true",
+                   help="serve LLM engine tick-trace rows INSTEAD of "
+                        "the matrix: paged-KV budget invariance, radix "
+                        "prefix reuse, CB smoke (CPU tiny model; no "
+                        "cluster)")
+    p.add_argument("--engine-requests", type=int, default=24)
     p.add_argument("--envelope", action="store_true",
                    help="run the scalability-envelope rows INSTEAD of "
                         "the microbenchmark matrix (reference: "
@@ -640,6 +759,15 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     import signal
 
     faulthandler.register(signal.SIGUSR1)
+
+    if args.engine_trace:
+        # no cluster: the engine is driven in-process on the CPU backend
+        results = measure_engine_trace(requests=args.engine_requests)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
 
     import ray_tpu as rt
 

@@ -35,6 +35,15 @@ STARTING = "STARTING"
 RUNNING = "RUNNING"
 
 
+def _replica_depth(r: "_ReplicaState") -> float:
+    """One queue-depth signal for routing AND the status panel: the
+    replica's engine-reported backlog when its deployment exposes
+    stats(), else its in-flight count."""
+    return float(r.metrics.get(
+        "engine_queue_depth", r.metrics.get("ongoing", 0) or 0
+    ))
+
+
 class _ReplicaState:
     def __init__(self, replica_id: str, handle, max_ongoing: int):
         self.replica_id = replica_id
@@ -77,14 +86,20 @@ class _DeploymentState:
         self.deleted = False
 
     def routing_table(self) -> Dict[str, Any]:
+        running = [r for r in self.replicas.values() if r.state == RUNNING]
         return {
             "version": self.version,
             "incarnation": self.incarnation,
             "replicas": {
-                r.replica_id: (r.handle, r.max_ongoing)
-                for r in self.replicas.values()
-                if r.state == RUNNING
+                r.replica_id: (r.handle, r.max_ongoing) for r in running
             },
+            # per-replica queue-depth signal, refreshed on the health
+            # cadence: a deployment exposing stats() (the LLM engine's
+            # queued+active count) reports real backlog; others fall
+            # back to the in-flight count.  Routers fold this into
+            # their pow-2 choice so N engine replicas share load by
+            # actual queue depth, not just each router's local view.
+            "depths": {r.replica_id: _replica_depth(r) for r in running},
         }
 
 
@@ -464,6 +479,24 @@ class ServeController:
                             (app_name, name),
                             {"completed": 0.0, "latency_sum_s": 0.0},
                         ),
+                        # per-replica load panel for /api/serve: queue
+                        # depth plus any user stats() signals (the LLM
+                        # engine's per-tick live tokens, block-pool
+                        # occupancy, prefix-cache hit rate, ...)
+                        "replicas": {
+                            rid: {
+                                "state": r.state,
+                                "ongoing": r.metrics.get("ongoing", 0),
+                                "queue_depth": _replica_depth(r),
+                                **(
+                                    {"engine": r.metrics["user_stats"]}
+                                    if isinstance(
+                                        r.metrics.get("user_stats"), dict
+                                    ) else {}
+                                ),
+                            }
+                            for rid, r in ds.replicas.items()
+                        },
                     }
                     for name, ds in deployments.items()
                 }

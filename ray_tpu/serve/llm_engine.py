@@ -1,31 +1,41 @@
-"""Continuous-batching LLM engine: step-level request scheduling.
+"""Continuous-batching LLM engine: step-level scheduling over a PAGED
+KV cache with radix prefix reuse.
 
-Reference capability: the vLLM-on-Ray serving pattern (what the
-reference ecosystem deploys behind Ray Serve for LLMs) — new requests
-join a RESIDENT decode batch mid-flight instead of waiting for the
-current batch to finish, so the decode batch stays full and weight
-reads amortize over every active sequence.  Gather-batching
-(`@serve.batch` + `llama.generate`) serializes prefill+decode per
-gathered group and idles slots as sequences finish; measured on v5e-1
-this engine nearly doubles served throughput at the same model/shapes
-(PERF.md round 5).
+Reference capability: the vLLM-on-Ray serving pattern (continuous
+batching) extended with its two production levers — PagedAttention
+(Kwon et al., SOSP 2023: block-granular KV allocation) and
+RadixAttention (Zheng et al., 2024: prefix-tree KV sharing) — rebuilt
+TPU-native.  New requests join a RESIDENT decode batch mid-flight; the
+KV cache is one fixed block pool instead of a per-slot `max_len` ring.
 
 TPU-native design points:
-- STATIC shapes end-to-end: a fixed slot count, a fixed max_len ring
-  of KV cache, per-row positions (`llama.decode_step_vec`), pow-2
-  prompt-length buckets for the prefill program — the whole serving
-  life runs on a handful of compiled programs.
-- CHUNKED stepping: `chunk` decode steps run inside one compiled
-  `lax.scan` per dispatch, so per-dispatch overhead (large on a
-  remote-tunnel device, nonzero everywhere) amortizes over
-  chunk x slots tokens; finish detection happens at chunk granularity
-  and surplus tokens are truncated host-side.
-- ONE host transfer per chunk (the emitted token block), never
-  per token.
+- STATIC shapes from a SMALL family of compiled programs: the block
+  pool `[L, num_blocks, block_size, KV, hd]` is allocated once; each
+  chunk dispatch gathers every slot's live blocks into a dense
+  `[L, slots, W*block_size, ...]` view, runs `chunk` decode steps on
+  it (one `lax.scan` per dispatch, per-row positions via
+  `llama.decode_step_vec`), and scatters the blocks back.  The gather
+  width W is the pow-2 bucket of the LONGEST live sequence's block
+  count — per-step attention cost tracks LIVE tokens, not the pool
+  budget, killing the measured "ring size is a per-step tax" cost
+  (PERF.md round 5: a 1024-ring ran ~20x slower than a 192-ring).
+- RADIX PREFIX CACHE: prompt prefixes are cached in a block-granular
+  token trie (`serve/kv_cache.py`).  A request whose prompt prefix is
+  cached pins those blocks (zero-copy sharing — its block table simply
+  points at them) and prefills only the suffix, attending over the
+  gathered prefix KV (`llama.forward_with_prefix`).  Completed
+  requests donate their full prompt blocks to the trie; unpinned
+  nodes are LRU-evicted when the pool runs low.  The dominant
+  consumer-scale shape — a shared system prompt — skips its prefill
+  entirely after the first request.
+- CHUNKED stepping + ONE host transfer per chunk, exactly as before:
+  the chunk emits its pre-chunk token row so admission never needs a
+  device->host read, and the token read of chunk N overlaps chunk
+  N+1's compute.
 
-The engine is model-specific to the in-tree Llama (the only decoder
-family here); the scheduling core (slots/admission/chunking) is the
-reusable part.
+Greedy outputs are bit-identical to a dedicated `llama.generate` for
+the same prompt, with the prefix cache on or off
+(`tests/test_llm_engine.py`).
 """
 
 from __future__ import annotations
@@ -40,6 +50,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.serve.kv_cache import SCRATCH_BLOCK, BlockPool, RadixCache
+
 logger = logging.getLogger(__name__)
 
 
@@ -52,15 +64,28 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
 class LlamaEngine:
-    """Resident continuous-batching decode engine.
+    """Resident continuous-batching decode engine over a paged KV pool.
 
     submit() is thread-safe and returns a `concurrent.futures.Future`
     resolving to the generated token ids (greedy — identical to what a
-    dedicated `llama.generate` would produce for the same prompt)."""
+    dedicated `llama.generate` would produce for the same prompt).
+
+    `max_len` caps one sequence (prompt + generation); `kv_blocks`
+    sizes the SHARED pool (default: enough for every slot at max_len,
+    i.e. ring-equivalent capacity — but unlike the ring, an
+    over-provisioned pool costs HBM only, not per-step time).
+    `prefix_cache=False` disables radix reuse (every request prefills
+    its whole prompt)."""
 
     def __init__(self, cfg, params, *, slots: int = 32,
-                 max_len: Optional[int] = None, chunk: int = 8):
+                 max_len: Optional[int] = None, chunk: int = 8,
+                 block_size: int = 16, kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -72,68 +97,91 @@ class LlamaEngine:
         self.slots = slots
         self.max_len = int(max_len or cfg.max_seq_len)
         self.chunk = chunk
+        self.block_size = int(block_size)
+        # blocks a maximal sequence needs (highest touched index is
+        # max_len - 1)
+        self._max_seq_blocks = _cdiv(self.max_len, self.block_size)
+        budget = (int(kv_blocks) if kv_blocks is not None
+                  else slots * self._max_seq_blocks)
+        if budget < self._max_seq_blocks:
+            raise ValueError(
+                f"kv_blocks={budget} cannot hold one max_len sequence "
+                f"({self._max_seq_blocks} blocks of {self.block_size})"
+            )
+        self._pool = BlockPool(budget + 1)  # +1: reserved scratch block
+        if prefix_cache and getattr(cfg, "attention", "dense") != "dense":
+            # the suffix prefill (`llama.forward_with_prefix`) mirrors
+            # the DENSE attention numerics; under flash/ring/ulysses
+            # the full prefill would use different reduction orders and
+            # a near-tie greedy argmax could diverge between cache-on
+            # and cache-off — keep the bit-identity guarantee instead
+            logger.info(
+                "prefix cache disabled: suffix prefill matches dense "
+                "attention numerics only (cfg.attention=%r)",
+                cfg.attention,
+            )
+            prefix_cache = False
+        self._radix: Optional[RadixCache] = (
+            RadixCache(self.block_size, self._pool) if prefix_cache
+            else None
+        )
 
         L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        self._k = jnp.zeros((L, slots, self.max_len, KV, hd), cfg.dtype)
-        self._v = jnp.zeros_like(self._k)
+        self._k_pool = jnp.zeros(
+            (L, self._pool.num_blocks, self.block_size, KV, hd), cfg.dtype
+        )
+        self._v_pool = jnp.zeros_like(self._k_pool)
         self._pos = jnp.zeros((slots,), jnp.int32)
         self._tok = jnp.zeros((slots,), jnp.int32)
 
-        # one compiled chunk-stepper for the engine's whole life
-        def _chunk_fn(params, k, v, tok, pos):
-            def body(carry, _):
-                tok, kv, pos = carry[0], (carry[1], carry[2]), carry[3]
-                logits, (k2, v2) = llama.decode_step_vec(
-                    cfg, params, tok, kv, pos
-                )
-                nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                # clamp: idle/finished slots must never walk their
-                # position past the cache ring
-                pos2 = jnp.minimum(pos + 1, self.max_len - 1)
-                return (nt, k2, v2, pos2), nt
-
-            tok_in = tok  # pre-chunk tokens: a freshly admitted
-            # slot's FIRST token (from prefill) — emitting it here
-            # means admission never needs its own device->host read
-            # (one ~100 ms round trip PER REQUEST on a remote tunnel)
-            (tok, k, v, pos), toks = jax.lax.scan(
-                body, (tok, k, v, pos), None, length=chunk
-            )
-            # [1 + chunk, slots]: row 0 = pre-chunk tokens
-            return k, v, tok, pos, jnp.concatenate(
-                [tok_in[None], toks], axis=0
-            )
-
-        self._chunk_step = jax.jit(_chunk_fn, donate_argnums=(1, 2))
-        # per prompt-length-bucket prefill (compiles per bucket)
-        self._prefill_cache: Dict[int, object] = {}
-
-        def _write_slot(k, v, k1, v1, slot, pos0, tok0, pos, tok):
-            # k1/v1 [L, 1, max_len, KV, hd] -> batch slot `slot`
-            k = jax.lax.dynamic_update_slice(
-                k, k1.astype(k.dtype), (0, slot, 0, 0, 0)
-            )
-            v = jax.lax.dynamic_update_slice(
-                v, v1.astype(v.dtype), (0, slot, 0, 0, 0)
-            )
-            pos = pos.at[slot].set(pos0)
-            tok = tok.at[slot].set(tok0)
-            return k, v, pos, tok
-
-        self._write_slot = jax.jit(_write_slot, donate_argnums=(0, 1))
+        # compiled-program families (each keyed by a static shape)
+        self._chunk_cache: Dict[int, object] = {}          # gather width W
+        self._prefill_cache: Dict[int, object] = {}        # prompt bucket
+        self._suffix_cache: Dict[tuple, object] = {}       # (S_bucket, P_blocks)
+        self._write_cache: Dict[tuple, object] = {}        # (T_in, nb)
 
         self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        # the submit queue lives under its OWN condition/lock: the
+        # engine thread holds `_lock` across admission dispatches
+        # (which COMPILE on new shapes — seconds), and submit() runs on
+        # the replica's event loop, which must never wait that out
+        # (same rationale as the bounded-wait stats())
+        self._wake = threading.Condition(threading.Lock())
         self._queue: deque = deque()
         self._free: List[int] = list(range(slots))
-        # slot -> dict(fut, out, want)
+        # slot -> dict(fut, out, want, since, pos_host, blocks, ...)
         self._active: Dict[int, Dict] = {}
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         self._running = True
         self._pending_toks = None  # deferred-harvest chunk (see _loop)
+        # requests popped from the queue but not yet admitted: they
+        # are in neither _queue nor _active while the admission loop
+        # compiles/dispatches, and queue_depth must keep counting them
+        # or the busiest replica under-reports exactly while it is
+        # wedged in admission work (plain int: GIL-atomic updates)
+        self._pending_admissions = 0
         self._chunk_seq = 0  # dispatch counter: requests are tagged
         # with the first chunk that can contain their tokens, so the
         # deferred harvest of an OLDER chunk never credits a slot's
         # new occupant with its previous occupant's tokens
+
+        # per-tick metrics exported via stats() (live on the engine
+        # thread; reads take the lock)
+        self._hit_tokens = 0          # prefix tokens served from cache
+        self._prefill_tokens = 0      # tokens actually prefilled
+        self._prefix_hits = 0         # requests with a non-empty match
+        self._prefill_calls = 0       # prefill dispatches (full+suffix)
+        self._ttft_ema_s = 0.0
+        self._tick_ema_s = 0.0
+        self._last_gather_blocks = 0  # W of the latest chunk dispatch
+        # last computed stats() dict, served when the engine lock is
+        # busy (admission compiles hold it for seconds) — whole-dict
+        # swaps only, so readers never see a partial snapshot.  Seeded
+        # BEFORE the thread starts: the first admission's compile is
+        # exactly the window the fallback exists for, and an empty
+        # dict there would blind queue-depth routing during startup
+        self._stats_snapshot: Dict[str, float] = self._stats_locked()
+
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine", daemon=True
         )
@@ -149,21 +197,69 @@ class LlamaEngine:
             ))
             return f
         n_new = max(1, min(int(max_new_tokens), limit - len(prompt_ids)))
+        # no pool-size check needed: __init__ guarantees the pool holds
+        # a full max_len sequence, and T + n_new - 1 <= max_len - 1
         fut: Future = Future()
         with self._wake:
             if not self._running:
                 fut.set_exception(RuntimeError("engine is shut down"))
                 return fut
-            self._queue.append((list(prompt_ids), n_new, fut))
+            self._queue.append(
+                (list(prompt_ids), n_new, fut, _time.monotonic())
+            )
             self._wake.notify()
         return fut
 
-    def stats(self) -> Dict[str, int]:
-        with self._lock:
-            return {
+    def stats(self) -> Dict[str, float]:
+        """Engine load/health signals: consumed by the serve replica's
+        metrics piggyback (queue-depth routing + the dashboard's
+        /api/serve) and by the tick-trace benchmark.
+
+        NON-BLOCKING by contract: the engine thread holds its lock
+        across admission dispatches, which COMPILE on first use of a
+        new shape (seconds to tens of seconds on a real model).  A
+        health check blocked that long would get a healthy replica
+        killed (health_check_timeout_s defaults to 10 s), so when the
+        lock isn't free within a bounded wait this returns the last
+        per-tick snapshot instead."""
+        if not self._lock.acquire(timeout=0.25):
+            return dict(self._stats_snapshot)
+        try:
+            # snapshot updated under the lock: an unlocked write here
+            # could land AFTER the engine loop's fresher per-tick one
+            snap = self._stats_snapshot = self._stats_locked()
+        finally:
+            self._lock.release()
+        return dict(snap)
+
+    def _stats_locked(self) -> Dict[str, float]:
+        served = self._hit_tokens + self._prefill_tokens
+        cached = self._radix.cached_blocks if self._radix else 0
+        return {
                 "active": len(self._active),
                 "queued": len(self._queue),
                 "free_slots": len(self._free),
+                "queue_depth": (len(self._active) + len(self._queue)
+                                + self._pending_admissions),
+                "live_tokens": sum(
+                    r["pos_host"] for r in self._active.values()
+                ),
+                "blocks_total": self._pool.capacity,
+                "blocks_free": self._pool.free_blocks,
+                "blocks_cached": cached,
+                "block_occupancy": (
+                    1.0 - self._pool.free_blocks / self._pool.capacity
+                ),
+                "prefix_hit_tokens": self._hit_tokens,
+                "prefill_tokens": self._prefill_tokens,
+                "prefix_hit_rate": (
+                    self._hit_tokens / served if served else 0.0
+                ),
+                "prefill_calls": self._prefill_calls,
+                "gather_blocks": self._last_gather_blocks,
+                "ttft_ema_s": self._ttft_ema_s,
+                "tick_ema_s": self._tick_ema_s,
+                "ticks": self._chunk_seq,
             }
 
     def shutdown(self):
@@ -175,13 +271,69 @@ class LlamaEngine:
             for req in list(self._active.values()):
                 if not req["fut"].done():
                     req["fut"].cancel()
-            for _, _, fut in self._queue:
-                if not fut.done():
-                    fut.cancel()
             self._active.clear()
+        with self._wake:
+            for item in self._queue:
+                if not item[2].done():
+                    item[2].cancel()
             self._queue.clear()
 
-    # -- engine loop ---------------------------------------------------
+    # -- compiled-program families ------------------------------------
+    def _chunk_step_for(self, W: int):
+        """Chunk stepper over a gathered W-block view: per-step cost is
+        O(W * block_size) per slot — live tokens, not pool budget."""
+        fn = self._chunk_cache.get(W)
+        if fn is None:
+            jax, jnp, llama = self._jax, self._jnp, self._llama
+            cfg, bs, chunk = self.cfg, self.block_size, self.chunk
+            L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+            S = self.slots
+
+            def _fn(params, k_pool, v_pool, tables, tok, pos):
+                # tables [slots, W] -> dense [L, slots, W*bs, KV, hd]
+                k = jnp.take(k_pool, tables, axis=1).reshape(
+                    L, S, W * bs, KV, hd
+                )
+                v = jnp.take(v_pool, tables, axis=1).reshape(
+                    L, S, W * bs, KV, hd
+                )
+
+                def body(carry, _):
+                    tok, kv, pos = carry[0], (carry[1], carry[2]), carry[3]
+                    logits, (k2, v2) = llama.decode_step_vec(
+                        cfg, params, tok, kv, pos
+                    )
+                    nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    # clamp: idle/finished slots must never walk their
+                    # position past the sequence cap
+                    pos2 = jnp.minimum(pos + 1, self.max_len - 1)
+                    return (nt, k2, v2, pos2), nt
+
+                tok_in = tok  # pre-chunk tokens: a freshly admitted
+                # slot's FIRST token (from prefill) — emitting it here
+                # means admission never needs its own device->host read
+                # (one ~100 ms round trip PER REQUEST on a remote tunnel)
+                (tok, k, v, pos), toks = jax.lax.scan(
+                    body, (tok, k, v, pos), None, length=chunk
+                )
+                # scatter the (updated) blocks back into the pool.
+                # Shared prefix blocks scatter identical, unmodified
+                # values from every sharer; padding rows target the
+                # scratch block — both make duplicate indices benign.
+                kb = k.reshape(L, S, W, bs, KV, hd)
+                vb = v.reshape(L, S, W, bs, KV, hd)
+                k_pool = k_pool.at[:, tables].set(kb)
+                v_pool = v_pool.at[:, tables].set(vb)
+                # [1 + chunk, slots]: row 0 = pre-chunk tokens
+                return k_pool, v_pool, tok, pos, jnp.concatenate(
+                    [tok_in[None], toks], axis=0
+                )
+
+            fn = self._chunk_cache[W] = jax.jit(
+                _fn, donate_argnums=(1, 2)
+            )
+        return fn
+
     def _prefill_for(self, bucket: int):
         fn = self._prefill_cache.get(bucket)
         if fn is None:
@@ -197,38 +349,202 @@ class LlamaEngine:
                 logits, (ks, vs) = llama.forward(
                     self.cfg, params, prompt, return_kv=True
                 )
-                pad = [(0, 0), (0, 0), (0, self.max_len - bucket),
-                       (0, 0), (0, 0)]
-                return logits[0], jnp.pad(ks, pad), jnp.pad(vs, pad)
+                return logits[0], ks, vs  # ks/vs [L, 1, bucket, KV, hd]
 
             fn = self._prefill_cache[bucket] = jax.jit(_pf)
         return fn
 
-    def _admit(self, prompt: List[int], n_new: int, fut: Future):
+    def _suffix_prefill_for(self, s_bucket: int, p_blocks: int):
+        """Prefix-hit prefill: gather the matched prefix blocks and run
+        the suffix forward against them (compiles per (suffix-bucket,
+        prefix-width) pair)."""
+        key = (s_bucket, p_blocks)
+        fn = self._suffix_cache.get(key)
+        if fn is None:
+            jax, jnp, llama = self._jax, self._jnp, self._llama
+            cfg, bs = self.cfg, self.block_size
+            L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+
+            def _pf(params, k_pool, v_pool, suffix, blk_ids, prefix_len):
+                pk = jnp.take(k_pool, blk_ids, axis=1).reshape(
+                    L, 1, p_blocks * bs, KV, hd
+                )
+                pv = jnp.take(v_pool, blk_ids, axis=1).reshape(
+                    L, 1, p_blocks * bs, KV, hd
+                )
+                logits, (ks, vs) = llama.forward_with_prefix(
+                    cfg, params, suffix, (pk, pv), prefix_len
+                )
+                return logits[0], ks, vs
+
+            fn = self._suffix_cache[key] = jax.jit(_pf)
+        return fn
+
+    def _write_blocks_for(self, t_in: int, nb: int):
+        """Write freshly prefilled KV (time axis `t_in`) into `nb` pool
+        blocks and set the slot's pos/tok rows.  Serves both prefill
+        shapes — full prompt from position 0, or a suffix starting at a
+        block boundary — since the write target is just a block-id
+        list."""
+        key = (t_in, nb)
+        fn = self._write_cache.get(key)
+        if fn is None:
+            jax, jnp = self._jax, self._jnp
+            bs = self.block_size
+            L, KV, hd = (self.cfg.n_layers, self.cfg.n_kv_heads,
+                         self.cfg.head_dim)
+            target = nb * bs
+
+            def _fn(k_pool, v_pool, k1, v1, blk_ids, slot, pos0, tok0,
+                    pos, tok):
+                # k1/v1 [L, 1, t_in, KV, hd] -> exactly nb blocks
+                if t_in < target:
+                    pad = [(0, 0), (0, 0), (0, target - t_in), (0, 0),
+                           (0, 0)]
+                    k1 = jnp.pad(k1, pad)
+                    v1 = jnp.pad(v1, pad)
+                elif t_in > target:
+                    k1 = k1[:, :, :target]
+                    v1 = v1[:, :, :target]
+                kb = k1.astype(k_pool.dtype).reshape(L, nb, bs, KV, hd)
+                vb = v1.astype(v_pool.dtype).reshape(L, nb, bs, KV, hd)
+                k_pool = k_pool.at[:, blk_ids].set(kb)
+                v_pool = v_pool.at[:, blk_ids].set(vb)
+                pos = pos.at[slot].set(pos0)
+                tok = tok.at[slot].set(tok0)
+                return k_pool, v_pool, pos, tok
+
+            fn = self._write_cache[key] = jax.jit(
+                _fn, donate_argnums=(0, 1)
+            )
+        return fn
+
+    # -- admission -----------------------------------------------------
+    def _alloc_or_evict(self, n: int) -> Optional[List[int]]:
+        own = self._pool.alloc(n)
+        if own is None and self._radix is not None:
+            self._radix.evict(n - self._pool.free_blocks)
+            own = self._pool.alloc(n)
+        return own
+
+    def _admit(self, prompt: List[int], n_new: int, fut: Future,
+               t_submit: float) -> bool:
+        """Returns False (without consuming anything) when the pool
+        cannot cover the request right now — the caller requeues it."""
         jnp = self._jnp
-        slot = self._free.pop()
+        bs = self.block_size
         T = len(prompt)
-        # pow-2 length buckets: RIGHT-pad (the scheme depends on it —
-        # causal prefill keeps positions 0..T-1 correct, the pad tail's
-        # garbage KV is masked by the starting pos and overwritten as
-        # decoding advances)
-        bucket = min(_next_pow2(T), self.max_len - 1)
-        padded = prompt + [0] * (bucket - T)
-        logits, k1, v1 = self._prefill_for(bucket)(
-            self.params, jnp.asarray([padded], jnp.int32)
-        )
-        # first generated token comes from the LAST REAL prompt
-        # position; it STAYS on device — the next chunk emits it in its
-        # pre-chunk token row, so admission costs only async dispatches
-        tok0 = jnp.argmax(logits[T - 1], axis=-1).astype(jnp.int32)
-        self._k, self._v, self._pos, self._tok = self._write_slot(
-            self._k, self._v, k1, v1, slot, jnp.asarray(T, jnp.int32),
+        # highest KV index a WANTED token's step touches is T+n_new-2
+        total_blocks = _cdiv(T + n_new - 1, bs)
+
+        shared: List[int] = []
+        path: List = []
+        if self._radix is not None:
+            shared, path = self._radix.match(prompt)
+        P = len(shared) * bs
+        own = self._alloc_or_evict(total_blocks - len(shared))
+        if own is None:
+            if self._radix is not None:
+                self._radix.release(path)
+            return False
+
+        slot = self._free.pop()
+        if P > 0:
+            # PREFIX HIT: prefill only the suffix, attending over the
+            # gathered prefix blocks (pow-2 buckets on both axes)
+            S = T - P
+            s_bucket = min(_next_pow2(S), self.max_len - 1)
+            p_bucket = _next_pow2(len(shared))
+            blk_ids = jnp.asarray(
+                shared + [SCRATCH_BLOCK] * (p_bucket - len(shared)),
+                jnp.int32,
+            )
+            suffix = jnp.asarray(
+                [prompt[P:] + [0] * (s_bucket - S)], jnp.int32
+            )
+            logits, k1, v1 = self._suffix_prefill_for(s_bucket, p_bucket)(
+                self.params, self._k_pool, self._v_pool, suffix,
+                blk_ids, jnp.asarray(P, jnp.int32),
+            )
+            tok0 = jnp.argmax(logits[S - 1], axis=-1).astype(jnp.int32)
+            # suffix KV starts exactly at block boundary P//bs; write
+            # only the blocks holding real suffix tokens — bucket-pad
+            # garbage past them is dropped, garbage within the last
+            # real block is masked by pos until decode overwrites it
+            nb_real = _cdiv(S, bs)
+            write_ids = own[:nb_real]
+            self._hit_tokens += P
+            self._prefill_tokens += S
+            self._prefix_hits += 1
+            wfn = self._write_blocks_for(s_bucket, nb_real)
+        else:
+            # pow-2 length buckets: RIGHT-pad (the scheme depends on it
+            # — causal prefill keeps positions 0..T-1 correct, the pad
+            # tail's garbage KV is masked by the starting pos and
+            # overwritten as decoding advances)
+            bucket = min(_next_pow2(T), self.max_len - 1)
+            padded = prompt + [0] * (bucket - T)
+            logits, k1, v1 = self._prefill_for(bucket)(
+                self.params, jnp.asarray([padded], jnp.int32)
+            )
+            # first generated token comes from the LAST REAL prompt
+            # position; it STAYS on device — the next chunk emits it in
+            # its pre-chunk token row, so admission costs only async
+            # dispatches
+            tok0 = jnp.argmax(logits[T - 1], axis=-1).astype(jnp.int32)
+            nb_real = _cdiv(T, bs)
+            write_ids = own[:nb_real]
+            self._prefill_tokens += T
+            wfn = self._write_blocks_for(bucket, nb_real)
+        self._prefill_calls += 1
+
+        self._k_pool, self._v_pool, self._pos, self._tok = wfn(
+            self._k_pool, self._v_pool, k1, v1,
+            jnp.asarray(write_ids, jnp.int32),
+            jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32),
             tok0, self._pos, self._tok,
         )
+
+        # donate this prompt's full blocks to the radix cache (pinned
+        # until completion); blocks the trie adopts stop being
+        # request-owned so completion doesn't double-free them
+        own_set = list(own)
+        if self._radix is not None:
+            donatable = own[: max(0, (T - 1) // bs - len(shared))]
+            path, adopted = self._radix.insert(prompt, path, donatable)
+            if adopted:
+                adopted_set = set(adopted)
+                own_set = [b for b in own_set if b not in adopted_set]
+
+        self._slot_blocks[slot] = shared + own
         self._active[slot] = {
             "fut": fut, "out": [], "want": n_new,
             "since": self._chunk_seq + 1,  # first chunk with its steps
+            "pos_host": T, "own_blocks": own_set, "tree_path": path,
+            "t_submit": t_submit, "first_tok": False,
         }
+        return True
+
+    def _release(self, slot: int, req: Dict):
+        self._slot_blocks[slot] = []
+        self._free.append(slot)
+        if self._radix is not None and req["tree_path"]:
+            self._radix.release(req["tree_path"])
+        self._pool.free(req["own_blocks"])
+
+    # -- engine loop ---------------------------------------------------
+    def _gather_width(self) -> int:
+        """Blocks per slot the next chunk must see: covers every active
+        slot's highest touched index, capped per slot at its own
+        allocation (overshoot past a finished budget reads scratch
+        garbage that only ever lands in truncated surplus tokens)."""
+        need = 1
+        for slot, req in self._active.items():
+            hi = min(req["pos_host"] + self.chunk - 1, self.max_len - 1)
+            w = min(hi // self.block_size + 1,
+                    len(self._slot_blocks[slot]))
+            need = max(need, w)
+        return min(_next_pow2(need), self._max_seq_blocks)
 
     def _harvest(self, toks_host: np.ndarray, seq: int):
         """toks_host [1 + chunk, slots] from dispatch `seq` (row 0 =
@@ -237,6 +553,7 @@ class LlamaEngine:
         dispatched are skipped — their tokens start in a later chunk.
         A request's FIRST chunk contributes from row 0 (its prefill
         token rode along); later chunks from row 1."""
+        now = _time.monotonic()
         done = []
         for slot, req in self._active.items():
             if req["since"] > seq:
@@ -247,21 +564,43 @@ class LlamaEngine:
                 req["out"].extend(
                     int(t) for t in toks_host[start:start + need, slot]
                 )
+            if req["out"] and not req["first_tok"]:
+                req["first_tok"] = True
+                ttft = now - req["t_submit"]
+                self._ttft_ema_s = (
+                    ttft if self._ttft_ema_s == 0.0
+                    else 0.8 * self._ttft_ema_s + 0.2 * ttft
+                )
             if len(req["out"]) >= req["want"]:
                 done.append(slot)
         for slot in done:
             req = self._active.pop(slot)
-            self._free.append(slot)
+            self._release(slot, req)
             if not req["fut"].done():
                 req["fut"].set_result(req["out"][:req["want"]])
 
     def _loop(self):
+        jnp = self._jnp
         while True:
             with self._wake:
                 while (self._running and not self._active
                        and not (self._queue and self._free)):
                     self._wake.wait()
                 if not self._running:
+                    # the engine thread sweeps its own state on exit:
+                    # shutdown()'s sweep runs after a BOUNDED join, so
+                    # an admission compile outlasting the join would
+                    # otherwise register requests into _active AFTER
+                    # that sweep and strand their futures forever
+                    for item in self._queue:
+                        if not item[2].done():
+                            item[2].cancel()
+                    self._queue.clear()
+                    with self._lock:
+                        for req in self._active.values():
+                            if not req["fut"].done():
+                                req["fut"].cancel()
+                        self._active.clear()
                     return
                 admissions = []
                 # bound by the FREE SLOTS, not just the cap: _admit
@@ -272,23 +611,49 @@ class LlamaEngine:
                 budget = min(16, len(self._free))
                 while self._queue and len(admissions) < budget:
                     admissions.append(self._queue.popleft())
+                self._pending_admissions = len(admissions)
             try:
                 t0 = _time.perf_counter()
-                for prompt, n_new, fut in admissions:
+                requeue = []
+                for i, (prompt, n_new, fut, ts) in enumerate(admissions):
                     with self._lock:
-                        self._admit(prompt, n_new, fut)
+                        if not self._admit(prompt, n_new, fut, ts):
+                            # pool exhausted by LIVE sequences: wait for
+                            # completions, preserving arrival order
+                            requeue = admissions[i:]
+                            break
+                        self._pending_admissions -= 1
+                if requeue:
+                    with self._wake:
+                        self._queue.extendleft(reversed(requeue))
+                        self._pending_admissions = 0
+                    admissions = admissions[:len(admissions) - len(requeue)]
+                else:
+                    self._pending_admissions = 0
                 t1 = _time.perf_counter()
                 with self._lock:
                     have_active = bool(self._active)
+                    W = self._gather_width() if have_active else 0
+                    if have_active:
+                        tables = np.zeros((self.slots, W), np.int32)
+                        for slot in self._active:
+                            blocks = self._slot_blocks[slot][:W]
+                            tables[slot, :len(blocks)] = blocks
                 toks = None
                 if have_active:
-                    self._k, self._v, self._tok, self._pos, toks = (
-                        self._chunk_step(
-                            self.params, self._k, self._v, self._tok,
-                            self._pos,
-                        )
+                    self._last_gather_blocks = W
+                    (self._k_pool, self._v_pool, self._tok, self._pos,
+                     toks) = self._chunk_step_for(W)(
+                        self.params, self._k_pool, self._v_pool,
+                        jnp.asarray(tables), self._tok, self._pos,
                     )
                     self._chunk_seq += 1
+                    with self._lock:
+                        for req in self._active.values():
+                            req["pos_host"] = min(
+                                req["pos_host"] + self.chunk,
+                                self.max_len - 1,
+                            )
                 # OVERLAP: harvest the PREVIOUS chunk's tokens while
                 # the current chunk computes — the device->host read is
                 # round-trip latency (~90 ms through a remote tunnel,
@@ -304,14 +669,22 @@ class LlamaEngine:
                 self._pending_toks = (
                     (toks, self._chunk_seq) if toks is not None else None
                 )
+                t3 = _time.perf_counter()
+                self._tick_ema_s = (
+                    (t3 - t0) if self._tick_ema_s == 0.0
+                    else 0.8 * self._tick_ema_s + 0.2 * (t3 - t0)
+                )
+                with self._lock:  # keep the lock-free stats() fallback
+                    self._stats_snapshot = self._stats_locked()  # fresh
                 if _TRACE:
-                    t3 = _time.perf_counter()
                     with self._lock:
                         na, nf = len(self._active), len(self._free)
+                        bf = self._pool.free_blocks
                     print(f"tick adm={len(admissions)} "
                           f"admit={1e3*(t1-t0):.0f} "
                           f"dispatch={1e3*(t2-t1):.0f} "
                           f"read+harvest={1e3*(t3-t2):.0f}ms "
+                          f"W={W} blkfree={bf} "
                           f"active={na} free={nf}", flush=True)
             except Exception as e:  # engine must not die silently
                 logger.exception("llm engine tick failed; failing %d "
@@ -324,20 +697,30 @@ class LlamaEngine:
                     # admissions popped from the queue but not (yet)
                     # registered in _active would otherwise hang their
                     # callers forever
-                    for _p, _n, fut in admissions:
+                    for _p, _n, fut, _ts in admissions:
                         if not fut.done():
                             fut.set_exception(e)
                     self._active.clear()
                     self._free = list(range(self.slots))
-                # the failed tick may have DONATED k/v without ever
-                # rebinding them — rebuild the device state or every
-                # later dispatch dies on invalid donated buffers
-                jnp = self._jnp
-                self._k = jnp.zeros(
-                    (self.cfg.n_layers, self.slots, self.max_len,
-                     self.cfg.n_kv_heads, self.cfg.head_dim),
+                    self._slot_blocks = [[] for _ in range(self.slots)]
+                    self._pending_admissions = 0
+                    # host bookkeeping restarts from scratch: every
+                    # block returns to the pool and the radix cache
+                    # empties (its pinned paths died with the requests)
+                    self._pool = BlockPool(self._pool.num_blocks)
+                    if self._radix is not None:
+                        self._radix = RadixCache(
+                            self.block_size, self._pool
+                        )
+                # the failed tick may have DONATED pool buffers without
+                # ever rebinding them — rebuild the device state or
+                # every later dispatch dies on invalid donated buffers
+                self._k_pool = jnp.zeros(
+                    (self.cfg.n_layers, self._pool.num_blocks,
+                     self.block_size, self.cfg.n_kv_heads,
+                     self.cfg.head_dim),
                     self.cfg.dtype,
                 )
-                self._v = jnp.zeros_like(self._k)
+                self._v_pool = jnp.zeros_like(self._k_pool)
                 self._pos = jnp.zeros((self.slots,), jnp.int32)
                 self._tok = jnp.zeros((self.slots,), jnp.int32)

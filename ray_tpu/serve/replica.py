@@ -11,8 +11,11 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import logging
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 
 async def _ensure_coro(awaitable):
@@ -187,7 +190,7 @@ class Replica:
                 break
 
     def get_metrics(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replica_id": self._replica_id,
             "ongoing": self._ongoing,
             "total": self._total,  # started (includes in-flight)
@@ -195,6 +198,45 @@ class Replica:
             "latency_sum_s": self._latency_sum_s,
             "latency_buckets": list(self._latency_buckets),
         }
+        # user-callable load signals (reference: the pow-2 scheduler's
+        # queue-len RPC): a deployment exposing `stats()` — e.g. the
+        # continuous-batching LLM engine's queue depth / TTFT / block
+        # occupancy — gets them piggybacked to the controller, where
+        # they feed queue-depth routing and the /api/serve dashboard.
+        # CONTRACT: stats() runs on the health-check path, so it must
+        # be fast and non-blocking (the engine's bounds its lock wait
+        # to 0.25 s) — a stats() that stalls past
+        # health_check_timeout_s gets its replica restarted, the same
+        # deal user check_health() methods already have
+        stats_fn = getattr(self._callable, "stats", None)
+        if callable(stats_fn):
+            try:
+                user = stats_fn()
+            except Exception as e:
+                # load signals are advisory; request serving must not
+                # depend on them
+                logger.debug("stats() of %s failed: %s",
+                             self._replica_id, e)
+                user = None
+            if inspect.isawaitable(user):
+                # an `async def stats()` would otherwise be silently
+                # dropped (and warn 'never awaited' every health tick)
+                if inspect.iscoroutine(user):  # Futures have no close()
+                    user.close()
+                logger.debug("stats() of %s is async; load signals "
+                             "must be a plain sync method",
+                             self._replica_id)
+                user = None
+            if isinstance(user, dict):
+                out["user_stats"] = user
+                try:
+                    out["engine_queue_depth"] = float(
+                        user["queue_depth"]
+                    )
+                except (KeyError, TypeError, ValueError) as e:
+                    logger.debug("queue_depth signal of %s unusable: "
+                                 "%s", self._replica_id, e)
+        return out
 
     def get_queue_len(self) -> int:
         return self._ongoing

@@ -32,7 +32,7 @@ logger = logging.getLogger(__name__)
 
 class _ReplicaInfo:
     __slots__ = ("replica_id", "handle", "max_ongoing", "local_inflight",
-                 "breaker")
+                 "breaker", "reported_depth")
 
     def __init__(self, replica_id: str, handle, max_ongoing: int,
                  breaker=None):
@@ -43,6 +43,22 @@ class _ReplicaInfo:
         # resolved once at table install: _try_pick runs per request
         # and must not take the process-wide breaker-board lock
         self.breaker = breaker
+        # controller-reported queue depth (an engine replica's
+        # queued+active backlog, or its in-flight count): the
+        # cross-router load signal this router's local_inflight can't
+        # see.  Refreshed on every table fetch.
+        self.reported_depth = 0.0
+
+    def score(self) -> float:
+        """Pow-2 comparison key: the max of the locally tracked
+        in-flight count and the replica-reported backlog.  max, not
+        sum — the reported depth already CONTAINS this router's own
+        dispatched requests, and summing would double-count them
+        (herding traffic away from a replica this router just used,
+        ping-ponging load on every refresh).  A replica drowning in
+        OTHER routers' (or slow in-engine) work still loses the coin
+        flip even when this router has sent it nothing."""
+        return max(float(self.local_inflight), self.reported_depth)
 
 
 class Router:
@@ -134,6 +150,12 @@ class Router:
                         _rpc.drop_breaker(self._breaker_key(rid))
                 self._replicas = new
                 self._version = table["version"]
+            # depth signals refresh on EVERY fetch — same-version
+            # tables still carry new load numbers
+            depths = table.get("depths") or {}
+            for rid, info in self._replicas.items():
+                if rid in depths:
+                    info.reported_depth = depths[rid]
             self._last_refresh = time.monotonic()
 
     def _needs_refresh(self, force: bool) -> bool:
@@ -271,7 +293,7 @@ class Router:
                 pick = cands[0]
             else:
                 a, b = random.sample(cands, 2)
-                pick = a if a.local_inflight <= b.local_inflight else b
+                pick = a if a.score() <= b.score() else b
             if pick.local_inflight < pick.max_ongoing:
                 pick.local_inflight += 1
                 return pick

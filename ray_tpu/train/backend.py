@@ -98,6 +98,26 @@ def _init_collective(world_size: int, rank: int, group_name: str):
 def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
     import jax
 
+    if num_processes > 1:
+        # CPU multi-process needs gloo collectives wired into the CPU
+        # client or every spanning computation dies with "Multiprocess
+        # computations aren't implemented on the CPU backend".  The
+        # flag must land via the config API BEFORE the backend
+        # initializes — jax 0.4.x never reads it from the environment
+        # (which is why env_vars alone can't fix this).  Set it
+        # unconditionally: probing the selected backend here would
+        # itself initialize it, and the flag only affects CPU-client
+        # construction (harmless on TPU hosts).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:
+            # older/newer flag surface: let initialize() proceed and
+            # surface the real capability error, if any
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "cpu gloo collectives flag unavailable: %s", e
+            )
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

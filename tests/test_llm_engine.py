@@ -4,7 +4,9 @@ The invariant: greedy decoding is deterministic and rows are
 independent, so every request served by the shared-slot engine must
 produce EXACTLY the tokens a dedicated `llama.generate` yields for
 the same prompt — across mixed lengths, mixed budgets, concurrent
-submission, slot reuse, and queueing beyond the slot count.
+submission, slot reuse, queueing beyond the slot count, paged KV
+block reuse, radix prefix-cache hits, and LRU eviction under
+block-pool pressure (RT008: all prompt RNGs seeded).
 """
 
 import numpy as np
@@ -14,6 +16,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.serve.kv_cache import BlockPool, RadixCache  # noqa: E402
 from ray_tpu.serve.llm_engine import LlamaEngine  # noqa: E402
 
 
@@ -79,10 +82,189 @@ def test_engine_validates_and_clamps(model):
             eng.submit([], 4).result(timeout=10)
         with pytest.raises(ValueError):
             eng.submit(list(range(40)), 4).result(timeout=10)
-        # budget clamped to the ring: T=20, ring 32 -> at most 11 new
+        # budget clamped to the sequence cap: T=20 -> at most 11 new
         out = eng.submit(list(range(1, 21)), 500).result(timeout=120)
         assert len(out) == 32 - 1 - 20
         s = eng.stats()
         assert s["active"] == 0 and s["free_slots"] == 2
+    finally:
+        eng.shutdown()
+
+
+# ----------------------------------------------------------------------
+# paged KV + radix prefix cache
+# ----------------------------------------------------------------------
+def _prompts_with_shared_system_prompt(cfg, n, rng):
+    """The consumer-scale shape: one shared system prompt + a short
+    per-request user tail."""
+    system = [int(x) for x in rng.randint(0, cfg.vocab_size, size=19)]
+    out = []
+    for _ in range(n):
+        tail = [int(x) for x in rng.randint(
+            0, cfg.vocab_size, size=int(rng.randint(1, 6)))]
+        out.append(system + tail)
+    return out
+
+
+@pytest.mark.parametrize("prefix_cache", [True, False])
+def test_paged_engine_bit_identical_prefix_on_off(model, prefix_cache):
+    """Shared-system-prompt workload: greedy outputs must match a
+    dedicated `llama.generate` exactly, with the radix cache on (later
+    requests skip the shared prefill) AND off (every request prefills
+    its full prompt)."""
+    cfg, params = model
+    eng = LlamaEngine(cfg, params, slots=4, max_len=64, chunk=4,
+                      block_size=8, prefix_cache=prefix_cache)
+    try:
+        rng = np.random.RandomState(1)
+        prompts = _prompts_with_shared_system_prompt(cfg, 9, rng)
+        futs = [(p, 7, eng.submit(p, 7)) for p in prompts]
+        for p, n_new, fut in futs:
+            got = fut.result(timeout=120)
+            assert got == _expected(cfg, params, p, n_new), (
+                f"prefix_cache={prefix_cache} diverged for T={len(p)}"
+            )
+        s = eng.stats()
+        if prefix_cache:
+            # 19-token system prompt = 2 full 8-token blocks shared;
+            # at least the later requests must have hit them
+            assert s["prefix_hit_tokens"] >= 2 * 8
+            assert 0.0 < s["prefix_hit_rate"] < 1.0
+        else:
+            assert s["prefix_hit_tokens"] == 0
+        assert s["active"] == 0 and s["queued"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_paged_engine_eviction_under_pool_pressure(model):
+    """A pool too small to cache every distinct prompt forces LRU
+    eviction of unpinned radix nodes; outputs stay exact and the pool
+    never leaks blocks."""
+    cfg, params = model
+    # 12 blocks of 8 = 96 tokens of KV for 2 slots of max_len 48
+    eng = LlamaEngine(cfg, params, slots=2, max_len=48, chunk=2,
+                      block_size=8, kv_blocks=12)
+    try:
+        rng = np.random.RandomState(2)
+        for round_ in range(3):
+            prompts = [
+                [int(x) for x in rng.randint(0, cfg.vocab_size, size=T)]
+                for T in (17, 20, 19, 18)
+            ]
+            futs = [(p, 6, eng.submit(p, 6)) for p in prompts]
+            for p, n_new, fut in futs:
+                got = fut.result(timeout=120)
+                assert got == _expected(cfg, params, p, n_new), (
+                    f"round {round_} diverged for T={len(p)}"
+                )
+        s = eng.stats()
+        # distinct 2-block prefixes * 3 rounds cannot all fit in 12
+        # blocks alongside live sequences: eviction must have fired
+        assert eng._radix.evicted_blocks > 0
+        # no leaks: free + cached == capacity once all requests finish
+        assert s["blocks_free"] + s["blocks_cached"] == s["blocks_total"]
+        assert s["active"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_paged_engine_rejects_pool_smaller_than_one_sequence(model):
+    """The admission invariant rests on the pool always covering one
+    max_len sequence; a budget below that must fail fast, not deadlock
+    a request mid-queue."""
+    cfg, params = model
+    with pytest.raises(ValueError, match="kv_blocks"):
+        LlamaEngine(cfg, params, slots=2, max_len=48, chunk=2,
+                    block_size=8, kv_blocks=5)
+
+
+def test_gather_width_tracks_live_tokens_not_pool_budget(model):
+    """The paged claim itself, shape-level and deterministic: the
+    chunk dispatch's gather width W (blocks per slot the compiled
+    program attends over) depends on LIVE sequence lengths only.  An
+    over-provisioned pool (1024-token budget) runs the SAME compiled
+    programs as a workload-sized one — the measured ~20x ring tax
+    cannot exist by construction.  The wall-clock counterpart is
+    `python -m ray_tpu.scripts.perf --engine-trace` (PERF.md)."""
+    cfg, params = model
+    rng = np.random.RandomState(3)
+    prompt = [int(x) for x in rng.randint(0, cfg.vocab_size, size=24)]
+    widths = {}
+    for label, kv_blocks in (("sized", 48 // 8 * 2), ("over", 128)):
+        # budget 1024 tokens (128 blocks of 8) vs workload-sized 96
+        eng = LlamaEngine(cfg, params, slots=2, max_len=48, chunk=4,
+                          block_size=8, kv_blocks=kv_blocks)
+        try:
+            assert eng.submit(prompt, 8).result(timeout=120) == _expected(
+                cfg, params, prompt, 8
+            )
+            widths[label] = eng.stats()["gather_blocks"]
+            assert eng.stats()["blocks_total"] == kv_blocks
+        finally:
+            eng.shutdown()
+    assert widths["sized"] == widths["over"] > 0
+    # W covers the live sequence (24 prompt + 8 new -> 4 blocks of 8),
+    # nowhere near the 128-block budget
+    assert widths["over"] <= 8
+
+
+# ----------------------------------------------------------------------
+# kv_cache bookkeeping units
+# ----------------------------------------------------------------------
+def test_block_pool_alloc_free_accounting():
+    pool = BlockPool(8)
+    assert pool.capacity == 7
+    got = pool.alloc(7)
+    assert sorted(got) == list(range(1, 8))  # scratch block 0 reserved
+    assert pool.alloc(1) is None
+    pool.free(got[:3])
+    assert pool.free_blocks == 3
+    with pytest.raises(ValueError):
+        pool.free([0])
+
+
+def test_radix_cache_match_insert_evict():
+    pool = BlockPool(16)
+    cache = RadixCache(4, pool)
+    toks = list(range(1, 14))  # 13 tokens -> 3 shareable 4-blocks
+    blocks, path = cache.match(toks)
+    assert blocks == [] and path == []
+    own = pool.alloc(3)
+    path, adopted = cache.insert(toks, path, own)
+    assert adopted == own and cache.cached_blocks == 3
+    # pinned: eviction must not touch the path
+    assert cache.evict(10) == 0
+    cache.release(path)
+    # a second request re-matches the full prefix and re-pins it
+    blocks2, path2 = cache.match(toks + [99])
+    assert blocks2 == own
+    assert cache.evict(10) == 0  # pinned again
+    cache.release(path2)
+    # unpinned now: leaves evict deepest-first until drained
+    freed = cache.evict(2)
+    assert freed == 2 and cache.cached_blocks == 1
+    assert pool.free_blocks == pool.capacity - 1
+    assert cache.evict(5) == 1 and cache.cached_blocks == 0
+
+
+def test_prefix_cache_disabled_for_non_dense_attention(model):
+    """forward_with_prefix mirrors DENSE attention numerics; under any
+    other attention backend the engine must refuse prefix reuse rather
+    than risk cache-on/cache-off greedy divergence."""
+    cfg, params = model
+    import dataclasses
+
+    flash_cfg = dataclasses.replace(cfg, attention="flash")
+    eng = LlamaEngine(flash_cfg, params, slots=2, max_len=32, chunk=2,
+                      block_size=8)
+    try:
+        assert eng._radix is None
+    finally:
+        eng.shutdown()
+    eng = LlamaEngine(cfg, params, slots=2, max_len=32, chunk=2,
+                      block_size=8)
+    try:
+        assert eng._radix is not None  # dense keeps the cache
     finally:
         eng.shutdown()

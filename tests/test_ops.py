@@ -9,6 +9,15 @@ import pytest
 from ray_tpu.ops import flash_attention
 from ray_tpu.parallel.moe import MoEConfig, init_moe, moe_forward
 from ray_tpu.parallel.ring_attention import plain_attention
+from ray_tpu.testing import pallas_kernel_support
+
+_pallas_ok, _pallas_why = pallas_kernel_support("attention")
+# the MoE tests below need no Pallas — guard only the kernel tests
+requires_pallas = pytest.mark.skipif(
+    not _pallas_ok,
+    reason=f"Pallas flash-attention kernels unavailable in this "
+           f"JAX/Pallas environment: {_pallas_why}",
+)
 
 
 def _qkv(B=2, T=64, H=4, D=16, seed=0, dtype=jnp.float32):
@@ -17,6 +26,7 @@ def _qkv(B=2, T=64, H=4, D=16, seed=0, dtype=jnp.float32):
     return tuple(jax.random.normal(k, shape, dtype) * 0.3 for k in ks)
 
 
+@requires_pallas
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_attention_matches_plain(causal):
     q, k, v = _qkv()
@@ -27,6 +37,7 @@ def test_flash_attention_matches_plain(causal):
     )
 
 
+@requires_pallas
 def test_flash_attention_grad_matches_plain():
     q, k, v = _qkv(T=32)
 
@@ -44,6 +55,7 @@ def test_flash_attention_grad_matches_plain():
         )
 
 
+@requires_pallas
 @pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16)])
 def test_flash_attention_grad_rect_blocks(bq, bk):
     """Rectangular blocks exercise the causal block-skip predicates and
@@ -64,6 +76,7 @@ def test_flash_attention_grad_rect_blocks(bq, bk):
         )
 
 
+@requires_pallas
 def test_flash_attention_bf16():
     q, k, v = _qkv(T=64, dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, True, 32, 32, True)
@@ -159,6 +172,7 @@ def test_moe_expert_parallel_matches_local():
     )
 
 
+@requires_pallas
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_attention_grad_fused_single_tile(causal):
     """blocks == T dispatches the FUSED single-tile backward (one

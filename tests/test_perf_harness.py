@@ -54,6 +54,31 @@ def test_core_split_accounting():
     assert split["bottleneck"] in ("driver", "noded", "worker_pool")
 
 
+def test_engine_trace_smoke_rows():
+    """`--engine-trace`: the serve_llm_cb regression canary plus the
+    paged-KV acceptance rows, structurally validated (timing claims
+    live in PERF.md, measured on an idle box):
+    - budget invariance: the over-provisioned pool runs the SAME
+      compiled chunk programs as the workload-sized one (equal gather
+      widths) — the mechanism that kills the ring-size tax;
+    - radix reuse: prefix_on prefills strictly fewer tokens than
+      prefix_off on the shared-system-prompt workload."""
+    from ray_tpu.scripts.perf import main
+
+    results = main(["--engine-trace", "--engine-requests", "12"])
+    smoke = results["serve_llm_cb_smoke"]
+    assert smoke["tokens_per_sec"] > 0
+    assert smoke["ticks"] > 0
+    assert results["sized"]["gather_blocks"] == \
+        results["overprovisioned"]["gather_blocks"] > 0
+    assert results["overprovisioned"]["kv_budget_tokens"] > \
+        5 * results["sized"]["kv_budget_tokens"]
+    assert results["prefix_on"]["prefix_hit_tokens"] > 0
+    assert results["prefix_on"]["prefill_tokens"] < \
+        results["prefix_off"]["prefill_tokens"]
+    assert results["prefix_off"]["prefix_hit_tokens"] == 0
+
+
 def test_pin_cores_rejects_oversubscription():
     import os
 

@@ -1,0 +1,128 @@
+"""Queue-depth-aware routing across LLM engine replicas.
+
+The serve scale-out path for the continuous-batching engine: replicas
+export queue-depth signals via `stats()` (piggybacked on health
+checks), the controller folds them into routing tables, and the
+router's pow-2 choice weighs reported backlog — so N engine replicas
+share load by actual queue depth, not just each router's local
+in-flight view.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    rt.shutdown()
+
+
+@pytest.fixture()
+def serve_instance(cluster):
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+def test_pow2_pick_weighs_reported_queue_depth():
+    """Unit-level: a replica reporting deep engine backlog loses the
+    pow-2 coin flip even when this router has sent it nothing."""
+    from ray_tpu.serve.router import Router, _ReplicaInfo
+
+    r = Router("dep", "app")
+    a = _ReplicaInfo("r#0", None, max_ongoing=100)
+    b = _ReplicaInfo("r#1", None, max_ongoing=100)
+    a.reported_depth = 50.0  # drowning in other routers' work
+    b.reported_depth = 0.0
+    r._replicas = {"r#0": a, "r#1": b}
+    picks = [r._try_pick() for _ in range(32)]
+    assert all(p is b for p in picks)
+    # local in-flight still counts: pile local load onto b and a's
+    # reported backlog stops dominating
+    a.local_inflight = 0
+    b.local_inflight = 60
+    b.reported_depth = 60.0
+    assert all(r._try_pick() is a for _ in range(8))
+
+
+def test_install_table_refreshes_depths_without_version_bump():
+    from ray_tpu.serve.router import Router, _ReplicaInfo
+
+    r = Router("dep", "app")
+    info = _ReplicaInfo("r#0", None, max_ongoing=8)
+    r._replicas = {"r#0": info}
+    r._version = 7
+    r._install_table({
+        "version": 7, "incarnation": "i", "replicas": {},
+        "depths": {"r#0": 13.0},
+    })
+    assert info.reported_depth == 13.0
+    # replica table untouched (same version): identity preserved
+    assert r._replicas["r#0"] is info
+
+
+def test_engine_replicas_share_load_by_queue_depth(serve_instance):
+    """End-to-end on a 2-replica tiny engine deployment: both engines
+    serve traffic, their stats() flow into the controller's routing
+    table and /api/serve status."""
+    from ray_tpu.examples.serve_llm import ContinuousLlamaService
+
+    app = ContinuousLlamaService.options(
+        num_replicas=2, autoscaling_config=None,
+        max_ongoing_requests=64, health_check_timeout_s=120.0,
+    ).bind(model_size="tiny", max_new_tokens=4, slots=4, chunk=2,
+           max_len=40, block_size=8, jax_platform="cpu")
+    h = serve.run(app, name="llm2", route_prefix="/llm2",
+                  timeout_s=300.0)
+    try:
+        prompt = list(range(1, 13))
+        responses = [
+            h.generate.remote([prompt], 4) for _ in range(24)
+        ]
+        for r in responses:
+            out = r.result(timeout_s=120)
+            assert len(out) == 1 and len(out[0]) == 4
+        from ray_tpu.serve.api import _get_controller
+
+        controller = _get_controller()
+        # both replicas' engines served prefills (traffic was spread)
+        deadline = time.time() + 30
+        engines = {}
+        while time.time() < deadline:
+            per = rt.get(controller.get_replica_metrics.remote())
+            engines = {
+                rid: m.get("user_stats") or {}
+                for rid, m in per.get("llm2", {})
+                .get("ContinuousLlamaService", {}).items()
+            }
+            if (len(engines) == 2
+                    and all(e.get("prefill_calls", 0) > 0
+                            for e in engines.values())):
+                break
+            time.sleep(0.3)
+        assert len(engines) == 2, engines
+        assert all(e.get("prefill_calls", 0) > 0
+                   for e in engines.values()), engines
+        # the routing table carries a depth entry per running replica
+        table = rt.get(controller.get_routing_table.remote(
+            "llm2", "ContinuousLlamaService"
+        ))
+        assert set(table["depths"]) == set(table["replicas"])
+        assert len(table["depths"]) == 2
+        # /api/serve's status view exposes the per-replica engine panel
+        status = rt.get(controller.get_serve_status.remote())
+        reps = status["llm2"]["ContinuousLlamaService"]["replicas"]
+        assert len(reps) == 2
+        for rep in reps.values():
+            assert "queue_depth" in rep
+            assert rep["engine"]["blocks_total"] > 0
+            assert "prefix_hit_rate" in rep["engine"]
+    finally:
+        serve.delete("llm2")

@@ -38,6 +38,24 @@ _WORKER_ENV = {
 }
 
 
+@pytest.fixture(scope="module")
+def multiproc_cpu():
+    """Capability gate: multi-process CPU XLA needs a jaxlib whose CPU
+    client wires cross-process (gloo) collectives — some builds fail
+    every spanning computation with "Multiprocess computations aren't
+    implemented on the CPU backend".  Probe once (a real 2-process
+    allgather in subprocesses) and SKIP with the environment's own
+    error instead of failing tier-1 over a missing capability."""
+    from ray_tpu.testing import jax_multiprocess_cpu_support
+
+    ok, why = jax_multiprocess_cpu_support()
+    if not ok:
+        pytest.skip(
+            f"multi-process CPU XLA unsupported in this JAX/jaxlib "
+            f"environment: {why}"
+        )
+
+
 def _gpt2_spmd_loop(config):
     """Train tiny GPT-2 on the GLOBAL mesh with dp/fsdp sharding;
     sharded-checkpoint every step; optionally die at a given step."""
@@ -151,7 +169,7 @@ def _gpt2_spmd_loop(config):
         )
 
 
-def test_jax_distributed_global_mesh(rt_start, tmp_path):
+def test_jax_distributed_global_mesh(multiproc_cpu, rt_start, tmp_path):
     """N separate worker processes form ONE jax runtime; tiny GPT-2
     trains under a global dp x fsdp mesh spanning both processes."""
     trainer = JaxTrainer(
@@ -172,7 +190,7 @@ def test_jax_distributed_global_mesh(rt_start, tmp_path):
     assert losses[-1] < losses[0]
 
 
-def test_jax_distributed_restart_reshards(rt_start, tmp_path):
+def test_jax_distributed_restart_reshards(multiproc_cpu, rt_start, tmp_path):
     """Kill rank 1 mid-training; the restarted group resumes from the
     sharded checkpoint on a DIFFERENT mesh layout and finishes."""
     trainer = JaxTrainer(

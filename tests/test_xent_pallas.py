@@ -11,6 +11,14 @@ from ray_tpu.ops.xent_pallas import (
     pallas_cross_entropy,
     reference_cross_entropy,
 )
+from ray_tpu.testing import pallas_kernel_support
+
+_pallas_ok, _pallas_why = pallas_kernel_support("xent")
+pytestmark = pytest.mark.skipif(
+    not _pallas_ok,
+    reason=f"Pallas xent kernel unavailable in this JAX/Pallas "
+           f"environment: {_pallas_why}",
+)
 
 
 @pytest.mark.parametrize("n,e,v,bn,bv", [
