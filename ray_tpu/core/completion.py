@@ -1,0 +1,380 @@
+"""Completion plane: owner-side task completion and the coalesced
+completion frames that feed it.
+
+Split out of `core/runtime.py` with the owner-shard refactor (the file
+was ~3.9k lines and the completion path is the driver's hot loop).
+Three pieces live here:
+
+- `complete_task(rt, result)` — the owner's exactly-once completion
+  state machine (retry/backoff/budget decisions, return ingestion,
+  ref-count release; reference: `task_manager.cc` CompletePendingTask).
+  Called from shard loops, the main io loop, and submitter threads;
+  all shared state is guarded by `rt._state_lock`.
+- `ingest_results(rt, results, conn)` — one executor connection
+  delivered a batch of completions: lease bookkeeping, per-result
+  completion, then ONE drain + idle-lease pass for the whole batch
+  (this amortization is the owner-side win of batching; the wire-level
+  win is one frame decode + one dispatch task instead of N).
+- `ResultCoalescer` — executor-side: task results bound for the same
+  (connection, owner) coalesce into one `task_result_batch` frame per
+  event-loop tick.  `call_soon`-scheduled, so a burst of completions
+  in one tick ships as one frame with ZERO added latency for the
+  single-task case (the flush runs before the loop ever sleeps).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.retry import backoff_delay_s
+from ray_tpu.core.task_spec import TaskResult, TaskResultBatch
+
+logger = logging.getLogger(__name__)
+
+_INLINE = "inline"
+_SHM = "shm"
+
+
+def complete_task(rt, result: TaskResult) -> list:
+    """Owner-side final/retry completion of one task.  Returns the
+    pending ACK futures of contained-borrow registrations made while
+    ingesting the result (awaited by `ingest_results` before confirming
+    `transit_release`).
+
+    Exactly-once: the `pending_tasks.pop` under `rt._state_lock` is the
+    single commit point — a duplicate completion frame (retry races,
+    relayed + direct delivery) finds no pending entry and is a no-op.
+    """
+    acks: list = []
+    resubmit = False
+    try:
+        with rt._state_lock:
+            pt = rt.pending_tasks.pop(result.task_id.binary(), None)
+            if pt is None:
+                return acks
+            if result.status == "ok":
+                # successes refill the retry budget (core/retry.py):
+                # steady progress re-earns the right to retry
+                rt._retry_budget.record_success()
+                if pt.deadline_timer is not None:
+                    # Handle.cancel() only sets a flag — safe off-loop
+                    pt.deadline_timer.cancel()
+                rt.task_events.record(
+                    result.task_id.binary(), pt.spec.name, "FINISHED",
+                    duration=(result.execution_info or {}).get("duration"),
+                )
+                _count_shard_completion(rt, pt.spec)
+                stream = rt._streams.get(result.task_id.binary())
+                if stream is not None:
+                    stream.total = int(
+                        (result.execution_info or {}).get(
+                            # fallback counts delivered + pending, not
+                            # just unconsumed, or it would truncate
+                            "num_items",
+                            stream.consumed + len(stream.items),
+                        )
+                    )
+                    rt.loop.call_soon_threadsafe(stream.event.set)
+                    rt.loop.call_soon_threadsafe(stream.done.set)
+                for i, ret in enumerate(result.returns):
+                    oid = ObjectID.for_return(result.task_id, i + 1)
+                    st = rt.objects.get(oid.binary())
+                    if st is None:
+                        continue
+                    if ret[0] == _INLINE:
+                        st.where, st.value, st.size = (
+                            _INLINE, ret[1], len(ret[1])
+                        )
+                        contained = ret[2] if len(ret) > 2 else None
+                    else:
+                        st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
+                        contained = ret[3] if len(ret) > 3 else None
+                    if contained:
+                        rt._register_contained(oid.binary(), contained, acks)
+                    st.ready.set()
+                for a in pt.spec.args:
+                    if _is_argref(a):
+                        rc = rt.refs.get(a.id_bytes)
+                        if rc:
+                            rc.submitted -= 1
+                            rt._maybe_free(a.id_bytes)
+                rt._release_transit(pt.transit)
+                pt.transit = []
+                # popped at EVERY final completion path (incl. the
+                # worker-died/cancel callers), so dead attempts can't
+                # leak ack lists or poison a retry
+                acks.extend(
+                    rt._stream_reg_acks.pop(result.task_id.binary(), ())
+                )
+                return acks
+            # failure path
+            retriable = result.status == "worker_died" or (
+                result.status == "error" and pt.spec.retry_exceptions
+            )
+            if (pt.spec.actor_id is not None
+                    and result.status == "worker_died"):
+                retriable = pt.spec.max_retries > 0
+            retry_delay = 0.0
+            override_err: Optional[BaseException] = None
+            if retriable and pt.retries_left > 0:
+                now = time.monotonic()
+                deadline = pt.spec.deadline_s
+                # capped exponential backoff with full jitter; the
+                # legacy task_retry_delay_ms is the floor (core/retry.py)
+                retry_delay = backoff_delay_s(
+                    pt.attempts,
+                    base_s=rt.cfg.task_retry_backoff_base_ms / 1000.0,
+                    cap_s=rt.cfg.task_retry_backoff_max_ms / 1000.0,
+                    floor_s=rt.cfg.task_retry_delay_ms / 1000.0,
+                    rng=rt._retry_rng,
+                )
+                if deadline is not None and now + retry_delay >= deadline:
+                    # the caller's budget would expire during the
+                    # backoff: fail fast instead of re-queueing work
+                    # nobody is waiting for
+                    override_err = exc.DeadlineExceededError(
+                        f"task {pt.spec.name!r} failed "
+                        f"({result.status}) and its deadline leaves no "
+                        f"room to retry ({pt.attempts} retries were "
+                        f"attempted); failing fast"
+                    )
+                elif not rt._retry_budget.try_acquire():
+                    # correlated-failure regime: the budget is drained,
+                    # so degrade to fail-fast instead of amplifying load
+                    override_err = exc.TaskError(
+                        f"task {pt.spec.name!r} failed "
+                        f"({result.status}) and the runtime retry "
+                        f"budget is exhausted after "
+                        f"{pt.attempts + 1} attempts "
+                        f"({pt.attempts} retries granted); failing "
+                        f"fast instead of amplifying load",
+                        cause_type="RetryBudgetExhausted",
+                    )
+                else:
+                    pt.retries_left -= 1
+                    pt.attempts += 1
+                    rt.pending_tasks[result.task_id.binary()] = pt
+                    logger.info(
+                        "retrying task %s in %.0f ms (%d retries left)",
+                        pt.spec.task_id.hex(),
+                        retry_delay * 1000.0,
+                        pt.retries_left,
+                    )
+                    resubmit = True
+            if not resubmit:
+                if pt.deadline_timer is not None:
+                    pt.deadline_timer.cancel()
+                rt.task_events.record(
+                    result.task_id.binary(), pt.spec.name, "FAILED",
+                    error=result.status,
+                )
+                _count_shard_completion(rt, pt.spec)
+                if override_err is not None:
+                    envelope = ser.serialize_to_bytes(
+                        override_err, tag=ser.TAG_ERROR
+                    )
+                elif result.error is not None:
+                    envelope = result.error
+                elif pt.spec.actor_id is not None:
+                    envelope = ser.serialize_to_bytes(
+                        exc.ActorDiedError(actor_id=pt.spec.actor_id),
+                        tag=ser.TAG_ERROR,
+                    )
+                else:
+                    envelope = ser.serialize_to_bytes(
+                        exc.WorkerCrashedError("worker died"),
+                        tag=ser.TAG_ERROR,
+                    )
+                stream = rt._streams.get(result.task_id.binary())
+                if stream is not None:
+                    stream.error = envelope
+                    rt.loop.call_soon_threadsafe(stream.event.set)
+                    rt.loop.call_soon_threadsafe(stream.done.set)
+                for i in range(max(pt.spec.num_returns, 0)):
+                    oid = ObjectID.for_return(result.task_id, i + 1)
+                    st = rt.objects.get(oid.binary())
+                    if st is not None:
+                        st.error = envelope
+                        st.ready.set()
+                for a in pt.spec.args:
+                    if _is_argref(a):
+                        rc = rt.refs.get(a.id_bytes)
+                        if rc:
+                            rc.submitted -= 1
+                            rt._maybe_free(a.id_bytes)
+                rt._release_transit(pt.transit)
+                pt.transit = []
+                acks.extend(
+                    rt._stream_reg_acks.pop(result.task_id.binary(), ())
+                )
+    finally:
+        # completion may run on a shard loop / submitter thread while a
+        # get()/wait() sleeps on the MAIN loop's selector: the ready
+        # Events are set (flag visible immediately) but their waiter
+        # callbacks were queued with plain call_soon, which does not
+        # wake a sleeping loop from another thread — nudge it
+        rt._wake_main_loop()
+    if resubmit:
+        spec = pt.spec
+
+        def _resend():
+            if spec.actor_id is not None:
+                rt._push_actor_task(spec.actor_id.binary(), spec)
+            else:
+                rt._push_or_queue(spec)
+
+        if retry_delay > 0:
+            # complete_task runs on io/shard AND submitter threads;
+            # call_later is only loop-thread-safe, so hop in
+            try:
+                rt.loop.call_soon_threadsafe(
+                    rt.loop.call_later, retry_delay, _resend
+                )
+            except RuntimeError:
+                pass  # loop closed mid-teardown
+        else:
+            _resend()
+    return acks
+
+
+def _is_argref(a) -> bool:
+    from ray_tpu.core.task_spec import ArgRef
+
+    return isinstance(a, ArgRef)
+
+
+def _count_shard_completion(rt, spec):
+    """Per-shard exactly-once accounting (normal tasks only; actor
+    tasks ride the main-loop actor plane).  Caller holds _state_lock —
+    shard.lock nests inside it by the documented order."""
+    if spec.actor_id is not None or not rt._shards:
+        return
+    shard = rt._shard_for(spec.task_id.binary())
+    with shard.lock:
+        shard.completed += 1
+
+
+async def ingest_results(rt, results: List[TaskResult], conn) -> None:
+    """One executor connection delivered `results` (a coalesced batch,
+    or a single legacy `task_result` frame).  Lease/actor bookkeeping
+    and the drain + idle-lease pass run ONCE per batch; completion and
+    the transit-release confirmation stay per task."""
+    entry = rt._find_lease(conn)
+    assigned = None
+    if entry is not None:
+        shard, pool, lease = entry
+        with shard.lock:
+            for r in results:
+                if lease.assigned.pop(r.task_id.binary(), None) is not None:
+                    lease.in_flight -= 1
+    else:
+        with rt._state_lock:
+            assigned = rt._actor_assigned.get(conn)
+            if assigned is not None:
+                for r in results:
+                    assigned.pop(r.task_id.binary(), None)
+    per_task = [(r, complete_task(rt, r)) for r in results]
+    if entry is not None:
+        # dispatch first: queued tasks must not idle behind the
+        # borrow-ack confirmation below (which only gates the
+        # executor's transit_release, not this worker's reuse)
+        shard.drain_pool(pool, lease)
+        await shard.maybe_return_lease(pool, lease)
+    if entry is None and assigned is None:
+        return  # daemon relay, not an executor conn: no transit pins
+    # executor conns only: confirm that the contained borrows in each
+    # result (and its stream items) are ON THE BOOKS at their owners
+    # before releasing the executor's transit pins; a failed
+    # registration keeps the pins (job-exit fallback) instead of
+    # risking a free
+    for r, acks in per_task:
+        confirmed = True
+        if acks:
+            done, pending = await asyncio.wait(
+                [asyncio.wrap_future(f) for f in acks], timeout=10
+            )
+            confirmed = not pending and all(
+                t.exception() is None for t in done
+            )
+            for t in pending:
+                t.cancel()
+        if confirmed:
+            try:
+                conn.send("transit_release",
+                          {"task_id": r.task_id.binary()})
+            except Exception as e:
+                logger.debug("transit_release dropped: %s", e)
+
+
+class ResultCoalescer:
+    """Executor-side completion coalescing: results bound for the same
+    (connection, owner) within one event-loop tick ship as ONE
+    `task_result_batch` frame.  Runs entirely on the executing
+    runtime's io loop (where `_exec_task` finishes), so no lock.
+
+    `call_soon` (not `call_later`) scheduling means the flush runs at
+    the end of the CURRENT loop iteration: a lone result is delayed by
+    zero ticks (the sync `rt.get(f.remote())` latency path is
+    untouched) while a pipelined burst — up to PIPELINE_DEPTH
+    completions posted back by the exec pool in one tick — coalesces.
+    """
+
+    MAX_BATCH = 128
+
+    def __init__(self, rt):
+        self.rt = rt
+        self._pending: dict = {}  # (conn, owner_tuple) -> [TaskResult]
+        self._scheduled = False
+        # observability: ships/frames ratio is the measured coalescing
+        # factor (surfaced via perf.py --storm on the worker side)
+        self.results_sent = 0
+        self.frames_sent = 0
+
+    def enqueue(self, conn, owner, result: TaskResult):
+        key = (conn, tuple(owner))
+        q = self._pending.get(key)
+        if q is None:
+            q = self._pending[key] = []
+        q.append(result)
+        if len(q) >= self.MAX_BATCH:
+            self._flush_key(key)
+            return
+        if not self._scheduled:
+            self._scheduled = True
+            self.rt.loop.call_soon(self._flush_all)
+
+    def _flush_all(self):
+        self._scheduled = False
+        for key in list(self._pending):
+            self._flush_key(key)
+
+    def _flush_key(self, key):
+        q = self._pending.pop(key, None)
+        if not q:
+            return
+        conn, owner = key
+        self.results_sent += len(q)
+        self.frames_sent += 1
+        try:
+            conn.send("task_result_batch",
+                      TaskResultBatch(owner=tuple(owner), results=q))
+            return
+        except Exception as e:
+            # origin went away: route each result via the node daemon
+            logger.debug("direct task_result_batch failed (%s); routing "
+                         "via noded", e)
+        for r in q:
+            try:
+                self.rt.noded.send(
+                    "task_done", {"result": r, "owner": list(owner)}
+                )
+            except Exception as e:
+                logger.debug("task_done via noded also failed: %s", e)
+
+

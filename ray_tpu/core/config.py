@@ -84,6 +84,19 @@ class Config:
     #: idle worker caching in the worker pool rather than instant
     #: return, `worker_pool.h` idle policy)
     lease_keepalive_ms: int = 500
+    #: driver-side owner shards (RT_OWNER_SHARDS).  1 = the classic
+    #: single-owner plane (everything on the runtime's io loop).  N>1
+    #: splits task-lifecycle submission/completion across N event loops
+    #: on N threads, each with its own node-daemon connection and lease
+    #: pools, keyed by task id — the driver plane then scales with
+    #: cores instead of one asyncio loop (reference analog: the
+    #: GCS/raylet split that lets the reference drain 1M queued tasks
+    #: across 64 cores; see docs/control_plane.md).
+    owner_shards: int = 1
+    #: max lease grants asked of the node daemon in ONE request_lease
+    #: round — a submission burst amortizes lease negotiation over a
+    #: batch instead of one RPC per worker grant
+    lease_request_batch: int = 16
     #: top-k fraction for hybrid scheduling randomization (reference
     #: hybrid policy top-k, `hybrid_scheduling_policy.h:50`)
     scheduler_top_k_fraction: float = 0.2

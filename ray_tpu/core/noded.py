@@ -1171,21 +1171,55 @@ class NodeDaemon:
                 return
 
     async def handle_request_lease(self, payload, conn):
-        """Grant a leased worker to a caller; returns (worker_id,
-        socket_path) or None if nothing is available right now
-        (reference: `HandleRequestWorkerLease` node_manager.cc:1797)."""
+        """Grant leased worker(s) to a caller (reference:
+        `HandleRequestWorkerLease` node_manager.cc:1797).
+
+        With `count` in the payload (the batched negotiation of the
+        sharded owner plane) the reply is `{"grants": [(worker_id,
+        socket_path), ...]}` — up to `count` grants from ONE daemon
+        pass, so a submission burst amortizes lease RPCs instead of
+        paying one round trip per worker.  Without `count` the legacy
+        single-grant shapes are preserved: (worker_id, socket_path),
+        None, {"infeasible": True}, or {"env_error": ...}."""
         demand = payload["resources"]
         holder = self._conn_worker.get(conn, "remote")
+        batched = "count" in payload
+        want = max(1, int(payload.get("count", 1)))
         if not _fits(demand, self.total_resources):
             # never feasible on this node: tell the caller to reroute
             # through the queue path, which spills to a feasible node
             # (reference: spillback in cluster_task_manager.cc:44)
             return {"infeasible": True}
+        env_hash = payload.get("env_hash")
+        container = payload.get("container")
+        grants = []
+        err = None
+        for _ in range(want):
+            grant = await self._grant_one_lease(
+                demand, env_hash, container, holder
+            )
+            if isinstance(grant, dict):  # env_error from a spawn attempt
+                err = grant
+                break
+            if grant is None:
+                break
+            grants.append(grant)
+        if batched:
+            if not grants and err is not None:
+                return err
+            return {"grants": grants}
+        if grants:
+            return grants[0]
+        return err  # None or {"env_error": ...}
+
+    async def _grant_one_lease(self, demand, env_hash, container, holder):
+        """One grant attempt: (worker_id, socket_path) on success, None
+        when nothing is available right now (spawn-on-demand may have
+        been kicked), or {"env_error": ...} when the env can never
+        materialize here."""
         if not _fits(demand, self.available):
             return None
         tpu_n = self._tpu_chips_needed(demand)
-        env_hash = payload.get("env_hash")
-        container = payload.get("container")
         w = self._pick_idle_worker(
             tpu_n, env_hash=env_hash,
             require_exact_env=container is not None,
@@ -1273,6 +1307,22 @@ class NodeDaemon:
     # worker replies arrive as task_result on its registration conn for
     # tasks this daemon dispatched (spillback / relayed actor tasks)
     handle_task_result = handle_task_done
+
+    async def handle_task_result_batch(self, payload, conn):
+        """Coalesced completion frame from a worker (daemon-dispatched
+        tasks reply on the registration conn): per-result lease
+        bookkeeping, then ONE routed frame to the owner for the whole
+        batch — the daemon's relay cost stays O(#frames)."""
+        results = list(payload.results)
+        owner = tuple(payload.owner)
+        wid = self._conn_worker.get(conn)
+        w = self.workers.get(wid) if wid else None
+        if w is not None:
+            for r in results:
+                w.in_flight.pop(r.task_id.binary(), None)
+            self._release_lease(w)
+        await self._route_to_owner(owner, "task_result_batch", payload)
+        self._schedule()
 
     async def handle_task_stream(self, payload, conn):
         """Relay one streaming-generator item to the task's owner (used

@@ -1,0 +1,530 @@
+"""Owner shard: one submission/completion lane of the driver's owner
+plane.
+
+The task hot path used to live entirely on the runtime's single io
+loop (~580 us of driver CPU per task on one core — PERF.md's measured
+cost model), which caps one driver at ~1.7k tasks/s no matter how many
+cores the head node has.  `Runtime` now owns N of these shards, keyed
+by task id: each shard runs its own asyncio loop on its own thread,
+holds its own connection to the node daemon, negotiates its own worker
+leases (batched: one `request_lease` round carries `count` grants for a
+submission burst), and receives its own completion frames (coalesced:
+executors reply `task_result_batch` per connection tick).  Shard state
+— lease pools, in-flight assignment — is guarded by a shard-local lock;
+cross-shard object/ref state stays in the runtime under `_state_lock`
+(lock order: `_state_lock` outer, `shard.lock` inner, never reversed).
+
+With `owner_shards = 1` (the default) the shard shares the runtime's io
+loop and node connection — byte-for-byte the classic single-owner
+plane.
+
+Reference analog: the GCS/raylet split of SURVEY layers 3-4, which is
+what lets the reference drain 1M queued tasks across 64 cores; here the
+split is owner-internal because the owner (not the daemon) is the
+measured bottleneck (~580 us vs ~30 us per task).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.retry import backoff_delay_s
+from ray_tpu.core.task_spec import TaskResult, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+# Max tasks pushed ahead of completion on one leased worker (the
+# reference's max_tasks_in_flight_per_worker).  The worker runs normal
+# tasks on a thread pool at least this wide, so a task that blocks
+# (collectives, nested gets) never deadlocks a pipelined successor and
+# short tasks are not serialized behind long ones.
+PIPELINE_DEPTH = 4
+
+
+class Lease:
+    """One leased worker with pipelined pushes."""
+
+    __slots__ = ("worker_id", "conn", "in_flight", "assigned", "idle_token",
+                 "socket_path")
+
+    def __init__(self, worker_id: str, conn: rpc.Connection,
+                 socket_path: str = ""):
+        self.worker_id = worker_id
+        self.conn = conn
+        self.in_flight = 0
+        self.assigned: Dict[bytes, TaskSpec] = {}
+        # bumped each time the lease goes idle; lets the delayed-return
+        # timer detect an intervening busy period and stand down
+        self.idle_token = 0
+        # breaker-board key material: the breaker for a retired socket
+        # is dropped on close so the board stays bounded by live peers
+        self.socket_path = socket_path
+
+
+class LeasePool:
+    """Per-resource-signature pool of leased workers + overflow queue
+    (reference: one lease request pipeline per SchedulingKey,
+    `normal_task_submitter.h`)."""
+
+    __slots__ = ("sig", "demand", "leases", "queue", "requesting",
+                 "env_hash", "container")
+
+    def __init__(self, sig, demand):
+        self.sig = sig
+        self.demand = demand
+        self.leases: Dict[str, Lease] = {}
+        self.queue: deque = deque()
+        self.container = None
+        self.requesting = False
+        self.env_hash: Optional[str] = None  # runtime-env dedication
+
+
+def _thread_cpu_seconds(native_tid: Optional[int]) -> float:
+    """CPU seconds burned by one kernel thread of this process, from
+    /proc (utime+stime) — readable from ANY thread, unlike
+    CLOCK_THREAD_CPUTIME_ID.  Feeds the per-shard us/task accounting
+    perf.py reports."""
+    if native_tid is None:
+        return 0.0
+    try:
+        with open(f"/proc/self/task/{native_tid}/stat") as f:
+            stat = f.read()
+    except OSError:
+        return 0.0
+    rest = stat.rsplit(")", 1)[1].split()
+    return (int(rest[11]) + int(rest[12])) / os.sysconf("SC_CLK_TCK")
+
+
+class OwnerShard:
+    """One lane of the owner plane: submission loop, lease pools, and
+    completion ingestion for the tasks whose ids hash here."""
+
+    def __init__(self, rt, index: int, shared: bool):
+        self.rt = rt
+        self.index = index
+        # shared=True: ride the runtime's io loop + noded conn (the
+        # classic single-owner plane; owner_shards == 1)
+        self.shared = shared
+        self.loop: asyncio.AbstractEventLoop = (
+            rt.loop if shared else asyncio.new_event_loop()
+        )
+        self.noded: Optional[rpc.Connection] = None
+        self.thread: Optional[threading.Thread] = None
+        self.native_tid: Optional[int] = None
+        # guards pools/conn_lease/counters; NEVER held across an await
+        # and NEVER taken before acquiring rt._state_lock (lock order:
+        # _state_lock outer, shard.lock inner)
+        self.lock = threading.Lock()
+        self.pools: Dict[tuple, LeasePool] = {}
+        self.conn_lease: Dict[rpc.Connection, Tuple[LeasePool, Lease]] = {}
+        self.lease_timers: set = set()
+        # live _acquire_leases tasks, cancelled at close so loop stop
+        # never destroys one mid-await
+        self._acquire_tasks: set = set()
+        # per-shard accounting (normal tasks only): submitted bumps at
+        # submit_task registration, completed at the exactly-once
+        # pending_tasks pop in _complete_task — their sum across shards
+        # must equal the single-owner totals (tests/test_owner_shards.py)
+        self.submitted = 0
+        self.completed = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self, node_socket: str):
+        if self.shared:
+            self.noded = self.rt.noded
+            self.native_tid = getattr(self.rt, "_io_native_tid", None)
+            return
+        self.thread = threading.Thread(
+            target=self._run_loop, name=f"rt-owner-{self.index}", daemon=True
+        )
+        self.thread.start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._connect(node_socket), self.loop
+        )
+        fut.result(timeout=self.rt.cfg.rpc_connect_timeout_s)
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.native_tid = threading.get_native_id()
+        self.loop.run_forever()
+
+    async def _connect(self, node_socket: str):
+        # unregistered with the daemon (holder shows as "remote"): the
+        # runtime's MAIN connection carries the owner identity; routed
+        # frames still land there, only lease traffic rides this one
+        self.noded = await rpc.connect_unix(
+            node_socket, handler=self.rt._handle,
+            name=f"noded-s{self.index}",
+        )
+
+    def stop(self):
+        """Close this shard's connections and (own-loop shards) stop the
+        loop.  Called from the runtime's shutdown path, any thread."""
+        async def _close():
+            await self.close_shared()
+            if self.noded is not None:
+                await self.noded.close()
+
+        if self.shared:
+            # the runtime's own shutdown coroutine runs _close on the
+            # shared loop; nothing to stop here
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self.loop).result(
+                timeout=5
+            )
+        except Exception as e:
+            logger.debug("shard %d close incomplete: %s", self.index, e)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        if self.thread is not None:
+            self.thread.join(timeout=5)
+
+    async def close_shared(self):
+        """Close this shard's lease-plane state: timers, acquire loops,
+        worker conns.  Awaited inside the runtime's shutdown coroutine
+        for shared-loop shards; `stop()` wraps it (plus the noded-conn
+        close) for own-loop shards."""
+        for timer in list(self.lease_timers):
+            timer.cancel()
+        self.lease_timers.clear()
+        for task in list(self._acquire_tasks):
+            task.cancel()
+        self._acquire_tasks.clear()
+        for conn in list(self.conn_lease):
+            await conn.close()
+
+    def cpu_seconds(self) -> float:
+        return _thread_cpu_seconds(self.native_tid)
+
+    def stats(self) -> Dict[str, float]:
+        with self.lock:
+            submitted, completed = self.submitted, self.completed
+            n_leases = sum(len(p.leases) for p in self.pools.values())
+            queued = sum(len(p.queue) for p in self.pools.values())
+        return {
+            "shard": self.index,
+            "submitted": submitted,
+            "completed": completed,
+            "leases": n_leases,
+            "queued": queued,
+            "cpu_s": round(self.cpu_seconds(), 3),
+        }
+
+    # ------------------------------------------------------------------
+    # submission (calling thread — must not block on the shard loop)
+    # ------------------------------------------------------------------
+    def pool_for(self, spec: TaskSpec) -> LeasePool:
+        demand = spec.resources.as_dict()
+        sig = (tuple(sorted(demand.items())), spec.env_hash)
+        with self.lock:
+            pool = self.pools.get(sig)
+        if pool is None:
+            pool = LeasePool(sig, demand)
+            pool.env_hash = spec.env_hash
+            # container envs ride the lease request so the daemon can
+            # spawn the worker INSIDE the image (core/container.py)
+            from ray_tpu.core.container import container_section
+
+            pool.container = container_section(
+                getattr(spec, "runtime_env", None)
+            )
+            with self.lock:
+                pool = self.pools.setdefault(sig, pool)
+        return pool
+
+    def push(self, spec: TaskSpec):
+        """Push a default-strategy task onto the least-loaded lease with
+        pipeline room, else queue it and (once) start the lease
+        acquisition loop on this shard's event loop."""
+        pool = self.pool_for(spec)
+        need_request = False
+        with self.lock:
+            lease = None
+            for cand in pool.leases.values():
+                if cand.in_flight < PIPELINE_DEPTH and (
+                    lease is None or cand.in_flight < lease.in_flight
+                ):
+                    lease = cand
+            if lease is not None:
+                lease.in_flight += 1
+                lease.assigned[spec.task_id.binary()] = spec
+            else:
+                pool.queue.append(spec)
+                need_request = not pool.requesting
+                if need_request:
+                    pool.requesting = True
+        if lease is not None:
+            try:
+                lease.conn.send_threadsafe("execute_task", spec)
+            except rpc.ConnectionLost:
+                pass  # teardown requeues/fails via on_lease_conn_closed
+        elif need_request:
+            self.loop.call_soon_threadsafe(self._spawn_acquire, pool)
+
+    def _spawn_acquire(self, pool: LeasePool):
+        task = asyncio.ensure_future(self._acquire_leases(pool))
+        self._acquire_tasks.add(task)
+        task.add_done_callback(self._acquire_tasks.discard)
+
+    # ------------------------------------------------------------------
+    # lease acquisition (shard loop) — batched negotiation
+    # ------------------------------------------------------------------
+    async def _acquire_leases(self, pool: LeasePool):
+        """Request leases from the node daemon while demand persists
+        (reference: RequestNewWorkerIfNeeded,
+        `normal_task_submitter.cc:299`).  Batch-first: one
+        `request_lease` round asks for up to `lease_request_batch`
+        grants sized to the queue, amortizing the RPC + daemon pass
+        over a whole submission burst."""
+        rt = self.rt
+        rpc_failures = 0
+        dry_rounds = 0
+        try:
+            while not rt._shutdown:
+                with self.lock:
+                    # prefer one lease per queued task; deep pipelines
+                    # only absorb work when the node can't grant more
+                    # workers (saturation)
+                    idle_capacity = sum(
+                        1 for l in pool.leases.values() if l.in_flight == 0
+                    )
+                    short = len(pool.queue) - idle_capacity
+                    if not pool.queue or short <= 0:
+                        pool.requesting = False
+                        return
+                want = max(1, min(short, rt.cfg.lease_request_batch))
+                try:
+                    reply = await self.noded.call(
+                        "request_lease",
+                        {"resources": pool.demand,
+                         "env_hash": pool.env_hash,
+                         "container": getattr(pool, "container", None),
+                         "count": want},
+                        timeout=60,
+                    )
+                except Exception as e:
+                    logger.debug("lease request failed: %s", e)
+                    rpc_failures += 1
+                    # jittered backoff, not constant pacing: N shards
+                    # retrying in lockstep against one wedged daemon
+                    # would otherwise synchronize into request storms
+                    await asyncio.sleep(backoff_delay_s(
+                        rpc_failures, base_s=0.1, cap_s=2.0,
+                        floor_s=0.05, rng=rt._retry_rng,
+                    ))
+                    continue
+                rpc_failures = 0
+                grants, err = _parse_lease_reply(reply)
+                if err == "env_error":
+                    # the daemon cannot materialize this runtime env at
+                    # all (e.g. container image with no podman/docker on
+                    # the host): fail the queued tasks with the cause
+                    # instead of retrying forever
+                    self._fail_queue_env_error(pool, reply["env_error"])
+                    return
+                if err == "infeasible":
+                    # local node can never host this demand: hand the
+                    # queued tasks to the node daemon, whose queue path
+                    # spills to a feasible node
+                    with self.lock:
+                        specs = list(pool.queue)
+                        pool.queue.clear()
+                        pool.requesting = False
+                    for s in specs:
+                        self.noded.send("submit_task", s)
+                    return
+                if not grants:
+                    dry_rounds += 1
+                    # saturated node (workers busy / spawn in flight):
+                    # back off the poll instead of hammering the daemon
+                    # at a fixed cadence from every shard at once
+                    await asyncio.sleep(backoff_delay_s(
+                        dry_rounds, base_s=0.02, cap_s=0.5,
+                        floor_s=0.01, rng=rt._retry_rng,
+                    ))
+                    continue
+                dry_rounds = 0
+                for worker_id, socket_path in grants:
+                    await self._adopt_grant(pool, worker_id, socket_path)
+        except Exception:
+            logger.exception("lease acquisition failed")
+            with self.lock:
+                pool.requesting = False
+
+    def _fail_queue_env_error(self, pool: LeasePool, cause: str):
+        from ray_tpu import exceptions as exc
+        from ray_tpu.core import serialization as ser
+
+        envelope = ser.serialize_to_bytes(
+            exc.RayTpuError(f"runtime_env setup failed: {cause}"),
+            tag=ser.TAG_ERROR,
+        )
+        with self.lock:
+            specs = list(pool.queue)
+            pool.queue.clear()
+            pool.requesting = False
+        for s in specs:
+            self.rt._complete_task(TaskResult(
+                task_id=s.task_id, status="error", error=envelope,
+            ))
+
+    async def _adopt_grant(self, pool: LeasePool, worker_id: str,
+                           socket_path: str):
+        """Connect one granted worker and drain queued work onto it."""
+        breaker = rpc.breaker_for(f"lease:{socket_path}")
+        if not breaker.allow():
+            # a worker whose socket keeps failing: hand the lease back
+            # and let the daemon grant another (paced so a re-grant of
+            # the same worker can't spin this loop hot in the cooldown)
+            self.noded.send("return_lease", {"worker_id": worker_id})
+            await asyncio.sleep(0.05)
+            return
+        try:
+            conn = await rpc.connect_unix(
+                socket_path, handler=self.rt._handle,
+                name=f"lease-{worker_id[:8]}",
+            )
+        except Exception as e:
+            logger.debug("lease socket connect to %s failed: %s",
+                         worker_id[:8], e)
+            breaker.record_failure()
+            self.noded.send("return_lease", {"worker_id": worker_id})
+            return
+        breaker.record_success()
+        lease = Lease(worker_id, conn, socket_path=socket_path)
+        with self.lock:
+            pool.leases[worker_id] = lease
+            self.conn_lease[conn] = (pool, lease)
+        conn.on_close = self.on_lease_conn_closed
+        self.drain_pool(pool, lease)
+        # a grant that raced with the queue draining elsewhere must not
+        # idle forever holding resources
+        await self.maybe_return_lease(pool, lease)
+
+    def drain_pool(self, pool: LeasePool, lease: Lease):
+        while True:
+            with self.lock:
+                if not pool.queue or lease.in_flight >= PIPELINE_DEPTH:
+                    return
+                spec = pool.queue.popleft()
+                lease.in_flight += 1
+                lease.assigned[spec.task_id.binary()] = spec
+            try:
+                lease.conn.send_threadsafe("execute_task", spec)
+            except rpc.ConnectionLost:
+                return
+
+    def on_lease_conn_closed(self, conn: rpc.Connection):
+        with self.lock:
+            entry = self.conn_lease.pop(conn, None)
+            if entry is None:
+                return
+            pool, lease = entry
+            pool.leases.pop(lease.worker_id, None)
+            specs = list(lease.assigned.values())
+        if lease.socket_path:
+            # the worker is gone and its socket path won't be re-granted
+            # (a replacement worker gets a fresh one): evict its breaker
+            # so the board stays bounded under worker churn
+            rpc.drop_breaker(f"lease:{lease.socket_path}")
+        for spec in specs:
+            self.rt._complete_task(
+                TaskResult(task_id=spec.task_id, status="worker_died")
+            )
+
+    # ------------------------------------------------------------------
+    # idle-lease return (shard loop)
+    # ------------------------------------------------------------------
+    async def maybe_return_lease(self, pool: LeasePool, lease: Lease):
+        """Idle lease handling: keep the worker warm for a grace period
+        so steady submit->get loops reuse it (conn and all) instead of
+        paying a lease round trip per task; a delayed task returns it if
+        still idle when the grace expires."""
+        rt = self.rt
+        with self.lock:
+            idle = (
+                not pool.queue
+                and lease.in_flight == 0
+                and pool.leases.get(lease.worker_id) is lease
+            )
+            if idle:
+                lease.idle_token += 1
+                token = lease.idle_token
+        if not idle:
+            return
+        keepalive = rt.cfg.lease_keepalive_ms / 1000.0
+        if keepalive > 0 and not rt._shutdown:
+            timer = asyncio.ensure_future(
+                self._return_lease_later(pool, lease, token, keepalive)
+            )
+            self.lease_timers.add(timer)
+            timer.add_done_callback(self.lease_timers.discard)
+        else:
+            await self._return_lease_now(pool, lease)
+
+    async def _return_lease_later(self, pool, lease, token, delay):
+        await asyncio.sleep(delay)
+        if self.rt._shutdown:
+            return
+        with self.lock:
+            still_idle = (
+                not pool.queue
+                and lease.in_flight == 0
+                and pool.leases.get(lease.worker_id) is lease
+                and lease.idle_token == token  # no busy period since
+            )
+        if still_idle:
+            await self._return_lease_now(pool, lease)
+
+    async def _return_lease_now(self, pool: LeasePool, lease: Lease):
+        with self.lock:
+            # full re-verify under ONE critical section: between any
+            # earlier idle check and this lock, a submitter may have
+            # pushed work onto this lease — popping it then would sever
+            # the in-flight task's result channel without the
+            # on_lease_conn_closed recovery (its map entry would
+            # already be gone)
+            if (
+                pool.leases.get(lease.worker_id) is not lease
+                or lease.in_flight != 0
+                or pool.queue
+            ):
+                return
+            pool.leases.pop(lease.worker_id, None)
+            self.conn_lease.pop(lease.conn, None)
+        try:
+            self.noded.send("return_lease", {"worker_id": lease.worker_id})
+        except Exception as e:
+            logger.debug("return_lease dropped: %s", e)
+        await lease.conn.close()
+
+
+def _parse_lease_reply(reply):
+    """-> (grants, error_kind).  Accepts the batched `{"grants": [...]}`
+    shape and the legacy single-grant tuple/None (a daemon one minor
+    revision behind still interoperates)."""
+    if reply is None:
+        return [], None
+    if isinstance(reply, dict):
+        if reply.get("env_error"):
+            return [], "env_error"
+        if reply.get("infeasible"):
+            return [], "infeasible"
+        return [tuple(g) for g in reply.get("grants", [])], None
+    return [tuple(reply)], None
+
+
+def shard_index(task_id_bytes: bytes, n: int) -> int:
+    """Task-id -> shard key.  The trailing bytes of a TaskID are random
+    per task (ids.py), so a plain modulus balances without hashing."""
+    if n <= 1:
+        return 0
+    return task_id_bytes[-1] % n

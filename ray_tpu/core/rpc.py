@@ -699,3 +699,25 @@ async def connect_tcp(host: str, port: int, handler=None, name="client") -> Conn
 
 async def _null_handler(method, payload, conn):
     raise RpcError(f"unexpected request {method!r} on client connection")
+
+
+async def call_on_conn_loop(conn: Connection, method: str,
+                            payload: Any = None,
+                            timeout: Optional[float] = None):
+    """`conn.call(...)` made safe from ANY event loop.
+
+    With an owner-sharded runtime a connection belongs to one shard's
+    loop, but cancellation/watchdog paths run on the main loop.  A
+    direct `conn.call` there would create the reply future on the
+    CALLING loop while the recv loop resolves it from the connection's
+    loop — a cross-thread `Future.set_result` that may never wake the
+    waiter.  This helper hops onto the connection's own loop when
+    needed and bridges the result back with `wrap_future` (which uses
+    `call_soon_threadsafe` and therefore does wake the caller)."""
+    own_loop = conn._loop
+    if own_loop is None or own_loop is asyncio.get_running_loop():
+        return await conn.call(method, payload, timeout=timeout)
+    fut = asyncio.run_coroutine_threadsafe(
+        conn.call(method, payload, timeout=timeout), own_loop
+    )
+    return await asyncio.wrap_future(fut)

@@ -10,19 +10,25 @@ Embedded in every driver and worker process (reference:
 - the reference counter (owner-side local/submitted/borrower counts —
   reference: `reference_count.h:64`),
 - the task manager (pending tasks, retries, lineage for reconstruction —
-  reference: `task_manager.h:208`),
-- the lease-based submitter: workers are leased from the node daemon,
-  then tasks are pushed DIRECTLY to the leased worker over its socket,
-  pipelined, bypassing the daemon on the hot path (reference two-level
-  scheduling: `normal_task_submitter.h:75`, lease pipelining, and
-  `SubmitActorTask` direct pushes `actor_task_submitter.h:75`),
+  reference: `task_manager.h:208`); the completion state machine lives
+  in `core/completion.py`,
+- the SHARDED lease-based submitter (`core/owner_shard.py`): workers
+  are leased from the node daemon (batched grants), then tasks are
+  pushed DIRECTLY to the leased worker over its socket, pipelined,
+  bypassing the daemon on the hot path (reference two-level scheduling:
+  `normal_task_submitter.h:75`, lease pipelining, and `SubmitActorTask`
+  direct pushes `actor_task_submitter.h:75`).  With `owner_shards` > 1
+  the submission/completion lanes run on N event loops keyed by task
+  id (docs/control_plane.md),
 - task execution when running as a worker (reference:
   `core_worker.cc:2908` ExecuteTask), with per-caller ordered actor
-  queues (`transport/actor_scheduling_queue.h`).
+  queues (`transport/actor_scheduling_queue.h`) and per-tick coalesced
+  `task_result_batch` replies (`core/completion.py`).
 
 Submission runs entirely on the calling thread (spec build, state
-registration under a lock, frame pickling) and hands the io loop only a
-batched flush — this is what makes >10k tasks/s feasible in Python.
+registration under a lock, frame pickling) and hands the owning
+shard's loop only a batched flush — this is what makes >10k tasks/s
+feasible in Python.
 """
 
 from __future__ import annotations
@@ -43,8 +49,14 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu.core import completion as _completion
 from ray_tpu.core import rpc, serialization as ser
 from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.owner_shard import (
+    PIPELINE_DEPTH,
+    OwnerShard,
+    shard_index,
+)
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.retry import RetryBudget, backoff_delay_s
@@ -99,14 +111,16 @@ _ambient_deadline: contextvars.ContextVar = contextvars.ContextVar(
     "rt_ambient_deadline", default=None
 )
 
+def _wake_nudge():
+    """No-op callback: waking the selector is the entire point."""
+
+
 _INLINE = "inline"
 _SHM = "shm"
-# Max tasks pushed ahead of completion on one leased worker (the
-# reference's max_tasks_in_flight_per_worker).  The worker runs normal
-# tasks on a thread pool at least this wide, so a task that blocks
-# (collectives, nested gets) never deadlocks a pipelined successor and
-# short tasks are not serialized behind long ones.
-_PIPELINE_DEPTH = 4
+# pipelining depth lives with the lease machinery now
+# (core/owner_shard.py); the alias keeps the exec-pool sizing below
+# reading naturally
+_PIPELINE_DEPTH = PIPELINE_DEPTH
 
 
 @dataclass
@@ -220,44 +234,6 @@ def next_actor_seq(aid: bytes, group: Optional[str] = None) -> int:
         return n
 
 
-class _Lease:
-    """One leased worker with pipelined pushes."""
-
-    __slots__ = ("worker_id", "conn", "in_flight", "assigned", "idle_token",
-                 "socket_path")
-
-    def __init__(self, worker_id: str, conn: rpc.Connection,
-                 socket_path: str = ""):
-        self.worker_id = worker_id
-        self.conn = conn
-        self.in_flight = 0
-        self.assigned: Dict[bytes, TaskSpec] = {}
-        # bumped each time the lease goes idle; lets the delayed-return
-        # timer detect an intervening busy period and stand down
-        self.idle_token = 0
-        # breaker-board key material: the breaker for a retired socket
-        # is dropped on close so the board stays bounded by live peers
-        self.socket_path = socket_path
-
-
-class _LeasePool:
-    """Per-resource-signature pool of leased workers + overflow queue
-    (reference: one lease request pipeline per SchedulingKey,
-    `normal_task_submitter.h`)."""
-
-    __slots__ = ("sig", "demand", "leases", "queue", "requesting",
-                 "env_hash", "container")
-
-    def __init__(self, sig, demand):
-        self.sig = sig
-        self.demand = demand
-        self.leases: Dict[str, _Lease] = {}
-        self.queue: deque = deque()
-        self.container = None
-        self.requesting = False
-        self.env_hash: Optional[str] = None  # runtime-env dedication
-
-
 class Runtime:
     """One per process; `driver` or `worker` mode."""
 
@@ -286,9 +262,12 @@ class Runtime:
         self.lineage: Dict[bytes, TaskSpec] = {}  # return id -> creating spec
         self._streams: Dict[bytes, _StreamState] = {}  # task id -> stream
 
-        # lease-based submission
-        self._pools: Dict[tuple, _LeasePool] = {}
-        self._conn_lease: Dict[rpc.Connection, Tuple[_LeasePool, _Lease]] = {}
+        # lease-based submission is owner-sharded: each shard owns its
+        # lease pools, its worker connections, and (shards > 1) its own
+        # event loop + node-daemon connection (core/owner_shard.py).
+        # Shard 0 with owner_shards == 1 shares this runtime's io loop —
+        # the classic single-owner plane.
+        self._shards: List[OwnerShard] = []
         # actor submission: direct conns to actor workers
         self._actor_conns: Dict[bytes, rpc.Connection] = {}
         self._actor_queue: Dict[bytes, deque] = {}
@@ -324,7 +303,6 @@ class Runtime:
         # values are zero-copy views into the segment (the reference
         # pins plasma buffers the same way while Python buffers exist)
         self._held_pins: set = set()
-        self._lease_timers: set = set()  # pending keep-alive returns
         # container object id -> borrows/pins it holds on inner refs
         self._contained_in: Dict[bytes, list] = {}
         # executor side: task id -> transit pins on foreign refs that
@@ -382,12 +360,18 @@ class Runtime:
         from ray_tpu.core.task_events import TaskEventBuffer
 
         self.task_events = TaskEventBuffer()
+        # executor-side completion coalescing (core/completion.py):
+        # results for one owner ship as one frame per loop tick
+        self._result_coalescer = _completion.ResultCoalescer(self)
 
     # ------------------------------------------------------------------
     # bootstrap
     # ------------------------------------------------------------------
     def _run_loop(self):
         asyncio.set_event_loop(self.loop)
+        # /proc-readable identity for the per-plane CPU accounting
+        # (perf.py --owner-shards reports per-shard us/task)
+        self._io_native_tid = threading.get_native_id()
         self.loop.run_forever()
 
     def start(self, node_socket: str, controller_addr: Tuple[str, int],
@@ -397,6 +381,45 @@ class Runtime:
             self._connect(node_socket, controller_addr, serve_dir), self.loop
         )
         fut.result(timeout=self.cfg.rpc_connect_timeout_s)
+        # owner shards: drivers honor cfg.owner_shards; workers always
+        # run the shared single-shard plane (their nested submissions
+        # are a side channel, not the bottleneck)
+        n = (max(1, int(self.cfg.owner_shards))
+             if self.mode == "driver" else 1)
+        self._shards = [OwnerShard(self, i, shared=(n == 1))
+                        for i in range(n)]
+        for s in self._shards:
+            s.start(node_socket)
+
+    def _shard_for(self, task_id_bytes: bytes) -> OwnerShard:
+        return self._shards[shard_index(task_id_bytes, len(self._shards))]
+
+    def _find_lease(self, conn):
+        """-> (shard, pool, lease) owning `conn`, or None."""
+        for shard in self._shards:
+            entry = shard.conn_lease.get(conn)
+            if entry is not None:
+                return (shard, *entry)
+        return None
+
+    def owner_shard_stats(self) -> List[Dict]:
+        """Per-shard accounting for tests and perf.py: submitted /
+        completed / lease + queue depth / CPU seconds per shard."""
+        return [s.stats() for s in self._shards]
+
+    def _wake_main_loop(self):
+        """Wake this runtime's io loop after an off-thread completion:
+        ready-Event waiter callbacks queued with plain `call_soon` from
+        a shard/submitter thread never wake a selector sleeping in
+        `run_forever` — a `call_soon_threadsafe` no-op writes the
+        self-pipe and the loop drains everything queued.  Called by
+        completion.complete_task's finally block."""
+        if threading.current_thread() is self._io_thread:
+            return  # in-loop completion: call_soon already suffices
+        try:
+            self.loop.call_soon_threadsafe(_wake_nudge)
+        except RuntimeError:
+            pass  # loop closed mid-teardown
 
     async def _connect(self, node_socket, controller_addr, serve_dir):
         if serve_dir is not None:
@@ -494,6 +517,11 @@ class Runtime:
         if self._shutdown:
             return
         self._shutdown = True
+        # own-loop owner shards close their lease/noded conns on their
+        # OWN loops (Task.cancel is loop-affine), then stop those loops
+        for s in self._shards:
+            if not s.shared:
+                s.stop()
 
         async def _close():
             flush = getattr(self, "_flush_task", None)
@@ -505,9 +533,6 @@ class Runtime:
                 await self._flush_ref_events(immediate=True)
             except Exception as e:
                 logger.debug("final ref-event flush failed: %s", e)
-            for timer in list(self._lease_timers):
-                timer.cancel()
-            self._lease_timers.clear()
             # final task-event drain so the last flush period's events
             # reach the controller before the connection dies
             events = self.task_events.drain()
@@ -519,8 +544,9 @@ class Runtime:
                     logger.debug("final task-event report dropped: %s", e)
             if self._server:
                 await self._server.stop()
-            for conn in list(self._conn_lease):
-                await conn.close()
+            for s in self._shards:
+                if s.shared:
+                    await s.close_shared()
             for conn in list(self._actor_conns.values()):
                 await conn.close()
             if self.noded:
@@ -609,13 +635,26 @@ class Runtime:
                 )
             pt.retries_left = 0  # a cancelled task never retries
             spec = pt.spec
-            # 1. still in a local lease-pool queue: drop it here
-            for pool in self._pools.values():
-                for queued in list(pool.queue):
-                    if queued.task_id.binary() == task_id:
-                        pool.queue.remove(queued)
-                        self._fail_cancelled(task_id, spec)
-                        return True
+            # 1. still in a local lease-pool queue: drop it here.
+            # shard.lock nests inside _state_lock (documented order);
+            # released before _fail_cancelled so the completion path's
+            # own shard.lock acquisition can't self-deadlock
+            dropped = False
+            for shard in self._shards:
+                with shard.lock:
+                    for pool in shard.pools.values():
+                        for queued in list(pool.queue):
+                            if queued.task_id.binary() == task_id:
+                                pool.queue.remove(queued)
+                                dropped = True
+                                break
+                        if dropped:
+                            break
+                if dropped:
+                    break
+            if dropped:
+                self._fail_cancelled(task_id, spec)
+                return True
             # 1b. actor tasks are NEVER dropped owner-side: per-caller
             # seq_nos were assigned at submit and the executor's ordered
             # queue would wait forever on a gap — instead the cancel
@@ -632,17 +671,19 @@ class Runtime:
 
     async def _cancel_remote(self, task_id: bytes, spec: TaskSpec,
                              force: bool = False):
-        with self._state_lock:
-            conns = []
-            lease_worker = None
-            for pool, lease in self._conn_lease.values():
-                if task_id in lease.assigned:
-                    conns.append(lease.conn)
-                    lease_worker = lease.worker_id
-            if spec.actor_id is not None:
+        conns = []
+        lease_worker = None
+        for shard in self._shards:
+            with shard.lock:
+                for pool, lease in shard.conn_lease.values():
+                    if task_id in lease.assigned:
+                        conns.append(lease.conn)
+                        lease_worker = lease.worker_id
+        if spec.actor_id is not None:
+            with self._state_lock:
                 c = self._actor_conns.get(spec.actor_id.binary())
-                if c is not None:
-                    conns.append(c)
+            if c is not None:
+                conns.append(c)
         if force:
             # reference force-cancel: kill the executing worker; the
             # pending task fails with worker_died -> WorkerCrashedError
@@ -678,8 +719,10 @@ class Runtime:
                     break
         for conn in conns:
             try:
-                reply = await conn.call(
-                    "cancel_task", {"task_id": task_id}, timeout=5
+                # lease conns live on shard loops with owner_shards > 1:
+                # call via the conn's own loop (rpc.call_on_conn_loop)
+                reply = await rpc.call_on_conn_loop(
+                    conn, "cancel_task", {"task_id": task_id}, timeout=5
                 )
                 if reply and reply.get("cancelled"):
                     return
@@ -911,6 +954,11 @@ class Runtime:
                     if rc:
                         rc.submitted += 1
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
+        # per-shard accounting (normal tasks): pairs with the completed
+        # bump at the exactly-once pop in completion.complete_task
+        shard = self._shard_for(spec.task_id.binary())
+        with shard.lock:
+            shard.submitted += 1
         if spec.deadline_s is not None:
             self._arm_deadline(spec)
         self._push_or_queue(spec)
@@ -1183,22 +1231,6 @@ class Runtime:
                         logger.debug("ref-event batch dropped: %s", e)
                         break
 
-    def _pool_for(self, spec: TaskSpec) -> _LeasePool:
-        demand = spec.resources.as_dict()
-        sig = (tuple(sorted(demand.items())), spec.env_hash)
-        pool = self._pools.get(sig)
-        if pool is None:
-            pool = self._pools[sig] = _LeasePool(sig, demand)
-            pool.env_hash = spec.env_hash
-            # container envs ride the lease request so the daemon can
-            # spawn the worker INSIDE the image (core/container.py)
-            from ray_tpu.core.container import container_section
-
-            pool.container = container_section(
-                getattr(spec, "runtime_env", None)
-            )
-        return pool
-
     # args at least this big make their node the preferred executor
     # (reference: locality-aware lease policy, `lease_policy.h` — pull
     # the task to the data, not the data to the task)
@@ -1243,163 +1275,9 @@ class Runtime:
             except rpc.ConnectionLost:
                 pass
             return
-        pool = self._pool_for(spec)
-        with self._state_lock:
-            # push to the least-loaded lease with pipeline room (worker
-            # exec pools are >= depth threads, so a blocked task can
-            # never wedge a pipelined successor)
-            lease = None
-            for cand in pool.leases.values():
-                if cand.in_flight < _PIPELINE_DEPTH and (
-                    lease is None or cand.in_flight < lease.in_flight
-                ):
-                    lease = cand
-            if lease is not None:
-                lease.in_flight += 1
-                lease.assigned[spec.task_id.binary()] = spec
-            else:
-                pool.queue.append(spec)
-                need_request = not pool.requesting
-                if need_request:
-                    pool.requesting = True
-        if lease is not None:
-            try:
-                lease.conn.send_threadsafe("execute_task", spec)
-            except rpc.ConnectionLost:
-                pass  # teardown requeues/fails via _on_lease_conn_closed
-        elif need_request:
-            self.loop.call_soon_threadsafe(
-                lambda: asyncio.ensure_future(self._acquire_leases(pool))
-            )
-
-    async def _acquire_leases(self, pool: _LeasePool):
-        """Request leases from the node daemon while demand persists
-        (reference: RequestNewWorkerIfNeeded, `normal_task_submitter.cc:299`)."""
-        try:
-            while not self._shutdown:
-                with self._state_lock:
-                    # prefer one lease per queued task; deep pipelines
-                    # only absorb work when the node can't grant more
-                    # workers (saturation)
-                    idle_capacity = sum(
-                        1 for l in pool.leases.values() if l.in_flight == 0
-                    )
-                    if not pool.queue or idle_capacity >= len(pool.queue):
-                        pool.requesting = False
-                        return
-                try:
-                    reply = await self.noded.call(
-                        "request_lease",
-                        {"resources": pool.demand,
-                         "env_hash": pool.env_hash,
-                         "container": getattr(pool, "container", None)},
-                        timeout=60,
-                    )
-                except Exception as e:
-                    logger.debug("lease request failed: %s", e)
-                    await asyncio.sleep(0.1)
-                    continue
-                if reply is None:
-                    await asyncio.sleep(0.02)
-                    continue
-                if isinstance(reply, dict) and reply.get("env_error"):
-                    # the daemon cannot materialize this runtime env at
-                    # all (e.g. container image with no podman/docker on
-                    # the host): fail the queued tasks with the cause
-                    # instead of retrying forever
-                    envelope = ser.serialize_to_bytes(
-                        exc.RayTpuError(
-                            f"runtime_env setup failed: "
-                            f"{reply['env_error']}"
-                        ),
-                        tag=ser.TAG_ERROR,
-                    )
-                    with self._state_lock:
-                        specs = list(pool.queue)
-                        pool.queue.clear()
-                        pool.requesting = False
-                    for s in specs:
-                        self._complete_task(TaskResult(
-                            task_id=s.task_id, status="error",
-                            error=envelope,
-                        ))
-                    return
-                if isinstance(reply, dict) and reply.get("infeasible"):
-                    # local node can never host this demand: hand the
-                    # queued tasks to the node daemon, whose queue path
-                    # spills to a feasible node
-                    with self._state_lock:
-                        specs = list(pool.queue)
-                        pool.queue.clear()
-                        pool.requesting = False
-                    for s in specs:
-                        self.noded.send("submit_task", s)
-                    return
-                worker_id, socket_path = reply
-                breaker = rpc.breaker_for(f"lease:{socket_path}")
-                if not breaker.allow():
-                    # a worker whose socket keeps failing: hand the
-                    # lease back and let the daemon grant another
-                    # (paced so a re-grant of the same worker can't
-                    # spin this loop hot during the cooldown)
-                    self.noded.send("return_lease", {"worker_id": worker_id})
-                    await asyncio.sleep(0.05)
-                    continue
-                try:
-                    conn = await rpc.connect_unix(
-                        socket_path, handler=self._handle, name=f"lease-{worker_id[:8]}"
-                    )
-                except Exception as e:
-                    logger.debug("lease socket connect to %s failed: %s",
-                                 worker_id[:8], e)
-                    breaker.record_failure()
-                    self.noded.send("return_lease", {"worker_id": worker_id})
-                    continue
-                breaker.record_success()
-                lease = _Lease(worker_id, conn, socket_path=socket_path)
-                with self._state_lock:
-                    pool.leases[worker_id] = lease
-                    self._conn_lease[conn] = (pool, lease)
-                conn.on_close = self._on_lease_conn_closed
-                self._drain_pool(pool, lease)
-                # a grant that raced with the queue draining elsewhere
-                # must not idle forever holding resources
-                await self._maybe_return_lease(pool, lease)
-        except Exception:
-            logger.exception("lease acquisition failed")
-            with self._state_lock:
-                pool.requesting = False
-
-    def _drain_pool(self, pool: _LeasePool, lease: _Lease):
-        while True:
-            with self._state_lock:
-                if not pool.queue or lease.in_flight >= _PIPELINE_DEPTH:
-                    return
-                spec = pool.queue.popleft()
-                lease.in_flight += 1
-                lease.assigned[spec.task_id.binary()] = spec
-            try:
-                lease.conn.send_threadsafe("execute_task", spec)
-            except rpc.ConnectionLost:
-                return
-
-    def _on_lease_conn_closed(self, conn: rpc.Connection):
-        with self._state_lock:
-            entry = self._conn_lease.pop(conn, None)
-            if entry is None:
-                return
-            pool, lease = entry
-            pool.leases.pop(lease.worker_id, None)
-            specs = list(lease.assigned.values())
-        if lease.socket_path:
-            # the worker is gone and its socket path won't be re-granted
-            # (a replacement worker gets a fresh one): evict its breaker
-            # so the board stays bounded under worker churn
-            rpc.drop_breaker(f"lease:{lease.socket_path}")
-        for spec in specs:
-            self._complete_task(
-                TaskResult(task_id=spec.task_id, status="worker_died")
-            )
+        # default strategy: the shard keyed by this task id owns the
+        # push (its lease pools, its loop, its daemon connection)
+        self._shard_for(spec.task_id.binary()).push(spec)
 
     # ------------------------------------------------------------------
     # actor creation + actor task submission
@@ -1784,185 +1662,11 @@ class Runtime:
                     logger.debug("task-event report dropped: %s", e)
 
     def _complete_task(self, result: TaskResult) -> list:
-        """Returns the pending ACK futures of contained-borrow
-        registrations made while ingesting the result (awaited by
-        `_h_task_result` before confirming `transit_release`)."""
-        acks: list = []
-        with self._state_lock:
-            pt = self.pending_tasks.pop(result.task_id.binary(), None)
-            if pt is None:
-                return acks
-            if result.status == "ok":
-                # successes refill the retry budget (core/retry.py):
-                # steady progress re-earns the right to retry
-                self._retry_budget.record_success()
-                if pt.deadline_timer is not None:
-                    # Handle.cancel() only sets a flag — safe off-loop
-                    pt.deadline_timer.cancel()
-                self.task_events.record(
-                    result.task_id.binary(), pt.spec.name, "FINISHED",
-                    duration=(result.execution_info or {}).get("duration"),
-                )
-                stream = self._streams.get(result.task_id.binary())
-                if stream is not None:
-                    stream.total = int(
-                        (result.execution_info or {}).get(
-                            # fallback counts delivered + pending, not
-                            # just unconsumed, or it would truncate
-                            "num_items",
-                            stream.consumed + len(stream.items),
-                        )
-                    )
-                    self.loop.call_soon_threadsafe(stream.event.set)
-                    self.loop.call_soon_threadsafe(stream.done.set)
-                for i, ret in enumerate(result.returns):
-                    oid = ObjectID.for_return(result.task_id, i + 1)
-                    st = self.objects.get(oid.binary())
-                    if st is None:
-                        continue
-                    if ret[0] == _INLINE:
-                        st.where, st.value, st.size = _INLINE, ret[1], len(ret[1])
-                        contained = ret[2] if len(ret) > 2 else None
-                    else:
-                        st.where, st.node_id, st.size = _SHM, ret[1], ret[2]
-                        contained = ret[3] if len(ret) > 3 else None
-                    if contained:
-                        self._register_contained(oid.binary(), contained, acks)
-                    st.ready.set()
-                for a in pt.spec.args:
-                    if isinstance(a, ArgRef):
-                        rc = self.refs.get(a.id_bytes)
-                        if rc:
-                            rc.submitted -= 1
-                            self._maybe_free(a.id_bytes)
-                self._release_transit(pt.transit)
-                pt.transit = []
-                # popped at EVERY final completion path (incl. the
-                # worker-died/cancel callers of _complete_task), so dead
-                # attempts can't leak ack lists or poison a retry
-                acks.extend(
-                    self._stream_reg_acks.pop(result.task_id.binary(), ())
-                )
-                return acks
-            # failure path
-            retriable = result.status == "worker_died" or (
-                result.status == "error" and pt.spec.retry_exceptions
-            )
-            if pt.spec.actor_id is not None and result.status == "worker_died":
-                retriable = pt.spec.max_retries > 0
-            resubmit = False
-            retry_delay = 0.0
-            override_err: Optional[BaseException] = None
-            if retriable and pt.retries_left > 0:
-                now = time.monotonic()
-                deadline = pt.spec.deadline_s
-                # capped exponential backoff with full jitter; the
-                # legacy task_retry_delay_ms is the floor (core/retry.py)
-                retry_delay = backoff_delay_s(
-                    pt.attempts,
-                    base_s=self.cfg.task_retry_backoff_base_ms / 1000.0,
-                    cap_s=self.cfg.task_retry_backoff_max_ms / 1000.0,
-                    floor_s=self.cfg.task_retry_delay_ms / 1000.0,
-                    rng=self._retry_rng,
-                )
-                if deadline is not None and now + retry_delay >= deadline:
-                    # the caller's budget would expire during the
-                    # backoff: fail fast instead of re-queueing work
-                    # nobody is waiting for
-                    override_err = exc.DeadlineExceededError(
-                        f"task {pt.spec.name!r} failed "
-                        f"({result.status}) and its deadline leaves no "
-                        f"room to retry ({pt.attempts} retries were "
-                        f"attempted); failing fast"
-                    )
-                elif not self._retry_budget.try_acquire():
-                    # correlated-failure regime: the budget is drained,
-                    # so degrade to fail-fast instead of amplifying load
-                    override_err = exc.TaskError(
-                        f"task {pt.spec.name!r} failed "
-                        f"({result.status}) and the runtime retry "
-                        f"budget is exhausted after "
-                        f"{pt.attempts + 1} attempts "
-                        f"({pt.attempts} retries granted); failing "
-                        f"fast instead of amplifying load",
-                        cause_type="RetryBudgetExhausted",
-                    )
-                else:
-                    pt.retries_left -= 1
-                    pt.attempts += 1
-                    self.pending_tasks[result.task_id.binary()] = pt
-                    logger.info(
-                        "retrying task %s in %.0f ms (%d retries left)",
-                        pt.spec.task_id.hex(),
-                        retry_delay * 1000.0,
-                        pt.retries_left,
-                    )
-                    resubmit = True
-            if not resubmit:
-                if pt.deadline_timer is not None:
-                    pt.deadline_timer.cancel()
-                self.task_events.record(
-                    result.task_id.binary(), pt.spec.name, "FAILED",
-                    error=result.status,
-                )
-                if override_err is not None:
-                    envelope = ser.serialize_to_bytes(
-                        override_err, tag=ser.TAG_ERROR
-                    )
-                elif result.error is not None:
-                    envelope = result.error
-                elif pt.spec.actor_id is not None:
-                    envelope = ser.serialize_to_bytes(
-                        exc.ActorDiedError(actor_id=pt.spec.actor_id),
-                        tag=ser.TAG_ERROR,
-                    )
-                else:
-                    envelope = ser.serialize_to_bytes(
-                        exc.WorkerCrashedError("worker died"), tag=ser.TAG_ERROR
-                    )
-                stream = self._streams.get(result.task_id.binary())
-                if stream is not None:
-                    stream.error = envelope
-                    self.loop.call_soon_threadsafe(stream.event.set)
-                    self.loop.call_soon_threadsafe(stream.done.set)
-                for i in range(max(pt.spec.num_returns, 0)):
-                    oid = ObjectID.for_return(result.task_id, i + 1)
-                    st = self.objects.get(oid.binary())
-                    if st is not None:
-                        st.error = envelope
-                        st.ready.set()
-                for a in pt.spec.args:
-                    if isinstance(a, ArgRef):
-                        rc = self.refs.get(a.id_bytes)
-                        if rc:
-                            rc.submitted -= 1
-                            self._maybe_free(a.id_bytes)
-                self._release_transit(pt.transit)
-                pt.transit = []
-                acks.extend(
-                    self._stream_reg_acks.pop(result.task_id.binary(), ())
-                )
-        if resubmit:
-            spec = pt.spec
-
-            def _resend():
-                if spec.actor_id is not None:
-                    self._push_actor_task(spec.actor_id.binary(), spec)
-                else:
-                    self._push_or_queue(spec)
-
-            if retry_delay > 0:
-                # _complete_task runs on io AND submitter threads;
-                # call_later is only loop-thread-safe, so hop in
-                try:
-                    self.loop.call_soon_threadsafe(
-                        self.loop.call_later, retry_delay, _resend
-                    )
-                except RuntimeError:
-                    pass  # loop closed mid-teardown
-            else:
-                _resend()
-        return acks
+        """Owner-side exactly-once completion (moved to
+        core/completion.py with the owner-shard split); returns the
+        pending contained-borrow ACK futures the batch ingester awaits
+        before confirming `transit_release`."""
+        return _completion.complete_task(self, result)
 
     # ------------------------------------------------------------------
     # get / wait internals (io thread)
@@ -2135,6 +1839,13 @@ class Runtime:
             st.ready = asyncio.Event()
             st.where = None
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(spec, 0)
+            if spec.actor_id is None:
+                # lineage resubmits count as submissions so per-shard
+                # submitted/completed stay balanced (shard.lock nests
+                # inside _state_lock by the documented order)
+                shard = self._shard_for(spec.task_id.binary())
+                with shard.lock:
+                    shard.submitted += 1
             # completion decrements submitted refs again, so re-pin args
             for a in spec.args:
                 if isinstance(a, ArgRef):
@@ -2572,111 +2283,23 @@ class Runtime:
                         self._pubsub_uncertain.discard(ch)
 
     async def _h_task_result(self, payload, conn):
-        """A task we own finished on a worker (direct push reply) or was
-        routed back via the daemons."""
-        result: TaskResult = payload["result"] if isinstance(payload, dict) else payload
-        assigned = None
-        with self._state_lock:
-            entry = self._conn_lease.get(conn)
-            if entry is not None:
-                pool, lease = entry
-                if lease.assigned.pop(result.task_id.binary(), None) is not None:
-                    lease.in_flight -= 1
-            else:
-                assigned = self._actor_assigned.get(conn)
-                if assigned is not None:
-                    assigned.pop(result.task_id.binary(), None)
-        acks = self._complete_task(result)
-        if entry is not None:
-            # dispatch first: queued tasks must not idle behind the
-            # borrow-ack confirmation below (which only gates the
-            # executor's transit_release, not this worker's reuse)
-            self._drain_pool(pool, lease)
-            await self._maybe_return_lease(pool, lease)
-        if entry is not None or assigned is not None:
-            # executor conns only (not daemon relays): confirm that the
-            # contained borrows in this result (and its stream items)
-            # are ON THE BOOKS at their owners before releasing the
-            # executor's transit pins; a failed registration keeps the
-            # pins (job-exit fallback) instead of risking a free
-            confirmed = True
-            if acks:
-                done, pending = await asyncio.wait(
-                    [asyncio.wrap_future(f) for f in acks], timeout=10
-                )
-                confirmed = not pending and all(
-                    t.exception() is None for t in done
-                )
-                for t in pending:
-                    t.cancel()
-            if confirmed:
-                try:
-                    conn.send("transit_release",
-                              {"task_id": result.task_id.binary()})
-                except Exception as e:
-                    logger.debug("transit_release dropped: %s", e)
+        """A task we own finished on a worker (legacy single-result
+        frame: daemon relays, worker_died routes) or was routed back via
+        the daemons.  Direct executor pushes arrive coalesced as
+        `task_result_batch`; both funnel into the same ingestion path
+        (core/completion.py)."""
+        result: TaskResult = (
+            payload["result"] if isinstance(payload, dict) else payload
+        )
+        await _completion.ingest_results(self, [result], conn)
 
-    async def _maybe_return_lease(self, pool: _LeasePool, lease: _Lease):
-        """Idle lease handling: keep the worker warm for a grace period
-        so steady submit->get loops reuse it (conn and all) instead of
-        paying a lease round trip per task; a delayed task returns it if
-        still idle when the grace expires."""
-        with self._state_lock:
-            idle = (
-                not pool.queue
-                and lease.in_flight == 0
-                and pool.leases.get(lease.worker_id) is lease
-            )
-            if idle:
-                lease.idle_token += 1
-                token = lease.idle_token
-        if not idle:
-            return
-        keepalive = self.cfg.lease_keepalive_ms / 1000.0
-        if keepalive > 0 and not self._shutdown:
-            timer = asyncio.ensure_future(
-                self._return_lease_later(pool, lease, token, keepalive)
-            )
-            self._lease_timers.add(timer)
-            timer.add_done_callback(self._lease_timers.discard)
-        else:
-            await self._return_lease_now(pool, lease)
-
-    async def _return_lease_later(self, pool, lease, token, delay):
-        await asyncio.sleep(delay)
-        if self._shutdown:
-            return
-        with self._state_lock:
-            still_idle = (
-                not pool.queue
-                and lease.in_flight == 0
-                and pool.leases.get(lease.worker_id) is lease
-                and lease.idle_token == token  # no busy period since
-            )
-        if still_idle:
-            await self._return_lease_now(pool, lease)
-
-    async def _return_lease_now(self, pool: _LeasePool, lease: _Lease):
-        with self._state_lock:
-            # full re-verify under ONE critical section: between any
-            # earlier idle check and this lock, a submitter may have
-            # pushed work onto this lease — popping it then would sever
-            # the in-flight task's result channel without the
-            # _on_lease_conn_closed recovery (its map entry would
-            # already be gone)
-            if (
-                pool.leases.get(lease.worker_id) is not lease
-                or lease.in_flight != 0
-                or pool.queue
-            ):
-                return
-            pool.leases.pop(lease.worker_id, None)
-            self._conn_lease.pop(lease.conn, None)
-        try:
-            self.noded.send("return_lease", {"worker_id": lease.worker_id})
-        except Exception as e:
-            logger.debug("return_lease dropped: %s", e)
-        await lease.conn.close()
+    async def _h_task_result_batch(self, payload, conn):
+        """Coalesced completion frame: every result one executor
+        produced for this owner within one connection tick (reference
+        analog: the owner-side fan-in that keeps completion dispatch
+        O(#frames), not O(#tasks); see docs/control_plane.md)."""
+        results = list(payload.results)
+        await _completion.ingest_results(self, results, conn)
 
     async def _h_stream_item(self, payload, conn):
         """One yielded item of a streaming-generator task we own arrived
@@ -2778,18 +2401,21 @@ class Runtime:
     async def _stream_cancel_remote(self, task_id: bytes, spec: TaskSpec):
         """Best-effort 'stop producing' to wherever the streaming task
         runs (same transport walk as _cancel_remote)."""
-        with self._state_lock:
-            conns = []
-            for pool, lease in self._conn_lease.values():
-                if task_id in lease.assigned:
-                    conns.append(lease.conn)
-            if spec.actor_id is not None:
+        conns = []
+        for shard in self._shards:
+            with shard.lock:
+                for pool, lease in shard.conn_lease.values():
+                    if task_id in lease.assigned:
+                        conns.append(lease.conn)
+        if spec.actor_id is not None:
+            with self._state_lock:
                 c = self._actor_conns.get(spec.actor_id.binary())
-                if c is not None:
-                    conns.append(c)
+            if c is not None:
+                conns.append(c)
         for conn in conns:
             try:
-                conn.send("stream_cancel", {"task_id": task_id})
+                # threadsafe variant: the conn may live on a shard loop
+                conn.send_threadsafe("stream_cancel", {"task_id": task_id})
                 return
             except Exception as e:
                 logger.debug("stream_cancel to executor failed: %s", e)
@@ -3496,18 +3122,10 @@ class Runtime:
         # must be ACKed by their owners before the result releases the
         # caller's transit pins (the forwarded-ref ordering guarantee)
         await self._await_borrow_acks()
-        try:
-            conn.send("task_result", {"result": result, "owner": spec.owner})
-        except Exception as e:
-            # origin went away: route via the node daemon
-            logger.debug("direct task_result failed (%s); routing via "
-                         "noded", e)
-            try:
-                self.noded.send(
-                    "task_done", {"result": result, "owner": spec.owner}
-                )
-            except Exception as e2:
-                logger.debug("task_done via noded also failed: %s", e2)
+        # coalesced reply: results for this owner produced within the
+        # same loop tick ship as ONE task_result_batch frame (the
+        # coalescer handles the origin-gone fallback via the daemon)
+        self._result_coalescer.enqueue(conn, spec.owner, result)
 
     async def _await_borrow_acks(self, timeout: float = 10.0):
         # SNAPSHOT, don't drain: with concurrent tasks in one worker

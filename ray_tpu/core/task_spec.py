@@ -263,3 +263,16 @@ class TaskResult:
     returns: List[Tuple] = field(default_factory=list)
     error: Optional[bytes] = None  # serialized TaskError envelope
     execution_info: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class TaskResultBatch:
+    """Coalesced completion frame: every TaskResult one executor
+    produced for ONE owner within one event-loop tick, shipped as a
+    single wire frame (reference analog: the reply batching gRPC's
+    HTTP/2 framing gives the raylet for free; here the win is on the
+    OWNER side — one frame means one dispatch task and one
+    drain/lease pass for the whole batch instead of per task)."""
+
+    owner: Tuple[str, str]
+    results: List[TaskResult] = field(default_factory=list)

@@ -344,3 +344,6 @@ def register_core_schemas():
     registry.register(_ts.TaskResult, [
         "task_id", "status", "returns", "error", "execution_info",
     ])
+    # coalesced completion frame (owner-sharded control plane): one
+    # frame per (executor connection, owner) per event-loop tick
+    registry.register(_ts.TaskResultBatch, ["owner", "results"])

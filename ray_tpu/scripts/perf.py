@@ -201,6 +201,31 @@ def build_matrix(rt, args):
     ]
 
 
+def _shard_snapshot() -> List[Dict]:
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().owner_shard_stats()
+
+
+def owner_shard_report(before: List[Dict], after: List[Dict]) -> List[Dict]:
+    """Per-shard delta rows for one measured run: tasks completed on
+    each shard and the shard thread's CPU us per task — the accounting
+    that proves shard scaling is flat even when the host lacks the
+    cores to show a wall-clock win (PERF.md cost model)."""
+    rows = []
+    for b, a in zip(before, after):
+        done = a["completed"] - b["completed"]
+        cpu = a["cpu_s"] - b["cpu_s"]
+        rows.append({
+            "shard": a["shard"],
+            "submitted": a["submitted"] - b["submitted"],
+            "completed": done,
+            "cpu_s": round(cpu, 3),
+            "us_per_task": round(cpu * 1e6 / done, 1) if done else 0.0,
+        })
+    return rows
+
+
 def measure_task_storm(rt, n: int = 1000) -> Dict[str, float]:
     """Submit `n` no-op tasks at once and track each completion time —
     the per-task latency distribution under a full queue bounds the
@@ -512,6 +537,7 @@ def measure_envelope(rt, *, args_n: int = 10_000, returns_n: int = 3_000,
 
     def row_queue():
         noop = rt.remote(num_cpus=0.001)(_small_value)
+        shards_before = _shard_snapshot()
         t0 = time.perf_counter()
         refs = [noop.remote() for _ in range(queue_n)]
         submit_s = time.perf_counter() - t0
@@ -521,11 +547,15 @@ def measure_envelope(rt, *, args_n: int = 10_000, returns_n: int = 3_000,
         for i in range(0, queue_n, step):
             rt.get(refs[i:i + step])
         drain_s = time.perf_counter() - t0
-        return {"n": queue_n, "submit_s": round(submit_s, 2),
-                "submit_per_s": round(queue_n / submit_s, 1),
-                "drain_s": round(drain_s, 2),
-                "tasks_per_s": round(queue_n / (submit_s + drain_s), 1),
-                "driver_rss_gb": round(rss_peak, 2)}
+        out = {"n": queue_n, "submit_s": round(submit_s, 2),
+               "submit_per_s": round(queue_n / submit_s, 1),
+               "drain_s": round(drain_s, 2),
+               "tasks_per_s": round(queue_n / (submit_s + drain_s), 1),
+               "driver_rss_gb": round(rss_peak, 2)}
+        shard_rows = owner_shard_report(shards_before, _shard_snapshot())
+        if len(shard_rows) > 1 or shard_rows[0]["completed"]:
+            out["owner_shards"] = shard_rows
+        return out
 
     def row_large():
         n = int(large_gb * (1 << 30))
@@ -722,6 +752,11 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                    help="also measure the 1k-task storm latency "
                         "distribution (scheduling throughput bound)")
     p.add_argument("--storm-n", type=int, default=1000)
+    p.add_argument("--owner-shards", type=int, default=0,
+                   help="driver-side owner shards (0 = config default; "
+                        "N>1 runs N submission/completion loops keyed "
+                        "by task id — docs/control_plane.md); storm and "
+                        "envelope-queue rows report per-shard us/task")
     p.add_argument("--core-split", action="store_true",
                    help="task storm with per-plane CPU accounting + "
                         "multi-core pipeline projection")
@@ -771,6 +806,10 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
 
     import ray_tpu as rt
 
+    sysconf = (
+        {"owner_shards": args.owner_shards} if args.owner_shards else None
+    )
+
     if args.envelope:
         rows = [r.strip() for r in args.envelope_rows.split(",") if r.strip()]
         results = {}
@@ -786,7 +825,8 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                 )
             rt.init(num_workers=args.num_workers,
                     num_cpus=max(16, args.num_workers * 2),
-                    object_store_memory=store)
+                    object_store_memory=store,
+                    _system_config=sysconf)
             try:
                 results.update(measure_envelope(
                     rt, rows=single_rows,
@@ -817,7 +857,7 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     if owns:
         rt.init(num_workers=args.num_workers, num_cpus=max(
             16, args.num_workers * 2
-        ))
+        ), _system_config=sysconf)
     results: Dict[str, Dict[str, float]] = {}
     try:
         if args.pin_cores:
@@ -856,7 +896,9 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                 cleanup()
             results[n] = {"ops_per_s": round(mean, 2), "sd": round(sd, 2)}
         if args.storm:
+            shards_before = _shard_snapshot()
             dist = measure_task_storm(rt, n=args.storm_n)
+            shard_rows = owner_shard_report(shards_before, _shard_snapshot())
             print(
                 f"task storm ({args.storm_n} tasks): "
                 f"submit {dist['submit_s']:.2f}s, drain "
@@ -864,9 +906,18 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                 f"p95 {dist['p95_s']:.2f}s p100 {dist['p100_s']:.2f}s",
                 flush=True,
             )
+            for row in shard_rows:
+                print(
+                    f"  owner shard {row['shard']}: "
+                    f"{row['completed']} tasks, "
+                    f"{row['cpu_s']:.2f}s CPU, "
+                    f"{row['us_per_task']:.0f} us/task",
+                    flush=True,
+                )
             results["task_storm"] = {
                 k: round(v, 3) for k, v in dist.items()
             }
+            results["task_storm"]["owner_shards"] = shard_rows  # type: ignore[assignment]
         if args.busbw:
             bw = measure_allreduce_busbw(
                 rt, world=args.busbw_world, size_mb=args.busbw_mb

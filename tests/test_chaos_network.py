@@ -185,11 +185,16 @@ def test_lease_connection_kill_mid_flight_retries(quiet_cluster):
 
     def killer():
         time.sleep(0.3)  # let leases establish and tasks start flowing
-        for conn in list(r._conn_lease):
-            try:
-                asyncio.run_coroutine_threadsafe(conn.close(), r.loop)
-            except Exception:
-                pass
+        # lease conns live on their owning shard's loop (the sharded
+        # owner plane); close each on its own loop
+        for shard in r._shards:
+            for conn in list(shard.conn_lease):
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        conn.close(), shard.loop
+                    )
+                except Exception:
+                    pass
 
     import asyncio
 

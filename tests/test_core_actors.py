@@ -72,19 +72,32 @@ def test_get_actor_missing(cluster):
 
 
 def test_async_actor_concurrency(cluster):
+    """Async actor methods interleave on one event loop.  Proven by
+    EVENTS, not wall clock (the old `elapsed < 1.2` bound flaked under
+    full-suite load on a busy 1-core box): 8 calls park on an
+    asyncio.Event a NINTH call sets — if execution were serialized, the
+    release call would sit queued behind the blocked eight forever and
+    the get() below could never return."""
     @rt.remote
     class Slow:
+        def __init__(self):
+            self._gate = asyncio.Event()
+
         async def wait_and_echo(self, x):
-            await asyncio.sleep(0.2)
+            await asyncio.wait_for(self._gate.wait(), timeout=60)
             return x
 
+        async def release(self):
+            self._gate.set()
+            return True
+
     a = Slow.remote()
-    t0 = time.time()
-    out = rt.get([a.wait_and_echo.remote(i) for i in range(8)])
-    elapsed = time.time() - t0
-    assert out == list(range(8))
-    # 8 x 0.2s sequential would be 1.6s; concurrent should be well under
-    assert elapsed < 1.2
+    blocked = [a.wait_and_echo.remote(i) for i in range(8)]
+    # genuinely parked: none may complete before the gate opens
+    done, _ = rt.wait(blocked, timeout=0.5)
+    assert not done
+    assert rt.get(a.release.remote(), timeout=60) is True
+    assert rt.get(blocked, timeout=60) == list(range(8))
 
 
 def test_handle_passing(cluster):
