@@ -105,6 +105,12 @@ class Controller:
         # cluster-wide)
         self.cluster_events: deque = deque(maxlen=10_000)
         self._pg_manager = None  # set by placement module
+        # per-bundle actor claims: (pg_id, bundle_index) ->
+        # {actor_id: demand}.  The bundle-spec admission check alone
+        # would let two actors oversubscribe one bundle; claims bound
+        # admitted demand by what the bundle actually reserved.
+        self._pg_bundle_claims: Dict[Tuple[bytes, int],
+                                     Dict[bytes, Dict[str, float]]] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._subscribers: Dict[str, List[rpc.Connection]] = {}
 
@@ -413,9 +419,45 @@ class Controller:
         # deadlock exactly the churn it tried to ride out.  Transient
         # failures ("resources no longer available", "no idle worker")
         # are retried by the callers.
+        # a placement-group actor consumes capacity the PG ALREADY
+        # reserved on its bundle's node (node.resources was decremented
+        # at reservation time) — checking the demand against the
+        # remaining pool would double-charge it and starve actors on
+        # exactly-sized nodes (an elastic train gang on 1-CPU hosts).
+        # The demand is validated against the bundle spec MINUS live
+        # claims instead, so concurrent actors cannot oversubscribe
+        # one bundle either.
+        pg_bundle = None
+        pg_claim_key = None
+        aid = info.spec.actor_id.binary()
+        if (self._pg_manager is not None
+                and strategy.kind == "placement_group"):
+            pg_info = self._pg_manager.groups.get(strategy.pg_id)
+            if pg_info is not None and pg_info.bundles:
+                idx = strategy.pg_bundle_index
+                idx = idx if idx >= 0 else 0
+                pg_bundle = pg_info.bundles[idx]
+                pg_claim_key = (strategy.pg_id, idx)
+
         errors = []
         for node in sorted(_candidates(), key=avail, reverse=True):
-            if not _fits(demand, node.resources):
+            if pg_bundle is not None:
+                free = dict(pg_bundle)
+                for claimant, d in self._pg_bundle_claims.get(
+                    pg_claim_key, {}
+                ).items():
+                    if claimant == aid:
+                        continue  # re-placement reclaims its own slot
+                    for k, v in d.items():
+                        free[k] = free.get(k, 0.0) - v
+                if not _fits(demand, free):
+                    errors.append(
+                        f"{node.node_id[:8]}: demand {demand} exceeds "
+                        f"free capacity {free} of placement-group "
+                        f"bundle {pg_bundle}"
+                    )
+                    continue
+            elif not _fits(demand, node.resources):
                 errors.append(f"{node.node_id[:8]}: infeasible {demand}")
                 continue
             try:
@@ -431,10 +473,28 @@ class Controller:
                 errors.append(f"{node.node_id[:8]}: {e}")
                 continue
             if reply.get("ok"):
+                if pg_claim_key is not None:
+                    self._pg_bundle_claims.setdefault(
+                        pg_claim_key, {}
+                    )[aid] = dict(demand)
                 return True, (node.node_id, reply["worker_id"])
             errors.append(f"{node.node_id[:8]}: {reply.get('error')}")
         detail = "; ".join(errors) if errors else "no alive candidate nodes"
         return False, f"no node can host actor: {detail}"
+
+    def _release_pg_claim(self, info: "ActorInfo") -> None:
+        """Free a dead actor's bundle claim so the bundle's capacity is
+        admissible again (restart re-claims through _place_actor)."""
+        strategy = info.spec.strategy
+        if getattr(strategy, "kind", None) != "placement_group":
+            return
+        idx = strategy.pg_bundle_index
+        key = (strategy.pg_id, idx if idx >= 0 else 0)
+        claims = self._pg_bundle_claims.get(key)
+        if claims is not None:
+            claims.pop(info.spec.actor_id.binary(), None)
+            if not claims:
+                self._pg_bundle_claims.pop(key, None)
 
     async def handle_readopt_actor(self, payload, conn):
         """A (re)connecting daemon reports an actor it already hosts;
@@ -481,6 +541,15 @@ class Controller:
         info.address = addr
         if spec.name:
             self.named_actors[(spec.namespace, spec.name)] = aid
+        # a restarted controller has an empty claims map: re-record the
+        # readopted actor's bundle claim or its bundle would admit a
+        # second actor into already-occupied capacity
+        strategy = getattr(spec, "strategy", None)
+        if getattr(strategy, "kind", None) == "placement_group":
+            idx = strategy.pg_bundle_index
+            self._pg_bundle_claims.setdefault(
+                (strategy.pg_id, idx if idx >= 0 else 0), {}
+            )[aid] = spec.resources.as_dict()
         self._record_event(
             "ACTOR_READOPTED",
             f"actor {spec.actor_id.hex()[:8]} re-adopted from node "
@@ -522,6 +591,7 @@ class Controller:
             cause = addr_or_err
         info.state = "DEAD"
         info.death_cause = cause
+        self._release_pg_claim(info)
         self._publish(
             "actor_state",
             {"actor_id": info.spec.actor_id.binary(), "state": "DEAD", "cause": cause},
@@ -586,6 +656,7 @@ class Controller:
             # mark dead now; worker-death notifications see max_restarts=0
             info.state = "DEAD"
             info.death_cause = "killed via kill_actor"
+            self._release_pg_claim(info)
             for key, aid in list(self.named_actors.items()):
                 if aid == payload["actor_id"]:
                     del self.named_actors[key]
@@ -632,6 +703,10 @@ class Controller:
 
     async def handle_remove_placement_group(self, payload, conn):
         self._pg_manager.remove(payload["pg_id"])
+        self._pg_bundle_claims = {
+            k: v for k, v in self._pg_bundle_claims.items()
+            if k[0] != payload["pg_id"]
+        }
         return {"ok": True}
 
     async def handle_list_placement_groups(self, payload, conn):

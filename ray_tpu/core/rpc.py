@@ -195,6 +195,9 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._lock = threading.Lock()
         self._touched = 0.0  # board-eviction recency (breaker_for)
+        # set by breaker_for; anonymous (directly-constructed) breakers
+        # never feed the health-subscription hook
+        self.address: Optional[str] = None
 
     def allow(self) -> bool:
         """True when a call toward this address may be attempted now.
@@ -213,16 +216,21 @@ class CircuitBreaker:
         import time as _time
 
         with self._lock:
+            old = self._state
             self._failures += 1
             if (self._state == self.HALF_OPEN
                     or self._failures >= self.failure_threshold):
                 self._state = self.OPEN
                 self._opened_at = _time.monotonic()
+            new = self._state
+        _notify_breaker_transition(self, old, new)
 
     def record_success(self) -> None:
         with self._lock:
+            old = self._state
             self._failures = 0
             self._state = self.CLOSED
+        _notify_breaker_transition(self, old, self.CLOSED)
 
     @property
     def state(self) -> str:
@@ -231,6 +239,48 @@ class CircuitBreaker:
 
     def __repr__(self):
         return f"CircuitBreaker({self.state}, failures={self._failures})"
+
+
+# ---------------------------------------------------------------------
+# health subscription hook: breaker state-transition listeners.
+#
+# Components that must react to a peer going dark WITHOUT waiting for
+# their own next (possibly hung) call — e.g. an elastic train
+# WorkerGroup marking a rank lost the moment its actor's breaker trips
+# — register a listener here.  Listeners fire for breakers created via
+# `breaker_for` (they carry their board address), AFTER the breaker's
+# lock is released, on whatever thread recorded the transition; they
+# must be fast and non-blocking (hand off to a queue/event, don't do
+# work inline).
+# ---------------------------------------------------------------------
+_breaker_listeners: list = []
+_breaker_listeners_lock = threading.Lock()
+
+
+def add_breaker_listener(fn) -> None:
+    """Register `fn(address, old_state, new_state)` to observe every
+    state transition of board breakers (idempotent)."""
+    with _breaker_listeners_lock:
+        if fn not in _breaker_listeners:
+            _breaker_listeners.append(fn)
+
+
+def remove_breaker_listener(fn) -> None:
+    with _breaker_listeners_lock:
+        if fn in _breaker_listeners:
+            _breaker_listeners.remove(fn)
+
+
+def _notify_breaker_transition(br: "CircuitBreaker", old: str, new: str) -> None:
+    if old == new or br.address is None:
+        return
+    with _breaker_listeners_lock:
+        listeners = list(_breaker_listeners)
+    for fn in listeners:
+        try:
+            fn(br.address, old, new)
+        except Exception as e:
+            logger.debug("breaker listener %r failed: %s", fn, e)
 
 
 # process-wide breaker board, keyed by a peer-address string (e.g.
@@ -276,6 +326,7 @@ def breaker_for(address: str) -> CircuitBreaker:
                              "using defaults", e)
                 threshold, cooldown = 5, 2.0
             br = _breakers[address] = CircuitBreaker(threshold, cooldown)
+            br.address = address
             if len(_breakers) > _BREAKER_BOARD_CAP:
                 _evict_stale_locked()
         br._touched = _time.monotonic()

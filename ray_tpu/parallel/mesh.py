@@ -91,6 +91,54 @@ class MeshSpec:
             slices=self.slices,
         )
 
+    def fit_to(self, n_devices: int) -> "MeshSpec":
+        """Elastic re-fit: the widest spec for `n_devices` that keeps
+        every MODEL axis (tp/sp/ep/pp) intact and shrinks only the data
+        axes — dp first (pure replication, cheapest to lose), then fsdp.
+
+        This is the shrink/re-grow contract of elastic training
+        (ROADMAP item 4): a worker group that lost a host rebuilds a
+        smaller mesh whose per-layer collectives are untouched, so the
+        restored checkpoint reshards only along the batch/param-shard
+        dimensions.  Wildcards (-1) resolve against `n_devices` as in
+        `resolve`.  Raises when the model axes alone need more devices
+        than remain."""
+        if n_devices < 1:
+            raise ValueError("n_devices must be >= 1")
+        sizes = self.sizes()
+        if any(v == -1 for v in sizes.values()):
+            return self.resolve(n_devices)
+        model = self.tp * self.sp * self.ep * self.pp
+        if model > n_devices or n_devices % model != 0:
+            raise ValueError(
+                f"model axes tp*sp*ep*pp={model} cannot fit {n_devices} "
+                f"device(s) without resharding a model dimension"
+            )
+        data = n_devices // model
+        # shrink dp (pure replication) before fsdp: keeping the fsdp
+        # degree as high as possible preserves the per-device param/
+        # optimizer memory footprint the ZeRO sharding was sized for.
+        # fsdp = largest divisor of the remaining data extent that does
+        # not exceed the requested fsdp; dp covers the rest.
+        fsdp = 1
+        for cand in range(min(self.fsdp, data), 0, -1):
+            if data % cand == 0:
+                fsdp = cand
+                break
+        dp = data // fsdp
+        fitted = MeshSpec(dp=dp, fsdp=fsdp, tp=self.tp, sp=self.sp,
+                          ep=self.ep, pp=self.pp, slices=self.slices)
+        if fitted.slices > 1:
+            try:
+                fitted.dcn_split()
+            except ValueError:
+                # the surviving data extent no longer factors across
+                # the slice count (e.g. a whole slice was lost): the
+                # re-formed mesh is single-slice by construction
+                fitted = MeshSpec(dp=dp, fsdp=fsdp, tp=self.tp,
+                                  sp=self.sp, ep=self.ep, pp=self.pp)
+        return fitted
+
     def dcn_split(self) -> Tuple[int, int]:
         """(dcn_dp, dcn_fsdp): how the slice count factors across the
         data axes.  dp is split first; fsdp covers the remainder."""

@@ -741,6 +741,113 @@ def measure_engine_trace(*, requests: int = 24, n_new: int = 8,
     return out
 
 
+def _elastic_mttr_loop(config):
+    """Per-worker loop for `--elastic-recovery`: pure control-plane
+    (no jax) so the measured MTTR is detection + re-form + restore,
+    not model compile time.  Rank 1 SIGKILLs itself mid-step on the
+    first attempt, recording the kill instant for the driver."""
+    import os as _os
+    import signal as _signal
+
+    from ray_tpu import train as rtrain
+    from ray_tpu.train.checkpoint import Checkpoint as _Ck
+
+    ctx = rtrain.get_context()
+    ck = rtrain.get_checkpoint()
+    start = ck.to_dict()["step"] + 1 if ck is not None else 0
+    for step in range(start, config["num_steps"]):
+        if (ck is None and step == config["kill_at"]
+                and ctx.get_world_rank() == 1):
+            with open(config["kill_marker"], "w") as f:
+                f.write(repr(time.time()))
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+        c = (_Ck.from_dict({"step": step})
+             if ctx.get_world_rank() == 0 else None)
+        rtrain.report({"step": step, "world": ctx.get_world_size()},
+                      checkpoint=c)
+
+
+def measure_elastic_recovery(*, num_workers: int = 2, num_steps: int = 12,
+                             kill_at: int = 4) -> Dict[str, Dict[str, float]]:
+    """MTTR for elastic preemption recovery (docs/elastic_training.md):
+    SIGKILL one training rank mid-step and measure, on the wall clock,
+
+    - `detect_s`: kill → the health plane marking the rank lost;
+    - `mttr_s`:   kill → the FIRST post-recovery step reported by the
+      re-formed group (detection + drain + re-reserve + actor boot +
+      checkpoint restore);
+    - `resume_step` == the checkpointed step (no lost progress beyond
+      the in-flight step).
+
+    The structural shape of these rows is tier-1-gated
+    (`tests/test_perf_harness.py`); the measured numbers live in
+    PERF.md."""
+    import tempfile
+
+    from ray_tpu.train import (
+        FailureConfig, JaxConfig, JaxTrainer, RunConfig, ScalingConfig,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="rt_elastic_mttr_")
+    kill_marker = os.path.join(workdir, "kill_ts")
+    reports: List[Dict[str, float]] = []
+    trainer = JaxTrainer(
+        _elastic_mttr_loop,
+        train_loop_config={
+            "num_steps": num_steps, "kill_at": kill_at,
+            "kill_marker": kill_marker,
+        },
+        jax_config=JaxConfig(distributed_mode="none"),
+        scaling_config=ScalingConfig(num_workers=num_workers),
+        run_config=RunConfig(
+            storage_path=workdir, name="elastic_mttr",
+            failure_config=FailureConfig(
+                elastic=True, min_workers=1, detect_poll_s=0.2,
+                drain_timeout_s=3.0, reform_timeout_s=10.0,
+            ),
+        ),
+    )
+    trainer._result_callback = lambda m, ck: reports.append(
+        {"step": m["step"], "wall": time.time()}
+    )
+    if num_workers < 2:
+        raise ValueError(
+            "--elastic-workers must be >= 2: the harness SIGKILLs "
+            "rank 1, which does not exist in a 1-worker group"
+        )
+    result = trainer.fit()
+    if result.error is not None:
+        raise RuntimeError(f"elastic recovery run failed: {result.error}")
+    shrinks = [e for e in trainer._elastic_events if e["kind"] == "shrink"]
+    reforms = [e for e in trainer._elastic_events if e["kind"] == "reform"]
+    if not shrinks or not reforms or not os.path.exists(kill_marker):
+        raise RuntimeError(
+            "elastic recovery run exercised no failover (events: "
+            f"{trainer._elastic_events}) — nothing to measure"
+        )
+    with open(kill_marker) as f:
+        kill_wall = float(f.read())
+    shrink, reform = shrinks[0], reforms[0]
+    # the resumed step re-reports the checkpointed step + 1: the first
+    # report after the reform event is the first post-recovery step
+    post = [r for r in reports if r["wall"] >= reform["wall"]]
+    resume_step = post[0]["step"] if post else -1
+    row = {
+        "detect_s": round(shrink["detected_wall"] - kill_wall, 3),
+        "mttr_s": round(post[0]["wall"] - kill_wall, 3) if post else -1.0,
+        "reform_s": round(reform["wall"] - shrink["detected_wall"], 3),
+        "kill_step": float(kill_at),
+        "resume_step": float(resume_step),
+        "final_step": float(result.metrics["step"]),
+        "failovers": float(sum(1 for e in trainer._elastic_events
+                               if e["kind"] == "shrink")),
+        "reform_width": float(reform["width"]),
+    }
+    print("elastic_recovery: " + ", ".join(
+        f"{k}={v}" for k, v in row.items()), flush=True)
+    return {"elastic_recovery": row}
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -773,6 +880,12 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "prefix reuse, CB smoke (CPU tiny model; no "
                         "cluster)")
     p.add_argument("--engine-requests", type=int, default=24)
+    p.add_argument("--elastic-recovery", action="store_true",
+                   help="measure elastic-training MTTR: SIGKILL one "
+                        "rank mid-step, report kill->detect and "
+                        "kill->first-post-recovery-step latencies")
+    p.add_argument("--elastic-workers", type=int, default=2)
+    p.add_argument("--elastic-steps", type=int, default=12)
     p.add_argument("--envelope", action="store_true",
                    help="run the scalability-envelope rows INSTEAD of "
                         "the microbenchmark matrix (reference: "
@@ -809,6 +922,26 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     sysconf = (
         {"owner_shards": args.owner_shards} if args.owner_shards else None
     )
+
+    if args.elastic_recovery:
+        owns = not rt.is_initialized()
+        if owns:
+            rt.init(num_workers=max(4, args.elastic_workers * 2),
+                    num_cpus=max(8, args.elastic_workers * 2),
+                    _system_config=sysconf)
+        try:
+            results = measure_elastic_recovery(
+                num_workers=args.elastic_workers,
+                num_steps=args.elastic_steps,
+            )
+        finally:
+            if owns:
+                rt.shutdown()
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
 
     if args.envelope:
         rows = [r.strip() for r in args.envelope_rows.split(",") if r.strip()]

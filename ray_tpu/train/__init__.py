@@ -7,9 +7,16 @@ backend; meshes come from the ScalingConfig).
 """
 
 from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
-from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    ElasticWorkerLost,
+    TrainingWorkerError,
+)
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.checkpoint_manager import (
+    CheckpointManager,
+    validate_checkpoint,
+)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -43,6 +50,7 @@ __all__ = [
     "CheckpointConfig",
     "CheckpointManager",
     "DataParallelTrainer",
+    "ElasticWorkerLost",
     "FailureConfig",
     "JaxBackend",
     "JaxConfig",
@@ -62,4 +70,5 @@ __all__ = [
     "load_sharded",
     "report",
     "save_sharded",
+    "validate_checkpoint",
 ]

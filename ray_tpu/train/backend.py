@@ -9,11 +9,14 @@ plane) or `jax.distributed.initialize` (multi-host SPMD, SURVEY §5.8).
 
 from __future__ import annotations
 
+import logging
 import socket
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -85,8 +88,10 @@ def _setup_worker_env(env_vars: Dict[str, str], platform: Optional[str]):
             import jax
 
             jax.config.update("jax_platforms", platform)
-        except Exception:
-            pass
+        except Exception as e:
+            logging.getLogger(__name__).debug(
+                "jax platform re-assert skipped: %s", e
+            )
 
 
 def _init_collective(world_size: int, rank: int, group_name: str):
@@ -177,8 +182,10 @@ class JaxBackend(Backend):
             name = f"__rt_collective__{backend_config.collective_group_name}"
             try:
                 rt.kill(rt.get_actor(name))
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort: the rendezvous actor may never have been
+                # created (group died before on_training_start)
+                logger.debug("rendezvous actor cleanup: %s", e)
 
 
 def _coordinator_addr():

@@ -97,16 +97,27 @@ def _new_checkpoint_dirname(index: int) -> str:
     return f"checkpoint_{index:06d}"
 
 
-def persist_checkpoint(checkpoint: Checkpoint, run_dir: str, index: int) -> str:
-    """Copy a worker-local checkpoint into run storage.  All reporting
-    ranks merge into one directory — under DP every rank holds the same
-    state (typically only rank 0 reports); under model parallelism ranks
-    write distinctly-named shard files (orbax does this natively).
-    Reference: `train/_internal/storage.py` persist_current_checkpoint.
-    """
-    dest = os.path.join(run_dir, _new_checkpoint_dirname(index))
+def merge_into(checkpoint: Checkpoint, dest: str) -> str:
+    """Merge one reported checkpoint's contents into `dest` (all
+    reporting ranks land in the same directory — under DP every rank
+    holds the same state; under model parallelism ranks write
+    distinctly-named shard files).  Reclaims temp-sourced checkpoint
+    directories after the copy."""
     os.makedirs(dest, exist_ok=True)
     checkpoint.to_directory(dest)
     if getattr(checkpoint, "_temp_source", False):
         shutil.rmtree(checkpoint.path, ignore_errors=True)
     return dest
+
+
+def persist_checkpoint(checkpoint: Checkpoint, run_dir: str, index: int) -> str:
+    """Copy a worker-local checkpoint into run storage (NON-atomic: the
+    destination is visible while being written).  The trainer's fit
+    loop uses `CheckpointManager.commit` instead, which stages all
+    reporting ranks in a temp directory, records a per-file checksum
+    manifest, and renames — a half-written "latest" is never trusted by
+    the restore path.  Reference: `train/_internal/storage.py`
+    persist_current_checkpoint."""
+    return merge_into(
+        checkpoint, os.path.join(run_dir, _new_checkpoint_dirname(index))
+    )

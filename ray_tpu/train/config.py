@@ -50,9 +50,57 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """Reference: `air/config.py` FailureConfig(max_failures)."""
+    """Reference: `air/config.py` FailureConfig(max_failures), extended
+    with the elastic-training contract (ROADMAP item 4: preemption-
+    tolerant worker groups).
+
+    - ``max_failures``: restarts granted for failures raised BY the
+      user's train loop (unchanged semantics; -1 = unlimited).
+    - ``elastic``: when True, a LOST worker (preempted host, SIGKILLed
+      process, tripped circuit breaker) does not consume the
+      ``max_failures`` budget and does not require full capacity to
+      recover: the group re-forms at the widest placeable width in
+      ``[min_workers, num_workers]``, restores from the latest atomic
+      checkpoint (resharding as needed), and re-grows to full width
+      when capacity returns.
+    - ``min_workers``: smallest world size worth training at (default
+      1).  Below it the trainer waits — with jittered backoff — up to
+      ``reform_deadline_s`` before failing the run.
+    - ``detect_poll_s``: executor-side polling granularity while
+      waiting on worker results; bounds how long a hung ``execute``
+      can mask a death signalled by the health plane.
+    - ``drain_timeout_s``: how long surviving ranks get to reach the
+      step barrier (their next ``report()``) before being torn down
+      anyway — a survivor wedged inside a collective with a dead peer
+      must not stall recovery.
+    - ``reform_timeout_s``: per-width placement-group wait while
+      re-forming (the shrink ladder tries num_workers, then
+      num_workers-1, ... min_workers, each bounded by this).
+    - ``reform_deadline_s``: total budget for capacity below
+      ``min_workers`` before the run fails.
+    - ``regrow_interval_s``: how often a degraded group probes for the
+      missing capacity; a successful probe pauses ranks at the next
+      step barrier and re-forms at full width.
+    """
 
     max_failures: int = 0
+    elastic: bool = False
+    min_workers: int = 1
+    detect_poll_s: float = 0.5
+    drain_timeout_s: float = 5.0
+    reform_timeout_s: float = 10.0
+    reform_deadline_s: float = 300.0
+    regrow_interval_s: float = 10.0
+    max_failovers: int = -1  # elastic failovers allowed (-1 = unlimited)
+
+    def __post_init__(self):
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        for knob in ("detect_poll_s", "drain_timeout_s",
+                     "reform_timeout_s", "reform_deadline_s",
+                     "regrow_interval_s"):
+            if getattr(self, knob) <= 0:
+                raise ValueError(f"{knob} must be positive")
 
     @property
     def retries_enabled(self) -> bool:

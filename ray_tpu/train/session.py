@@ -68,13 +68,38 @@ class TrainContext:
     def get_trial_id(self) -> str:
         return self.trial_id
 
+    def get_target_world_size(self) -> int:
+        """The ScalingConfig's requested width.  Under an elastic
+        shrink (`FailureConfig(elastic=True)`) `get_world_size()` may
+        be smaller; the difference tells the loop it is running
+        degraded and will be re-grown when capacity returns."""
+        return int(self.extra.get("target_world_size", self.world_size))
+
+    def is_elastic(self) -> bool:
+        return bool(self.extra.get("elastic", False))
+
     def get_mesh(self):
         """Build this worker's jax mesh per the ScalingConfig's
-        ``mesh_shape`` (all local devices if unset)."""
-        from ray_tpu.parallel import mesh_from_devices
+        ``mesh_shape`` (all local devices if unset).
 
-        shape = self.mesh_shape or {}
-        return mesh_from_devices(**shape)
+        Elastic runs re-form at a smaller width, so the requested
+        shape may no longer match the visible device count — then the
+        spec is re-fit via `MeshSpec.fit_to`: model axes preserved,
+        data axes (dp first) shrunk to cover the surviving devices."""
+        import jax
+
+        from ray_tpu.parallel import MeshSpec
+
+        shape = dict(self.mesh_shape or {})
+        n = shape.pop("n", None)
+        devices = jax.devices()[: n or len(jax.devices())]
+        spec = MeshSpec(**shape)
+        try:
+            return spec.build(devices)
+        except ValueError:
+            if not self.is_elastic():
+                raise
+            return spec.fit_to(len(devices)).build(devices)
 
 
 class _Session:
@@ -90,11 +115,24 @@ class _Session:
         self.result_queue: "queue.Queue[_TrainingResult]" = queue.Queue(maxsize=1)
         self.loaded_checkpoint = checkpoint
         self.datasets = datasets or {}
+        # stop: unwind at the NEXT step barrier, after delivering the
+        # current result (graceful — the executor keeps consuming).
+        # abandoned: the executor has stopped consuming (elastic drain,
+        # teardown); skip delivery entirely so nothing blocks on the
+        # 1-deep queue.
         self.stop_requested = threading.Event()
+        self.abandoned = threading.Event()
         self.iteration = 0
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
         self.iteration += 1
+        # An ABANDONED session's results have no consumer: skip the put
+        # (it could block forever on the 1-deep queue) and unwind at
+        # the step barrier now.  A graceful stop still DELIVERS this
+        # round — dropping it would hand the trainer a partial round
+        # and a partial (invalid) checkpoint commit.
+        if self.abandoned.is_set():
+            raise StopIteration("training session abandoned")
         # Blocks when the executor is behind — natural backpressure, the
         # same semantics as the reference's result queue.
         self.result_queue.put(_TrainingResult(metrics=metrics, checkpoint=checkpoint))

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -89,6 +90,11 @@ def save_sharded(tree: Any, dir_: str) -> None:
                         (sl.start or 0) for sl in shard.index
                     ] if shard.index else [0] * data.ndim,
                     "shape": list(data.shape),
+                    # per-piece checksum over the raw buffer: restore
+                    # verifies it so a piece corrupted between commit
+                    # and restore (truncated copy, bit rot) fails loud
+                    # instead of silently assembling garbage params
+                    "crc32": zlib.crc32(np.ascontiguousarray(data).tobytes()),
                 })
         else:
             aux[key] = leaf
@@ -124,33 +130,48 @@ class _PieceReader:
     def __init__(self, dir_: str, num_processes: Optional[int] = None):
         self._dir = dir_
         self._npz: Dict[str, Any] = {}
-        # leaf key -> [(rank_file, piece_key, start, shape)]
+        self._verified: set = set()
+        # leaf key -> [(rank_file, piece_key, start, shape, crc32|None)]
         self.by_leaf: Dict[str, List] = {}
+        self.ranks_seen: set = set()
         for fn in sorted(os.listdir(dir_)):
-            if num_processes is not None and fn.startswith("pieces_r"):
+            if fn.startswith("pieces_r"):
                 rank = int(fn[len("pieces_r"):].split(".")[0])
-                if rank >= num_processes:
+                if num_processes is not None and rank >= num_processes:
                     continue  # stale file from an earlier larger save
             if fn.startswith("pieces_r") and fn.endswith(".json"):
+                self.ranks_seen.add(rank)
                 with open(os.path.join(dir_, fn)) as f:
                     for ent in json.load(f):
                         self.by_leaf.setdefault(ent["leaf"], []).append(
                             (fn[:-5] + ".npz", ent["key"],
-                             ent["start"], ent["shape"])
+                             ent["start"], ent["shape"],
+                             ent.get("crc32"))
                         )
 
-    def read(self, npz_name: str, key: str) -> np.ndarray:
+    def read(self, npz_name: str, key: str,
+             crc: Optional[int] = None) -> np.ndarray:
         z = self._npz.get(npz_name)
         if z is None:
             z = self._npz[npz_name] = np.load(
                 os.path.join(self._dir, npz_name)
             )
-        return z[key]
+        arr = z[key]
+        if crc is not None and (npz_name, key) not in self._verified:
+            got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if got != crc:
+                raise ValueError(
+                    f"checkpoint piece {npz_name}:{key} is corrupted "
+                    f"(crc32 {got:#x} != recorded {crc:#x})"
+                )
+            self._verified.add((npz_name, key))
+        return arr
 
     def assemble(self, leaf: str, region: Tuple[slice, ...],
                  shape, dtype) -> np.ndarray:
         """Build the requested global region of `leaf` from overlapping
-        pieces."""
+        pieces (the reshard-on-restore core: pieces written by any
+        N-process layout assemble into any M-process target layout)."""
         full = tuple(
             slice(sl.start or 0, sl.stop if sl.stop is not None else dim)
             for sl, dim in zip(region, shape)
@@ -158,12 +179,12 @@ class _PieceReader:
         out_shape = tuple(sl.stop - sl.start for sl in full)
         out = np.empty(out_shape, dtype=dtype)
         covered = 0
-        for npz_name, key, start, pshape in self.by_leaf.get(leaf, ()):
+        for npz_name, key, start, pshape, crc in self.by_leaf.get(leaf, ()):
             ov = _overlap(full, start, pshape)
             if ov is None:
                 continue
             dst, src = ov
-            out[dst] = self.read(npz_name, key)[src]
+            out[dst] = self.read(npz_name, key, crc)[src]
             covered += int(np.prod([s.stop - s.start for s in dst]))
         want = int(np.prod(out_shape))
         if covered < want:
@@ -195,6 +216,19 @@ def load_sharded(dir_: str, target: Any) -> Any:
         with open(os.path.join(dir_, _AUX), "rb") as f:
             aux = serialization.loads(f.read())
     reader = _PieceReader(dir_, manifest.get("num_processes"))
+    want_ranks = manifest.get("num_processes")
+    if want_ranks is not None:
+        missing = set(range(int(want_ranks))) - reader.ranks_seen
+        if missing:
+            # the save was made by N writers but the merged directory
+            # lost some of them (a preempted rank never reported, a
+            # partial copy): refuse up front rather than failing on
+            # partial coverage mid-assembly — or worse, assembling a
+            # replicated leaf from the wrong rank's stale piece
+            raise ValueError(
+                f"incomplete sharded checkpoint {dir_}: missing piece "
+                f"files for rank(s) {sorted(missing)} of {want_ranks}"
+            )
 
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
     out = []

@@ -9,21 +9,46 @@ through Tune — our Tune-equivalent wraps trainers via
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import uuid
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu.core.retry import backoff_delay_s
 from ray_tpu.train.backend import BackendConfig, JaxConfig
-from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
-from ray_tpu.train.checkpoint import Checkpoint, persist_checkpoint
-from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    ElasticWorkerLost,
+    TrainingWorkerError,
+)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import (
+    CheckpointCommitError,
+    CheckpointManager,
+    sweep_staging,
+)
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.result import Result
+
+logger = logging.getLogger(__name__)
 
 
 class TrainingFailedError(RuntimeError):
     """Raised by fit() when training fails beyond FailureConfig limits."""
+
+
+def _is_capacity_error(e: BaseException) -> bool:
+    """Start failures worth waiting out: the cluster momentarily lacks
+    the bundles/workers (preempted capacity routinely returns), as
+    opposed to deterministic config/backend failures."""
+    msg = str(e)
+    return any(s in msg for s in (
+        "could not reserve",
+        "no node can host actor",
+        "resources no longer available",
+        "no idle worker",
+    ))
 
 
 class BaseTrainer:
@@ -111,14 +136,23 @@ class DataParallelTrainer(BaseTrainer):
 
         record_library_usage("train")
         run_dir = self._run_dir()
+        sweep_staging(run_dir)
         ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
-        max_failures = self.run_config.failure_config.max_failures
+        fc = self.run_config.failure_config
+        max_failures = fc.max_failures
         failures = 0
+        failovers = 0
         latest_checkpoint = self.resume_from_checkpoint
         history = []
         last_metrics: Optional[Dict[str, Any]] = None
         error: Optional[BaseException] = None
         iteration = 0
+        reform = False
+        # elastic lifecycle log — {"kind": "shrink"|"reform"|"regrow",
+        # ...} — consumed by the MTTR harness (`perf.py
+        # --elastic-recovery`) and the chaos tests' deterministic
+        # assertions
+        self._elastic_events: List[Dict[str, Any]] = []
 
         while True:
             executor = BackendExecutor(
@@ -127,15 +161,26 @@ class DataParallelTrainer(BaseTrainer):
                 experiment_name=os.path.basename(run_dir),
                 trial_id=uuid.uuid4().hex[:8],
                 storage_path=run_dir,
+                failure_config=fc,
             )
             try:
-                executor.start()
+                self._start_with_capacity_wait(executor, reform)
+                width = len(executor.worker_group)
+                if reform:
+                    self._elastic_events.append({
+                        "kind": "reform", "width": width,
+                        "target": self.scaling_config.num_workers,
+                        "iteration": iteration, "wall": time.time(),
+                    })
                 executor.start_training(
                     self.train_loop_per_worker,
                     self.train_loop_config,
                     checkpoint=latest_checkpoint,
                     datasets=self.datasets,
                 )
+                stop_requested = False
+                pause_for_regrow = False
+                regrow_last_probe = time.monotonic()
                 while True:
                     results = executor.get_next_results()
                     if results is None:
@@ -150,20 +195,75 @@ class DataParallelTrainer(BaseTrainer):
                     reported = [r.checkpoint for r in results if r.checkpoint]
                     persisted = None
                     if reported:
-                        dest = None
-                        for ck in reported:
-                            dest = persist_checkpoint(ck, run_dir, iteration)
-                        persisted = Checkpoint(dest)
-                        persisted.update_metadata({"iteration": iteration})
-                        ckpt_manager.register(persisted, metrics, iteration)
-                        latest_checkpoint = persisted
+                        try:
+                            persisted = ckpt_manager.commit(
+                                reported, run_dir, iteration, metrics
+                            )
+                            latest_checkpoint = persisted
+                        except CheckpointCommitError as ce:
+                            # e.g. a stop-boundary round where only a
+                            # subset of writer ranks reported: the
+                            # previous checkpoint stays `latest`
+                            logger.warning(
+                                "iteration %d checkpoint not published"
+                                " (%s); keeping the previous one",
+                                iteration, ce,
+                            )
                     if self._result_callback is not None:
                         self._result_callback(metrics, persisted)
-                    if self._should_stop(metrics):
-                        for w in executor.worker_group.workers:
-                            w.request_stop.remote()
+                    if not stop_requested and self._should_stop(metrics):
+                        stop_requested = True
+                        executor.request_stop_all()
+                    # re-grow: a degraded elastic group periodically
+                    # probes for its missing capacity; on success the
+                    # ranks pause at the next step barrier and the
+                    # group re-forms at full width
+                    if (
+                        fc.elastic
+                        and not stop_requested
+                        and not pause_for_regrow
+                        and width < self.scaling_config.num_workers
+                        and time.monotonic() - regrow_last_probe
+                        >= fc.regrow_interval_s
+                    ):
+                        regrow_last_probe = time.monotonic()
+                        if executor.probe_regrow():
+                            pause_for_regrow = True
+                            executor.request_stop_all()
+                if pause_for_regrow:
+                    self._elastic_events.append({
+                        "kind": "regrow", "width_from": width,
+                        "iteration": iteration, "wall": time.time(),
+                    })
+                    executor.shutdown()
+                    latest_checkpoint = (
+                        ckpt_manager.latest_valid or latest_checkpoint
+                    )
+                    reform = True
+                    continue
                 error = None
                 break
+            except ElasticWorkerLost as e:
+                failovers += 1
+                self._elastic_events.append({
+                    "kind": "shrink", "lost_ranks": dict(e.lost_ranks),
+                    "width": e.width, "iteration": iteration,
+                    "detected_wall": e.detected_at, "wall": time.time(),
+                })
+                logger.warning(
+                    "elastic failover %d: %s — re-forming from latest "
+                    "valid checkpoint", failovers, e,
+                )
+                if 0 <= fc.max_failovers < failovers:
+                    error = TrainingFailedError(
+                        f"training failed after {failovers} elastic "
+                        f"failover(s): {e}"
+                    )
+                    break
+                latest_checkpoint = (
+                    ckpt_manager.latest_valid or latest_checkpoint
+                )
+                reform = True
             except TrainingWorkerError as e:
                 failures += 1
                 if max_failures >= 0 and failures > max_failures:
@@ -171,7 +271,11 @@ class DataParallelTrainer(BaseTrainer):
                         f"training failed after {failures} failure(s): {e}"
                     )
                     break
-                latest_checkpoint = ckpt_manager.latest or latest_checkpoint
+                latest_checkpoint = (
+                    ckpt_manager.latest_valid or latest_checkpoint
+                    if fc.elastic
+                    else ckpt_manager.latest or latest_checkpoint
+                )
             finally:
                 executor.shutdown()
 
@@ -183,6 +287,46 @@ class DataParallelTrainer(BaseTrainer):
             metrics_history=history,
             best_checkpoints=ckpt_manager.best_checkpoints,
         )
+
+    def _start_with_capacity_wait(self, executor: BackendExecutor,
+                                  reform: bool) -> None:
+        """Start the executor; an elastic run whose cluster momentarily
+        cannot place even ``min_workers`` waits with jittered backoff
+        (never a constant-sleep redial loop) up to
+        ``reform_deadline_s`` — preempted capacity routinely comes
+        back within minutes."""
+        fc = self.run_config.failure_config
+        if not fc.elastic:
+            executor.start()
+            return
+        deadline = time.monotonic() + fc.reform_deadline_s
+        attempt = 0
+        while True:
+            try:
+                executor.start(reform=reform)
+                return
+            except Exception as e:
+                executor.shutdown()
+                if not _is_capacity_error(e):
+                    # deterministic failures (bad config, backend bug)
+                    # must surface immediately with their real cause,
+                    # not after reform_deadline_s of futile redialing
+                    raise
+                if time.monotonic() >= deadline:
+                    raise TrainingFailedError(
+                        f"cluster stayed below min_workers="
+                        f"{fc.min_workers} for {fc.reform_deadline_s:.0f}s: "
+                        f"{e}"
+                    ) from e
+                delay = backoff_delay_s(
+                    attempt, base_s=0.5, cap_s=15.0,
+                )
+                logger.info(
+                    "elastic start attempt %d failed (%s); retrying in "
+                    "%.1fs", attempt + 1, e, delay,
+                )
+                time.sleep(delay)
+                attempt += 1
 
 
 class JaxTrainer(DataParallelTrainer):

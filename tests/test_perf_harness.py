@@ -79,6 +79,27 @@ def test_engine_trace_smoke_rows():
     assert results["prefix_off"]["prefix_hit_tokens"] == 0
 
 
+def test_elastic_recovery_row():
+    """`--elastic-recovery`: the elastic-training MTTR canary —
+    structurally validated like the engine-trace rows (measured
+    latencies live in PERF.md):
+    - the kill was detected through the health plane (detect_s bounded)
+      and exactly one failover recovered it;
+    - recovery resumed at (or before) the kill step from the latest
+      atomic checkpoint, never beyond it;
+    - the run finished every step without consuming the failure
+      budget (fit() returned without error at max_failures=0)."""
+    from ray_tpu.scripts.perf import main
+
+    results = main(["--elastic-recovery", "--elastic-steps", "10"])
+    row = results["elastic_recovery"]
+    assert row["failovers"] == 1.0
+    assert 0.0 < row["detect_s"] < row["mttr_s"]
+    assert 0.0 < row["resume_step"] <= row["kill_step"]
+    assert row["final_step"] == 9.0  # every step delivered
+    assert row["reform_width"] == 2.0  # capacity returned: full width
+
+
 def test_pin_cores_rejects_oversubscription():
     import os
 

@@ -1,0 +1,789 @@
+"""Elastic preemption-tolerant training (ROADMAP item 4).
+
+Chaos conventions follow `test_chaos*.py`: seeded RNGs, deterministic
+marker files for victim selection, real SIGKILLs.  The flagship test
+preempts a whole host (SIGKILL the rank + its node daemon) mid-step and
+drives the full detect → shrink → reshard → resume → re-grow lifecycle
+without restarting `fit()`; reshard-on-restore is covered N→M in both
+directions via real multi-process saves (gloo collectives path).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    WorkerGroup,
+    validate_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos
+
+# each worker process: its own jax runtime with 2 virtual CPU devices
+_WORKER_ENV = {
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+@pytest.fixture(scope="module")
+def multiproc_cpu():
+    from ray_tpu.testing import jax_multiprocess_cpu_support
+
+    ok, why = jax_multiprocess_cpu_support()
+    if not ok:
+        pytest.skip(
+            f"multi-process CPU XLA unsupported in this JAX/jaxlib "
+            f"environment: {why}"
+        )
+
+
+def _wait_for(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ----------------------------------------------------------------------
+# mesh re-fit
+# ----------------------------------------------------------------------
+def test_mesh_fit_to_shrinks_data_axes_only():
+    from ray_tpu.parallel import MeshSpec
+
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    half = spec.fit_to(4)
+    assert (half.tp, half.sp, half.ep, half.pp) == (2, 1, 1, 1)
+    assert half.dp * half.fsdp == 2
+    assert half.fsdp == 2  # fsdp preserved; dp absorbed the loss
+    # dp-first shrink: pure-DP spec keeps model axes implicitly
+    assert MeshSpec(dp=4, fsdp=2).fit_to(4).fsdp == 2
+    # grow direction: fit_to can also widen the data axes
+    grown = MeshSpec(dp=1, fsdp=2).fit_to(8)
+    assert grown.dp * grown.fsdp == 8
+    # model axes can never be shrunk implicitly
+    with pytest.raises(ValueError):
+        MeshSpec(tp=4).fit_to(2)
+    with pytest.raises(ValueError):
+        MeshSpec(tp=3).fit_to(4)  # non-divisible
+    # wildcard specs resolve as usual
+    assert MeshSpec(dp=-1).fit_to(6).dp == 6
+
+
+def test_train_context_get_mesh_refits_when_elastic():
+    import jax
+
+    from ray_tpu.train.session import TrainContext
+
+    ctx = TrainContext(mesh_shape={"dp": 8})
+    assert ctx.get_mesh().devices.size == 8
+    # shrunk world: 8 devices requested, only elastic contexts re-fit
+    ctx_bad = TrainContext(mesh_shape={"dp": 16})
+    with pytest.raises(ValueError):
+        ctx_bad.get_mesh()
+    ctx_elastic = TrainContext(
+        mesh_shape={"dp": 16}, extra={"elastic": True}
+    )
+    mesh = ctx_elastic.get_mesh()
+    assert mesh.devices.size == len(jax.devices())
+
+
+# ----------------------------------------------------------------------
+# atomic checkpoint commit
+# ----------------------------------------------------------------------
+def test_atomic_commit_and_corruption_detection(tmp_path):
+    from ray_tpu.train.checkpoint_manager import (
+        CheckpointManager,
+        sweep_staging,
+    )
+
+    run_dir = str(tmp_path)
+    mgr = CheckpointManager()
+    c1 = mgr.commit([Checkpoint.from_dict({"step": 1})], run_dir, 1,
+                    {"loss": 1.0})
+    c2 = mgr.commit([Checkpoint.from_dict({"step": 2})], run_dir, 2,
+                    {"loss": 0.5})
+    assert validate_checkpoint(c1.path) == (True, "ok")
+    assert validate_checkpoint(c2.path) == (True, "ok")
+    assert mgr.latest_valid.path == c2.path
+    assert c2.to_dict()["step"] == 2
+    assert c2.get_metadata()["iteration"] == 2
+
+    # corrupt the newest: restore must fall back to the previous one
+    with open(os.path.join(c2.path, "state.pkl"), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    ok, why = validate_checkpoint(c2.path)
+    assert not ok and "checksum mismatch" in why
+    assert mgr.latest_valid.path == c1.path
+
+    # a truncated (partial) file is caught by the size check
+    c3 = mgr.commit([Checkpoint.from_dict({"step": 3})], run_dir, 3,
+                    {"loss": 0.4})
+    with open(os.path.join(c3.path, "state.pkl"), "r+b") as f:
+        f.truncate(4)
+    ok, why = validate_checkpoint(c3.path)
+    assert not ok and "size mismatch" in why
+
+    # a file missing entirely
+    c4 = mgr.commit([Checkpoint.from_dict({"step": 4})], run_dir, 4,
+                    {"loss": 0.3})
+    os.unlink(os.path.join(c4.path, "state.pkl"))
+    ok, why = validate_checkpoint(c4.path)
+    assert not ok and "missing file" in why
+    assert mgr.latest_valid.path == c1.path
+
+    # orphaned staging dirs (driver killed mid-commit) are swept, and
+    # were never visible as committed checkpoints in the first place
+    os.makedirs(os.path.join(run_dir, ".tmp_checkpoint_000009_dead"))
+    assert sweep_staging(run_dir) == 1
+    assert not any(
+        d.startswith(".tmp_checkpoint_") for d in os.listdir(run_dir)
+    )
+
+
+def test_commit_interrupted_staging_never_becomes_latest(tmp_path):
+    """A crash mid-merge leaves only a staging dir; the restore path
+    must not see it as a checkpoint at all."""
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+    run_dir = str(tmp_path)
+    mgr = CheckpointManager()
+    committed = mgr.commit([Checkpoint.from_dict({"step": 1})], run_dir,
+                           1, {})
+
+    class _Boom(Exception):
+        pass
+
+    class _ExplodingCheckpoint(Checkpoint):
+        def to_directory(self, path=None):
+            super().to_directory(path)
+            raise _Boom("preempted mid-merge")
+
+    src = _ExplodingCheckpoint(Checkpoint.from_dict({"step": 2}).path)
+    with pytest.raises(_Boom):
+        mgr.commit([src], run_dir, 2, {})
+    assert mgr.latest_valid.path == committed.path
+    # the failed commit cleaned its staging dir
+    assert [d for d in os.listdir(run_dir)
+            if d.startswith(".tmp_checkpoint_")] == []
+    assert not os.path.exists(os.path.join(run_dir, "checkpoint_000002"))
+
+
+# ----------------------------------------------------------------------
+# sharded checkpoint: piece checksums + rank completeness
+# ----------------------------------------------------------------------
+def test_sharded_piece_crc_detects_corruption(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train.sharded_checkpoint import load_sharded, save_sharded
+
+    mesh = MeshSpec(dp=8).build(jax.devices()[:8])
+    sh = NamedSharding(mesh, P("dp"))
+    d = str(tmp_path / "ck")
+    save_sharded({"w": jax.device_put(jnp.arange(8.0), sh)}, d)
+    # rewrite the piece data without updating the recorded checksums
+    stale = dict(np.load(os.path.join(d, "pieces_r00000.npz")))
+    np.savez(os.path.join(d, "pieces_r00000.npz"),
+             **{k: np.full_like(v, 99.0) for k, v in stale.items()})
+    with pytest.raises(ValueError, match="corrupted"):
+        load_sharded(d, {"w": jax.device_put(jnp.zeros(8), sh)})
+
+
+def test_sharded_missing_rank_files_rejected(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train.sharded_checkpoint import load_sharded, save_sharded
+
+    mesh = MeshSpec(dp=8).build(jax.devices()[:8])
+    sh = NamedSharding(mesh, P("dp"))
+    d = str(tmp_path / "ck")
+    save_sharded({"w": jax.device_put(jnp.arange(8.0), sh)}, d)
+    # forge a 2-writer manifest: the merge "lost" rank 1's pieces
+    mpath = os.path.join(d, "sharded_manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["num_processes"] = 2
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="missing piece files"):
+        load_sharded(d, {"w": jax.device_put(jnp.zeros(8), sh)})
+
+
+# ----------------------------------------------------------------------
+# reshard-on-restore, N writers -> M readers (real multi-process saves)
+# ----------------------------------------------------------------------
+_SAVE_2PROC = r"""
+import sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+rank, port, dir_ = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=rank)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, sys.argv[4])
+from ray_tpu.parallel import MeshSpec
+from ray_tpu.train.sharded_checkpoint import save_sharded
+
+mesh = MeshSpec(dp=1, fsdp=4).build(jax.devices())  # 2 procs x 2 devs
+ref = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+w = jax.make_array_from_callback(
+    ref.shape, NamedSharding(mesh, P("fsdp", None)), lambda idx: ref[idx]
+)
+b = jax.make_array_from_callback(
+    (8,), NamedSharding(mesh, P()), lambda idx: np.arange(8.0,
+                                                          dtype=np.float32)[idx]
+)
+save_sharded({"w": w, "b": b, "step": 7}, dir_)
+"""
+
+_LOAD_2PROC = r"""
+import sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+rank, port, dir_ = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=rank)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, sys.argv[4])
+from ray_tpu.parallel import MeshSpec
+from ray_tpu.train.sharded_checkpoint import load_sharded
+
+mesh = MeshSpec(dp=2, fsdp=2).build(jax.devices())
+target = {
+    "w": jax.device_put(jnp.zeros((16, 8)),
+                        NamedSharding(mesh, P(("dp", "fsdp"), None))),
+    "b": jax.device_put(jnp.zeros(8), NamedSharding(mesh, P("fsdp"))),
+    "step": 0,
+}
+out = load_sharded(dir_, target)
+assert int(out["step"]) == 7, out["step"]
+ref = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+full = multihost_utils.process_allgather(out["w"], tiled=True)
+np.testing.assert_array_equal(np.asarray(full), ref)
+fullb = multihost_utils.process_allgather(out["b"], tiled=True)
+np.testing.assert_array_equal(np.asarray(fullb), np.arange(8.0))
+"""
+
+
+def _run_pair(script, dir_, repo_root):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(rank), str(port), dir_,
+             repo_root],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        assert p.returncode == 0, out
+    return outs
+
+
+def test_reshard_two_writers_one_reader(multiproc_cpu, tmp_path):
+    """save_sharded at N=2 processes -> load_sharded at M=1 with a
+    different layout: bit-identical assembled arrays."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train.sharded_checkpoint import load_sharded
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path / "ck2to1")
+    _run_pair(_SAVE_2PROC, d, repo_root)
+    assert os.path.exists(os.path.join(d, "pieces_r00000.json"))
+    assert os.path.exists(os.path.join(d, "pieces_r00001.json"))
+
+    mesh = MeshSpec(dp=2, fsdp=2).build(jax.devices()[:4])
+    target = {
+        "w": jax.device_put(jnp.zeros((16, 8)),
+                            NamedSharding(mesh, P("fsdp", None))),
+        "b": jax.device_put(jnp.zeros(8), NamedSharding(mesh, P())),
+        "step": 0,
+    }
+    out = load_sharded(d, target)
+    ref = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    np.testing.assert_array_equal(np.asarray(out["w"]), ref)
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.arange(8.0))
+    assert int(out["step"]) == 7
+
+
+def test_reshard_one_writer_two_readers(multiproc_cpu, tmp_path):
+    """save_sharded at N=1 process -> load_sharded at M=2 processes
+    spanning a global gloo mesh: every reader assembles the identical
+    global array."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train.sharded_checkpoint import save_sharded
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mesh = MeshSpec(dp=2, fsdp=2).build(jax.devices()[:4])
+    ref = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    d = str(tmp_path / "ck1to2")
+    save_sharded({
+        "w": jax.device_put(jnp.asarray(ref),
+                            NamedSharding(mesh, P(("dp", "fsdp"), None))),
+        "b": jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P())),
+        "step": 7,
+    }, d)
+    _run_pair(_LOAD_2PROC, d, repo_root)
+
+
+# ----------------------------------------------------------------------
+# detection: health monitor signals
+# ----------------------------------------------------------------------
+def test_monitor_detects_sigkilled_rank(rt_start):
+    wg = WorkerGroup(num_workers=2)
+    try:
+        lost_events = []
+        wg.start_monitor(lambda rank, cause: lost_events.append((rank, cause)))
+        infos = [wg.execute_single(i, os.getpid) for i in range(2)]
+        os.kill(infos[1], signal.SIGKILL)
+        _wait_for(lambda: 1 in wg.lost_ranks(), 15.0,
+                  "SIGKILLed rank marked lost")
+        assert lost_events and lost_events[0][0] == 1
+        assert 0 not in wg.lost_ranks()
+    finally:
+        wg.shutdown()
+
+
+def test_monitor_breaker_trip_marks_rank_lost(rt_start):
+    """A tripped circuit breaker (black-holed peer, never cleanly died)
+    marks the rank lost through the rpc health-subscription hook."""
+    from ray_tpu.core import rpc
+
+    wg = WorkerGroup(num_workers=2)
+    try:
+        wg.execute(os.getpid)  # force actor address registration
+        wg.start_monitor(lambda rank, cause: None)
+        addrs = wg._worker_addresses()
+        assert set(addrs) == {0, 1}
+        node_id, worker_id = addrs[0]
+        br = rpc.breaker_for(f"actor:{node_id}:{worker_id}")
+        for _ in range(br.failure_threshold):
+            br.record_failure()
+        _wait_for(lambda: 0 in wg.lost_ranks(), 5.0,
+                  "breaker-open rank marked lost")
+        assert "breaker" in wg.lost_ranks()[0]
+        assert 1 not in wg.lost_ranks()
+    finally:
+        wg.shutdown()
+        rpc.reset_breakers()
+
+
+# ----------------------------------------------------------------------
+# WorkerGroup finish/shutdown hardening
+# ----------------------------------------------------------------------
+def test_finish_surfaces_first_worker_exception(rt_start):
+    wg = WorkerGroup(num_workers=2)
+    try:
+
+        def boom(config):
+            raise RuntimeError("loop exploded")
+
+        from ray_tpu.train.session import TrainContext
+
+        for rank, w in enumerate(wg.workers):
+            rt.get(w.start_training.remote(
+                boom, {}, TrainContext(world_size=2, world_rank=rank), None
+            ))
+        time.sleep(0.5)
+        with pytest.raises(rt.exceptions.RayTpuError,
+                           match="loop exploded"):
+            wg.finish(timeout_s=10.0)
+        # non-raising form reports per-rank statuses instead
+        statuses = wg.finish(timeout_s=10.0, raise_on_error=False)
+        assert all("loop exploded" in s["error"] for s in statuses)
+    finally:
+        wg.shutdown()
+
+
+def test_finish_bounded_join_with_wedged_loop(rt_start):
+    """A loop that never reaches a step barrier cannot stall finish
+    beyond its bound; request_stop is propagated to every rank BEFORE
+    any join, so responsive ranks unwind in parallel with the wedged
+    one."""
+    wg = WorkerGroup(num_workers=2)
+    try:
+
+        def loop(config):
+            ctx = train.get_context()
+            if ctx.get_world_rank() == 0:
+                time.sleep(60)  # wedged: never reports
+            else:
+                for _ in range(1000):
+                    train.report({"x": 1})
+
+        from ray_tpu.train.session import TrainContext
+
+        for rank, w in enumerate(wg.workers):
+            rt.get(w.start_training.remote(
+                loop, {}, TrainContext(world_size=2, world_rank=rank), None
+            ))
+        t0 = time.monotonic()
+        statuses = wg.finish(timeout_s=3.0, raise_on_error=False)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0, f"finish not bounded: {elapsed:.1f}s"
+        assert statuses[0]["clean"] is False  # wedged rank: bounded join
+        assert statuses[1]["clean"] is True   # stopped at its barrier
+    finally:
+        wg.shutdown()
+
+
+# ----------------------------------------------------------------------
+# elastic recovery, single node (capacity returns instantly)
+# ----------------------------------------------------------------------
+def _py_elastic_loop(config):
+    ctx = train.get_context()
+    ck = train.get_checkpoint()
+    start = ck.to_dict()["step"] + 1 if ck is not None else 0
+    for step in range(start, config["num_steps"]):
+        if (ck is None and step == config["kill_at"]
+                and ctx.get_world_rank() == 1):
+            os.kill(os.getpid(), signal.SIGKILL)
+        c = (Checkpoint.from_dict({"step": step})
+             if ctx.get_world_rank() == 0 else None)
+        train.report({"step": step, "world": ctx.get_world_size()},
+                     checkpoint=c)
+
+
+def test_elastic_sigkill_recovers_without_consuming_failure_budget(
+    rt_start, tmp_path
+):
+    """SIGKILL of rank 1 mid-run with max_failures=0: the elastic path
+    re-forms the group (full width — the pool respawns the worker) and
+    resumes from the latest atomic checkpoint at the same step."""
+    trainer = JaxTrainer(
+        _py_elastic_loop,
+        train_loop_config={"num_steps": 6, "kill_at": 3},
+        jax_config=JaxConfig(distributed_mode="none"),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="elastic_one_node",
+            failure_config=FailureConfig(
+                elastic=True, min_workers=1, detect_poll_s=0.25,
+                drain_timeout_s=3.0, reform_timeout_s=5.0,
+            ),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
+    kinds = [e["kind"] for e in trainer._elastic_events]
+    assert "shrink" in kinds and "reform" in kinds
+    shrink = next(e for e in trainer._elastic_events if e["kind"] == "shrink")
+    assert 1 in shrink["lost_ranks"]
+    # resumed exactly at the checkpointed step: steps are a contiguous
+    # sequence with the kill invisible in the metric stream
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps == sorted(steps)
+    assert steps[-1] == 5 and 2 in steps and 3 in steps
+
+
+# ----------------------------------------------------------------------
+# flagship chaos test: host preemption -> shrink -> reshard -> re-grow
+# ----------------------------------------------------------------------
+def _elastic_gpt2_loop(config):
+    """Tiny GPT-2 under jax_distributed (gloo) with sharded
+    checkpointing every step; batch is FIXED so the loss trajectory
+    depends only on (params, step), never on world size."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train as rtrain
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import (
+        MeshSpec,
+        data_sharding,
+        optimizer_shardings,
+        tree_shardings,
+    )
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.sharded_checkpoint import load_sharded, save_sharded
+
+    ctx = rtrain.get_context()
+    rank = ctx.get_world_rank()
+    # deterministic chaos markers: the driver picks its victim by the
+    # host (ppid == the node daemon) carrying the rank
+    with open(os.path.join(
+        config["marker_dir"], f"rank{rank}_pid{os.getpid()}.json"
+    ), "w") as f:
+        json.dump({"rank": rank, "pid": os.getpid(),
+                   "ppid": os.getppid(),
+                   "world": ctx.get_world_size()}, f)
+
+    n = jax.device_count()
+    mesh = MeshSpec(dp=1, fsdp=n).build(jax.devices())
+    cfg = gpt2.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+    )
+    param_sh = tree_shardings(mesh, gpt2.logical_axes(cfg))
+    params = jax.jit(
+        lambda: gpt2.init_params(cfg, jax.random.PRNGKey(0)),
+        out_shardings=param_sh,
+    )()
+    opt = gpt2.default_optimizer(lr=1e-3, warmup_steps=1, total_steps=32)
+    opt_sh = optimizer_shardings(mesh, opt, params, param_sh)
+    opt_state = jax.jit(opt.init, out_shardings=opt_sh)(params)
+
+    @jax.jit
+    def global_norm(tree):
+        return jnp.sqrt(sum(
+            jnp.sum(x.astype(jnp.float32) ** 2)
+            for x in jax.tree.leaves(tree)
+        ))
+
+    start_step = 0
+    resume = rtrain.get_checkpoint()
+    if resume is not None:
+        with resume.as_directory() as d:
+            state = load_sharded(
+                d, {"params": params, "opt_state": opt_state, "step": 0,
+                    "pnorm": 0.0},
+            )
+        params, opt_state = state["params"], state["opt_state"]
+        start_step = int(state["step"])
+        # reshard-on-restore correctness: the params norm computed
+        # under the OLD layout must survive re-laying onto this mesh
+        restored = float(global_norm(params))
+        assert abs(restored - state["pnorm"]) < 1e-3 * abs(state["pnorm"]), (
+            restored, state["pnorm"]
+        )
+
+    step_fn = gpt2.make_train_step(cfg, opt, mesh)
+    with mesh:
+        jstep = jax.jit(step_fn)
+
+    batch, seq = 4, 16
+    rng = np.random.default_rng(7)  # seeded: every attempt, same data
+    tokens_host = rng.integers(
+        0, cfg.vocab_size, size=(batch, seq + 1)
+    ).astype(np.int32)
+
+    def put(b):
+        return jax.make_array_from_callback(
+            b.shape, data_sharding(mesh), lambda idx: b[idx]
+        )
+
+    for step in range(start_step, config["num_steps"]):
+        time.sleep(config.get("step_sleep_s", 0.0))
+        params, opt_state, metrics = jstep(params, opt_state,
+                                           put(tokens_host))
+        d = tempfile.mkdtemp(prefix="rt_elastic_ck_")
+        save_sharded(
+            {"params": params, "opt_state": opt_state, "step": step + 1,
+             "pnorm": float(global_norm(params))},
+            d,
+        )
+        ck = Checkpoint(d)
+        ck._temp_source = True
+        rtrain.report(
+            {"loss": float(metrics["loss"]), "step": step + 1,
+             "world": ctx.get_world_size(), "global_devices": n,
+             "process_count": jax.process_count()},
+            checkpoint=ck,
+        )
+
+
+def test_host_preemption_shrink_reshard_resume_regrow(
+    multiproc_cpu, tmp_path
+):
+    """The acceptance scenario end to end: SIGKILL one training rank
+    AND its host daemon mid-step.  Without restarting fit(): the loss
+    is detected through the health plane, the group re-forms on the
+    surviving host with a SMALLER global mesh, restores the latest
+    atomic checkpoint (2-writer pieces resharded onto the 1-process
+    layout) at the same global step, and — when a replacement node
+    joins — re-grows to full width and finishes.  The post-shrink loss
+    trajectory must match a never-killed run restored from the same
+    checkpoint."""
+    from ray_tpu.cluster_utils import Cluster
+
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    num_steps = 12
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1, "num_workers": 1})
+    node_b = c.add_node(num_cpus=1, num_workers=1)
+    c.connect()
+    c.wait_for_nodes()
+    try:
+        history = []
+        trainer = JaxTrainer(
+            _elastic_gpt2_loop,
+            train_loop_config={
+                "num_steps": num_steps, "marker_dir": marker_dir,
+                "step_sleep_s": 0.4,
+            },
+            jax_config=JaxConfig(
+                distributed_mode="jax_distributed", env_vars=_WORKER_ENV
+            ),
+            scaling_config=ScalingConfig(
+                num_workers=2, placement_strategy="SPREAD"
+            ),
+            run_config=RunConfig(
+                storage_path=str(tmp_path), name="elastic_preemption",
+                failure_config=FailureConfig(
+                    elastic=True, min_workers=1, detect_poll_s=0.25,
+                    drain_timeout_s=4.0, reform_timeout_s=3.0,
+                    regrow_interval_s=1.0,
+                ),
+            ),
+        )
+        trainer._result_callback = (
+            lambda m, ck: history.append(dict(m))
+        )
+        box = {}
+
+        def run():
+            box["result"] = trainer.fit()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        # let two full checkpoints commit before preempting
+        _wait_for(lambda: len(history) >= 2, 180.0, "2 iterations")
+        victim = None
+        for fn in os.listdir(marker_dir):
+            with open(os.path.join(marker_dir, fn)) as f:
+                info = json.load(f)
+            if info["ppid"] == node_b.proc.pid:
+                victim = info
+        assert victim is not None, "no rank found on the victim host"
+        os.kill(victim["pid"], signal.SIGKILL)  # the rank, mid-step
+        c.remove_node(node_b, graceful=False)   # ... and its host
+
+        # shrunk-phase steps must flow before the replacement appears,
+        # so the width-1 resume is actually exercised
+        _wait_for(lambda: any(m.get("world") == 1 for m in history),
+                  180.0, "post-shrink step on the smaller mesh")
+        c.add_node(num_cpus=1, num_workers=1)  # replacement host joins
+        _wait_for(lambda: not t.is_alive(), 240.0, "fit completion")
+        t.join()
+        result = box["result"]
+
+        assert result.error is None, result.error
+        # finished at FULL width on the re-grown group
+        assert result.metrics["step"] == num_steps
+        assert result.metrics["world"] == 2
+        assert result.metrics["process_count"] == 2
+        assert result.metrics["global_devices"] == 4
+
+        # lifecycle: shrink -> reform(1) -> regrow -> reform(2)
+        kinds = [e["kind"] for e in trainer._elastic_events]
+        assert kinds.count("shrink") == 1, trainer._elastic_events
+        assert "regrow" in kinds, trainer._elastic_events
+        widths = [e["width"] for e in trainer._elastic_events
+                  if e["kind"] == "reform"]
+        assert widths[0] == 1 and widths[-1] == 2, trainer._elastic_events
+        shrink = next(e for e in trainer._elastic_events
+                      if e["kind"] == "shrink")
+        assert shrink["lost_ranks"], shrink
+
+        # step continuity: every resume landed exactly at the
+        # checkpointed step — the metric stream is gapless and
+        # duplicate-free across both membership changes
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps == list(range(1, num_steps + 1)), steps
+        shrunk = {m["step"]: m["loss"] for m in result.metrics_history
+                  if m["world"] == 1}
+        assert shrunk, "no steps ran on the shrunk mesh"
+        # the shrunk phase ran on the smaller global mesh
+        shrunk_devices = {m["global_devices"]
+                          for m in result.metrics_history
+                          if m["world"] == 1}
+        assert shrunk_devices == {2}
+
+        # loss continuity: a never-killed run restored from the SAME
+        # atomic checkpoint (the one the shrink resumed from) must
+        # produce the same losses over the shrunk segment
+        first_shrunk = min(shrunk)
+        resume_dir = os.path.join(
+            result.path, f"checkpoint_{first_shrunk - 1:06d}"
+        )
+        ok, why = validate_checkpoint(resume_dir)
+        assert ok, why
+        control = JaxTrainer(
+            _elastic_gpt2_loop,
+            train_loop_config={
+                "num_steps": max(shrunk), "marker_dir": marker_dir,
+            },
+            jax_config=JaxConfig(
+                distributed_mode="jax_distributed", env_vars=_WORKER_ENV
+            ),
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                storage_path=str(tmp_path), name="elastic_control",
+            ),
+            resume_from_checkpoint=Checkpoint(resume_dir),
+        ).fit()
+        assert control.error is None, control.error
+        control_losses = {m["step"]: m["loss"]
+                          for m in control.metrics_history}
+        for step, loss in shrunk.items():
+            assert control_losses[step] == pytest.approx(
+                loss, rel=1e-5
+            ), (step, loss, control_losses[step])
+    finally:
+        c.shutdown()
