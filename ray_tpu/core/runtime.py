@@ -111,6 +111,20 @@ _ambient_deadline: contextvars.ContextVar = contextvars.ContextVar(
     "rt_ambient_deadline", default=None
 )
 
+
+def remaining_deadline_s():
+    """The executing task's remaining end-to-end budget in seconds, or
+    None when no deadline is in force.  Read-only view of the ambient
+    deadline for code that wants to PROPAGATE the budget into a
+    non-task queue (e.g. the serve LLM engine's admission queue, so
+    queued requests can be shed once their caller must have given up)
+    rather than spawn nested tasks."""
+    deadline = _ambient_deadline.get()
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
+
+
 def _wake_nudge():
     """No-op callback: waking the selector is the entire point."""
 

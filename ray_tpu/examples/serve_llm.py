@@ -261,6 +261,7 @@ class ContinuousLlamaService:
                  seed: int = 0, slots: int = 32, chunk: int = 8,
                  max_len: Optional[int] = None, block_size: int = 16,
                  kv_blocks: Optional[int] = None, prefix_cache: bool = True,
+                 max_queued: Optional[int] = None,
                  jax_platform: Optional[str] = None):
         import jax
 
@@ -270,10 +271,14 @@ class ContinuousLlamaService:
         from ray_tpu.serve.llm_engine import LlamaEngine
 
         cfg, params = _build_model(model_size, seed)
+        # max_queued mirrors the deployment's max_queued_requests at
+        # the ENGINE queue (the replica callable can't see its
+        # DeploymentConfig): overflow submissions fail immediately
+        # with BackPressureError -> HTTP 503 + Retry-After
         self.engine = LlamaEngine(
             cfg, params, slots=slots, chunk=chunk, max_len=max_len,
             block_size=block_size, kv_blocks=kv_blocks,
-            prefix_cache=prefix_cache,
+            prefix_cache=prefix_cache, max_queued=max_queued,
         )
         self.max_new_tokens = max_new_tokens
         self.max_new_tokens_limit = max_new_tokens
@@ -281,11 +286,20 @@ class ContinuousLlamaService:
     async def generate(self, token_lists, max_new_tokens=None):
         import asyncio
 
+        from ray_tpu.core.runtime import remaining_deadline_s
+
         n_new = (max_new_tokens if max_new_tokens is not None
                  else self.max_new_tokens)
         n_new = max(1, min(int(n_new), self.max_new_tokens_limit))
+        # the caller's end-to-end budget (handle.options(timeout_s=...)
+        # propagated into this task gRPC-style) rides into the engine
+        # queue, so a request that cannot decode its first token before
+        # the caller gives up is SHED before it burns a prefill
+        budget = remaining_deadline_s()
         futs = [
-            asyncio.wrap_future(self.engine.submit(list(t), n_new))
+            asyncio.wrap_future(
+                self.engine.submit(list(t), n_new, timeout_s=budget)
+            )
             for t in token_lists
         ]
         return list(await asyncio.gather(*futs))
@@ -309,6 +323,17 @@ class ContinuousLlamaService:
         body with LlamaService."""
         return _bench_generate(self.engine.cfg, self.engine.params,
                                batch, prompt_len, max_new_tokens, iters)
+
+    def __serve_drain__(self):
+        """Graceful scale-down hook (called by the replica once the
+        controller has removed it from routing tables): stop admitting
+        new requests while live sequences decode to completion."""
+        self.engine.begin_drain()
+
+    def __serve_shutdown__(self):
+        """Post-drain hook: release the KV block pool deterministically
+        instead of relying on actor-kill teardown."""
+        self.engine.shutdown()
 
     def __del__(self):
         try:

@@ -92,6 +92,56 @@ class DeadlineExceededError(GetTimeoutError):
     `except GetTimeoutError` call sites keep working."""
 
 
+class BackPressureError(RayTpuError):
+    """The target's admission queue is full: the request was rejected
+    IMMEDIATELY instead of queueing unboundedly (reference analog:
+    serve's max_queued_requests rejection).  Carries `retry_after_s`,
+    a hint for when capacity is expected to free — the HTTP proxy
+    translates it to `503` + a `Retry-After` header, the gRPC proxy to
+    `RESOURCE_EXHAUSTED` with `retry-after` trailing metadata.
+
+    The hint is ALSO embedded in the message text: a rejection raised
+    inside a replica crosses the wire as a `TaskError` (which keeps
+    only the message + cause type), and `backpressure_retry_after`
+    recovers the hint from either shape."""
+
+    def __init__(self, message: str = "admission queue is full",
+                 retry_after_s: float = 1.0):
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        super().__init__(
+            f"{message} [retry_after_s={self.retry_after_s:.3f}]"
+        )
+
+
+def backpressure_retry_after(err: BaseException):
+    """The retry-after hint (seconds) if `err` is — or wraps, as a
+    remote `TaskError` — a `BackPressureError`; None otherwise.  The
+    single overload-classification chokepoint for the HTTP/gRPC
+    proxies and any caller-side retry logic."""
+    import re
+
+    if isinstance(err, BackPressureError):
+        return err.retry_after_s
+    if (isinstance(err, TaskError)
+            and err.cause_type == "BackPressureError"):
+        m = re.search(r"\[retry_after_s=([0-9.]+)\]", str(err))
+        try:
+            return float(m.group(1)) if m else 1.0
+        except ValueError:
+            return 1.0
+    return None
+
+
+def is_deadline_expiry(err: BaseException) -> bool:
+    """True for a deadline expiry in either shape: the typed
+    `DeadlineExceededError` (router/owner-side) or its remote
+    `TaskError` wrapping (a replica-side shed crossing the wire)."""
+    if isinstance(err, DeadlineExceededError):
+        return True
+    return (isinstance(err, TaskError)
+            and err.cause_type == "DeadlineExceededError")
+
+
 class NodeDiedError(RayTpuError):
     """The node hosting the computation died."""
 

@@ -741,6 +741,128 @@ def measure_engine_trace(*, requests: int = 24, n_new: int = 8,
     return out
 
 
+def measure_overload(*, overflow: int = 12, seed: int = 0
+                     ) -> Dict[str, Dict[str, float]]:
+    """Overload-plane acceptance rows on the CPU tiny engine (admission
+    control + deadline shedding, no serve stack in the way):
+
+    - `overload_storm`: one bounded-queue engine (4 slots, queue cap
+      8) saturated with long decodes, then hit with an expired-budget
+      wave (must SHED before prefill) and an overflow wave (must be
+      REJECTED with a retry-after hint).  Accounting is exact:
+      offered == admitted + rejected + shed, the queue never exceeds
+      its cap, and the block pool returns to its pre-storm free count.
+    - `overload_ttft`: closed-loop 2x overload (2*slots in flight,
+      n_new=1 so completion == first token): TTFT p50/p99 under
+      sustained queueing.
+    """
+    import jax
+
+    from ray_tpu import exceptions as exc
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_engine import LlamaEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    slots, queue_cap, bs = 4, 8, 8
+    out: Dict[str, Dict[str, float]] = {}
+
+    def _prompt():
+        return [int(x) for x in rng.integers(1, cfg.vocab_size, size=24)]
+
+    eng = LlamaEngine(cfg, params, slots=slots, chunk=4, block_size=bs,
+                      max_len=48, prefix_cache=False,
+                      max_queued=queue_cap)
+    try:
+        # warm both compiled families (prefill bucket, chunk width)
+        for f in [eng.submit(_prompt(), 8) for _ in range(slots)]:
+            f.result(timeout=600)
+        base = eng.stats()
+        free0 = base["blocks_free"]
+        t0 = time.perf_counter()
+        # phase 1 — saturate every slot with a LONG decode (>= 6 chunk
+        # dispatches), so nothing else can be admitted until they end
+        long_futs = [eng.submit(_prompt(), 20) for _ in range(slots)]
+        deadline = time.monotonic() + 60
+        while eng.stats()["free_slots"] > 0:
+            if time.monotonic() > deadline:
+                raise RuntimeError("engine never saturated")
+            time.sleep(0.001)
+        # phase 2 — a wave with a ~zero budget: it QUEUES (the cap has
+        # room) but every slot is busy for many chunk walls, so by pop
+        # time the deadline is long past -> shed before prefill
+        shed_futs = [eng.submit(_prompt(), 8, timeout_s=0.001)
+                     for _ in range(6)]
+        # phase 3 — overflow: more work than the queue cap can hold
+        over_futs = [eng.submit(_prompt(), 8) for _ in range(overflow)]
+        queue_peak = 0.0
+        waves = long_futs + shed_futs + over_futs
+        while not all(f.done() for f in waves):
+            queue_peak = max(queue_peak, eng.stats()["queued"])
+            time.sleep(0.002)
+        wall = time.perf_counter() - t0
+        admitted = rejected = shed = 0
+        admitted_tokens = 0
+        for f in waves:
+            try:
+                admitted_tokens += len(f.result(timeout=60))
+                admitted += 1
+            except exc.BackPressureError as e:
+                assert e.retry_after_s > 0
+                rejected += 1
+            except exc.DeadlineExceededError:
+                shed += 1
+        s = eng.stats()
+        out["overload_storm"] = {
+            "offered": float(len(waves)),
+            "admitted": float(admitted),
+            "rejected": float(rejected),
+            "shed": float(shed),
+            "shed_expired": s["shed_expired"] - base["shed_expired"],
+            "shed_predicted": (s["shed_predicted"]
+                               - base["shed_predicted"]),
+            "queue_cap": float(queue_cap),
+            "queue_peak": queue_peak,
+            "blocks_free_delta": float(s["blocks_free"] - free0),
+            "prefill_calls": s["prefill_calls"] - base["prefill_calls"],
+            "wall_s": round(wall, 3),
+            "admitted_tok_s": round(admitted_tokens / wall, 1),
+        }
+        print("overload[storm]: " + ", ".join(
+            f"{k}={v}" for k, v in out["overload_storm"].items()),
+            flush=True)
+
+        # -- TTFT under sustained 2x overload -------------------------
+        target, conc = 32, 2 * slots
+        lat: List[float] = []
+        inflight: List[tuple] = []
+        submitted = 0
+        t0 = time.perf_counter()
+        while len(lat) < target:
+            while submitted < target and len(inflight) < conc:
+                inflight.append((time.perf_counter(),
+                                 eng.submit(_prompt(), 1)))
+                submitted += 1
+            t_s, f = inflight.pop(0)
+            f.result(timeout=600)
+            lat.append(time.perf_counter() - t_s)
+        wall = time.perf_counter() - t0
+        out["overload_ttft"] = {
+            "requests": float(target),
+            "concurrency": float(conc),
+            "ttft_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "ttft_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            "tok_s": round(target / wall, 1),
+        }
+        print("overload[ttft]: " + ", ".join(
+            f"{k}={v}" for k, v in out["overload_ttft"].items()),
+            flush=True)
+    finally:
+        eng.shutdown()
+    return out
+
+
 def _elastic_mttr_loop(config):
     """Per-worker loop for `--elastic-recovery`: pure control-plane
     (no jax) so the measured MTTR is detection + re-form + restore,
@@ -880,6 +1002,12 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "prefix reuse, CB smoke (CPU tiny model; no "
                         "cluster)")
     p.add_argument("--engine-requests", type=int, default=24)
+    p.add_argument("--overload", action="store_true",
+                   help="overload-plane rows (no cluster): bounded-"
+                        "queue storm accounting (offered vs admitted "
+                        "vs rejected vs shed, block-pool leak check) "
+                        "and TTFT p50/p99 under 2x overload")
+    p.add_argument("--overload-overflow", type=int, default=12)
     p.add_argument("--elastic-recovery", action="store_true",
                    help="measure elastic-training MTTR: SIGKILL one "
                         "rank mid-step, report kill->detect and "
@@ -908,9 +1036,17 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
 
     faulthandler.register(signal.SIGUSR1)
 
-    if args.engine_trace:
+    if args.engine_trace or args.overload:
         # no cluster: the engine is driven in-process on the CPU backend
-        results = measure_engine_trace(requests=args.engine_requests)
+        results = {}
+        if args.engine_trace:
+            results.update(measure_engine_trace(
+                requests=args.engine_requests
+            ))
+        if args.overload:
+            results.update(measure_overload(
+                overflow=args.overload_overflow
+            ))
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(results, f, indent=2)

@@ -1,0 +1,165 @@
+"""SLO-driven serve autoscaling policy.
+
+Reference: `python/ray/serve/autoscaling_policy.py` +
+`_private/autoscaling_state.py` — but where the reference scales on
+handle-reported ongoing-request counts, this policy consumes the
+ENGINE-grade signals the stats() piggyback already delivers to the
+controller on the health-check cadence (PR 6): per-replica queue
+depth, TTFT EMA, and shed/rejection counters.  That makes the scaling
+loop close over the metric users actually experience (time to first
+token) instead of a proxy for it, and lets an overloaded system that
+is actively REFUSING work scale out even when its smoothed latency
+EMAs still look acceptable.
+
+The controller owns the cadence and the cooldowns; this module owns
+the decision:
+
+    pressure(metrics)          -> instantaneous load ratio r
+    desired_replicas(avg_r, n) -> target replica count
+
+`r` is normalized so 1.0 means "exactly at SLO": the controller
+smooths it over `look_back_period_s` and applies
+`upscale_delay_s`/`downscale_delay_s` exactly as for the legacy
+ongoing-requests policy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+from ray_tpu.serve.config import AutoscalingConfig
+
+# how much headroom above the hysteresis band a shed/rejection burst
+# asserts: refusing work is the strongest possible "under-provisioned"
+# signal, so it must clear the dead band whatever the EMAs say
+_SHED_PRESSURE_MARGIN = 0.01
+
+
+def replica_depth(m: Dict[str, Any]) -> float:
+    """Backlog signal for one replica's metrics dict: the engine's
+    reported queue depth when the deployment exposes stats(), else the
+    plain in-flight count.  THE definition of per-replica backlog —
+    the controller's routing tables, the status panel, and the SLO
+    policy all call this one helper, so queue-depth routing and
+    autoscaling pressure can never silently diverge on what "backlog"
+    means."""
+    try:
+        return float(m.get("engine_queue_depth",
+                           m.get("ongoing", 0) or 0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+class AutoscalingPolicy:
+    """SLO policy state for ONE deployment (held by its
+    `_DeploymentState`): tracks per-replica shed counters across ticks
+    so a *rate* (new sheds since the last decision) is observable from
+    the monotonic totals the engine exports."""
+
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        # replica_id -> last seen (shed_total + rejections) totals;
+        # replicas that restart reset their counters, so deltas are
+        # clamped at zero rather than trusted to be monotonic
+        self._last_refused: Dict[str, float] = {}
+        # True when the LAST pressure() reading was forced above the
+        # band by fresh refusals: the controller lets that reading
+        # bypass its look-back smoothing (a one-tick burst of 503s
+        # averaged into a quiet window would otherwise dilute below
+        # the band and never scale — see _autoscale_slo)
+        self.refusal_forced = False
+
+    # -- signals -------------------------------------------------------
+    def _refused_delta(self, metrics: List[Dict[str, Any]]) -> float:
+        """New sheds + rejections since the previous pressure() call,
+        summed across replicas (engine shed/rejected counters plus the
+        replica-level max_ongoing rejections)."""
+        total_delta = 0.0
+        seen = {}
+        for m in metrics:
+            rid = str(m.get("replica_id", ""))
+            us = m.get("user_stats") or {}
+            refused = 0.0
+            for src, key in ((us, "shed_total"), (us, "rejected_total"),
+                             (m, "rejected")):
+                try:
+                    refused += float(src.get(key, 0) or 0)
+                except (TypeError, ValueError):
+                    pass
+            seen[rid] = refused
+            total_delta += max(0.0, refused - self._last_refused.get(rid, 0.0))
+        # dropped replicas leave the map with their counters; a fresh
+        # replica reusing the id starts over (delta clamped at 0)
+        self._last_refused = seen
+        return total_delta
+
+    def pressure(self, metrics: List[Dict[str, Any]]) -> float:
+        """Instantaneous load ratio for the deployment: the max over
+        configured SLOs of observed/target.
+
+        - TTFT: the WORST replica's `ttft_ema_s` (a p99-flavored
+          reading — one replica missing the SLO means real users
+          missing it, however good the mean looks);
+        - queue depth: the MEAN per-replica backlog (depth is additive
+          across replicas, so the mean is what scaling actually
+          changes);
+        - sheds/rejections since the last tick force the ratio above
+          the hysteresis band: a system refusing work is
+          under-provisioned by definition.
+
+        IDLE OVERRIDE: with zero backlog and zero in-flight work the
+        ratio is 0.0 regardless of the TTFT EMA — the EMA is lifetime-
+        smoothed and never decays once traffic stops, and without this
+        a deployment that was once slow could never scale back down."""
+        cfg = self.config
+        depths = [replica_depth(m) for m in metrics]
+        ongoing = 0.0
+        for m in metrics:
+            try:
+                ongoing += float(m.get("ongoing", 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        refused = self._refused_delta(metrics)
+        self.refusal_forced = refused > 0.0
+        if not metrics or (sum(depths) == 0.0 and ongoing == 0.0
+                           and refused == 0.0):
+            return 0.0
+        r = 0.0
+        if cfg.target_queue_depth is not None and depths:
+            mean_depth = sum(depths) / len(depths)
+            r = max(r, mean_depth / max(cfg.target_queue_depth, 1e-9))
+        if cfg.target_ttft_s is not None:
+            worst = 0.0
+            for m in metrics:
+                us = m.get("user_stats") or {}
+                try:
+                    worst = max(worst, float(us.get("ttft_ema_s", 0) or 0))
+                except (TypeError, ValueError):
+                    pass
+            r = max(r, worst / max(cfg.target_ttft_s, 1e-9))
+        if refused > 0.0:
+            r = max(r, 1.0 + cfg.hysteresis + _SHED_PRESSURE_MARGIN)
+        return r
+
+    # -- decision ------------------------------------------------------
+    def desired_replicas(self, avg_ratio: float, current: int) -> int:
+        """Target replica count from the smoothed load ratio.
+
+        Inside the hysteresis band [1-h, 1+h] the target holds (the
+        cooldown clocks in the controller handle *time*; the band
+        handles *amplitude*).  Above it, scale proportionally — capped
+        at doubling per decision, so one noisy reading can't fork a
+        fleet.  Below it, scale to the smallest count that would still
+        sit under the band's ceiling, so the post-shrink ratio does
+        not immediately re-trigger an upscale."""
+        cfg = self.config
+        current = max(1, current)
+        h = max(0.0, cfg.hysteresis)
+        if avg_ratio > 1.0 + h:
+            desired = math.ceil(current * min(avg_ratio, 2.0))
+        elif avg_ratio < 1.0 - h:
+            desired = math.ceil(current * avg_ratio / max(1.0 - h, 1e-9))
+        else:
+            desired = current
+        return max(cfg.min_replicas, min(cfg.max_replicas, max(desired, 0)))

@@ -13,23 +13,45 @@ import asyncio
 import functools
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.exceptions import BackPressureError
+
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
                  batch_wait_timeout_s: float,
-                 bucket_fill_timeout_s: Optional[float] = None):
+                 bucket_fill_timeout_s: Optional[float] = None,
+                 max_queued_requests: int = -1):
         self._fn = fn
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._bucket_wait = bucket_fill_timeout_s
+        self._max_queued = max_queued_requests
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        self._executing = False  # a batch is inside the user function
 
     def _ensure_loop(self):
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def submit(self, item: Any) -> Any:
+        if (self._max_queued >= 0 and self._executing
+                and self._queue.qsize() >= self._max_queued):
+            # bounded like every other admission queue: a stalled (or
+            # merely slow) batched function must surface as immediate
+            # typed backpressure, not as an unbounded pending list
+            # whose callers all eventually time out.  The cap applies
+            # only while a batch is EXECUTING downstream — matching
+            # the engine's max_queued semantics, where work that the
+            # consumer will pick up immediately is not really waiting
+            # (so max_queued=0 means "serve while the downstream keeps
+            # up, never queue behind it", not "reject everything").
+            # Hint: one batch wait — the soonest a batch can drain.
+            raise BackPressureError(
+                f"batch queue full (max_queued_requests="
+                f"{self._max_queued})",
+                retry_after_s=max(0.05, self._wait),
+            )
         fut = asyncio.get_running_loop().create_future()
         self._queue.put_nowait((item, fut))
         self._ensure_loop()
@@ -77,6 +99,7 @@ class _BatchQueue:
             batch = await self._gather_batch()
             items = [b[0] for b in batch]
             futs = [b[1] for b in batch]
+            self._executing = True
             try:
                 results = await self._fn(items)
                 if results is None or len(results) != len(items):
@@ -100,6 +123,8 @@ class _BatchQueue:
                         )
                 if not isinstance(e, Exception):
                     raise
+            finally:
+                self._executing = False
 
 
 def batch(
@@ -108,6 +133,7 @@ def batch(
     max_batch_size: int = 10,
     batch_wait_timeout_s: float = 0.01,
     bucket_fill_timeout_s: Optional[float] = None,
+    max_queued_requests: int = -1,
 ):
     """Decorator: turn `async def f(self, item)`-shaped handlers into
     batched `f(self, items: List)` execution (reference:
@@ -121,7 +147,13 @@ def batch(
     per gather cycle (the measured max_batch=32 stall in PERF.md's
     serve sweep).  Small batches keep gathering under the normal
     batch_wait_timeout_s, where padding up is cheap and batching pays
-    the most."""
+    the most.
+
+    `max_queued_requests` (default -1 = unbounded) bounds the pending
+    list the same way the deployment-level admission cap does: the
+    overflow submit raises `BackPressureError` (translated to 503 +
+    Retry-After at the HTTP proxy) instead of queueing behind a
+    stalled downstream forever."""
 
     def _decorate(fn: Callable):
         # one queue per bound instance (methods) or per function
@@ -159,6 +191,7 @@ def batch(
                     over.get("batch_wait_timeout_s", batch_wait_timeout_s),
                     over.get("bucket_fill_timeout_s",
                              bucket_fill_timeout_s),
+                    over.get("max_queued_requests", max_queued_requests),
                 )
                 setattr(owner, attr, q)
             return await q.submit(item)

@@ -15,7 +15,24 @@ from typing import Any, Dict, Optional
 @dataclass
 class AutoscalingConfig:
     """Reference: `serve/config.py` AutoscalingConfig — replica count is
-    driven by the average number of ongoing requests per replica."""
+    driven by the average number of ongoing requests per replica.
+
+    Setting either SLO field switches the deployment to the
+    **SLO-driven policy** (`serve/autoscaling.py`): replica counts are
+    computed from the controller-collected per-replica engine signals
+    (queue depth, TTFT EMA, shed/rejection counters piggybacked on
+    health checks) instead of router-pushed in-flight counts —
+
+    - `target_ttft_s`: keep the worst replica's time-to-first-token
+      EMA at or below this;
+    - `target_queue_depth`: keep the mean per-replica backlog
+      (engine queued + active) at or below this;
+    - `hysteresis`: dead band around the SLO — the load ratio must
+      leave [1-h, 1+h] before the target moves, so jitter at the
+      boundary can't flap replicas.
+
+    `upscale_delay_s` / `downscale_delay_s` stay the scale cooldowns
+    for both policies."""
 
     min_replicas: int = 1
     max_replicas: int = 1
@@ -24,6 +41,14 @@ class AutoscalingConfig:
     downscale_delay_s: float = 2.0
     metrics_interval_s: float = 0.2
     look_back_period_s: float = 2.0
+    # SLO-driven policy (either one opts in)
+    target_ttft_s: Optional[float] = None
+    target_queue_depth: Optional[float] = None
+    hysteresis: float = 0.1
+
+    def has_slo(self) -> bool:
+        return (self.target_ttft_s is not None
+                or self.target_queue_depth is not None)
 
     def desired_replicas(self, total_ongoing: float, current: int) -> int:
         if current <= 0:

@@ -36,12 +36,37 @@ RUNNING = "RUNNING"
 
 
 def _replica_depth(r: "_ReplicaState") -> float:
-    """One queue-depth signal for routing AND the status panel: the
-    replica's engine-reported backlog when its deployment exposes
-    stats(), else its in-flight count."""
-    return float(r.metrics.get(
-        "engine_queue_depth", r.metrics.get("ongoing", 0) or 0
-    ))
+    """One queue-depth signal for routing AND the status panel —
+    delegates to the shared backlog definition in serve/autoscaling.py
+    so routing and SLO-autoscaling pressure always agree on it."""
+    from ray_tpu.serve.autoscaling import replica_depth
+
+    return replica_depth(r.metrics)
+
+
+def _overload_summary(ds: "_DeploymentState",
+                      router_rejected: float = 0.0) -> Dict[str, float]:
+    """Deployment-level overload counters for /api/serve's serve panel:
+    rejections (router assignment-queue cap — delta-folded from router
+    pushes, since those requests never reach a replica — plus replica
+    cap and engine queue cap) and deadline sheds, summed over live
+    replicas' piggybacked metrics.  Advisory — replica restarts reset
+    their counters."""
+    rejected = float(router_rejected or 0.0)
+    shed = 0.0
+    for r in ds.replicas.values():
+        m = r.metrics
+        us = m.get("user_stats") or {}
+        for src, key in ((m, "rejected"), (us, "rejected_total")):
+            try:
+                rejected += float(src.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        try:
+            shed += float(us.get("shed_total", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    return {"rejected_total": rejected, "shed_total": shed}
 
 
 class _ReplicaState:
@@ -82,8 +107,17 @@ class _DeploymentState:
         self.version = 0
         self.next_replica_idx = 0
         self.last_scale_change = 0.0
-        self.samples: list = []  # (ts, total_ongoing) autoscaler window
+        # autoscaler window: (ts, total_ongoing) for the legacy policy,
+        # (ts, load_ratio) for the SLO policy
+        self.samples: list = []
         self.deleted = False
+        ac = config.autoscaling_config
+        if ac is not None and ac.has_slo():
+            from ray_tpu.serve.autoscaling import AutoscalingPolicy
+
+            self.policy = AutoscalingPolicy(ac)
+        else:
+            self.policy = None
 
     def routing_table(self) -> Dict[str, Any]:
         running = [r for r in self.replicas.values() if r.state == RUNNING]
@@ -100,6 +134,11 @@ class _DeploymentState:
             # their pow-2 choice so N engine replicas share load by
             # actual queue depth, not just each router's local view.
             "depths": {r.replica_id: _replica_depth(r) for r in running},
+            # admission-control contract: routers bound their
+            # assignment wait pool at this (-1 = unbounded) and reject
+            # the overflow with BackPressureError instead of letting
+            # every waiter burn its full assignment timeout
+            "max_queued": self.config.max_queued_requests,
         }
 
 
@@ -414,15 +453,19 @@ class ServeController:
                 now_mono = time.monotonic()
                 key = (app_name, deployment_name)
                 last = self._router_stats.setdefault(key, {})
-                prev = last.get(router_id, (0.0, {"completed": 0.0,
-                                                  "latency_sum_s": 0.0}))[1]
+                prev = last.get(router_id, (0.0, {}))[1]
                 totals = self._deployment_stats.setdefault(
                     key, {"completed": 0.0, "latency_sum_s": 0.0}
                 )
-                for field_ in ("completed", "latency_sum_s"):
-                    delta = handle_stats.get(field_, 0.0) - prev[field_]
+                # "rejected" counts router-side admission rejections
+                # (the request never reached a replica, so no replica
+                # counter can see it); .get defaults keep pre-overload
+                # checkpoints and old routers folding cleanly
+                for field_ in ("completed", "latency_sum_s", "rejected"):
+                    delta = (handle_stats.get(field_, 0.0)
+                             - prev.get(field_, 0.0))
                     if delta > 0:
-                        totals[field_] += delta
+                        totals[field_] = totals.get(field_, 0.0) + delta
                 last[router_id] = (now_mono, dict(handle_stats))
                 # dead routers leave permanent per-process entries
                 # otherwise (ids are unique per process)
@@ -475,10 +518,21 @@ class ServeController:
                             1 for r in ds.replicas.values() if r.state == RUNNING
                         ),
                         "version": ds.version,
-                        **self._deployment_stats.get(
-                            (app_name, name),
-                            {"completed": 0.0, "latency_sum_s": 0.0},
+                        # overload plane: the serve panel shows how
+                        # much work this deployment is refusing or
+                        # shedding (0/0 when never overloaded)
+                        "overload": _overload_summary(
+                            ds,
+                            self._deployment_stats.get(
+                                (app_name, name), {}
+                            ).get("rejected", 0.0),
                         ),
+                        **{
+                            k: self._deployment_stats.get(
+                                (app_name, name), {}
+                            ).get(k, 0.0)
+                            for k in ("completed", "latency_sum_s")
+                        },
                         # per-replica load panel for /api/serve: queue
                         # depth plus any user stats() signals (the LLM
                         # engine's per-tick live tokens, block-pool
@@ -487,6 +541,7 @@ class ServeController:
                             rid: {
                                 "state": r.state,
                                 "ongoing": r.metrics.get("ongoing", 0),
+                                "rejected": r.metrics.get("rejected", 0),
                                 "queue_depth": _replica_depth(r),
                                 **(
                                     {"engine": r.metrics["user_stats"]}
@@ -755,11 +810,21 @@ class ServeController:
                 changed = True
             if changed:
                 ds.version += 1
-        for r in victims:
-            self._stop_replica(r, timeout_s=ds.config.graceful_shutdown_timeout_s)
         if changed:
+            # publish the shrunk table BEFORE draining scale-down
+            # victims: routers must stop admitting new requests to a
+            # draining replica, so the graceful window is spent on
+            # genuinely in-flight work.  A stale-table straggler that
+            # still lands on a victim either executes normally (no
+            # drain hook) or — once `__serve_drain__` has told the
+            # callable to stop admitting, as the LLM engine does —
+            # gets a typed, retryable BackPressureError (503 +
+            # Retry-After at the proxy) rather than being silently
+            # dropped with the replica
             self._checkpoint()
             self._notify_routes(ds.app_name, ds.name, ds.version)
+        for r in victims:
+            self._stop_replica(r, timeout_s=ds.config.graceful_shutdown_timeout_s)
 
     def _start_replica(self, ds: _DeploymentState):
         rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_idx}"
@@ -811,7 +876,15 @@ class ServeController:
     # -- autoscaling --------------------------------------------------
     def _autoscale(self):
         """Reference: `autoscaling_state.py` + `serve/autoscaling_policy.py`
-        — desired = ceil(current * (ongoing/replica) / target_ongoing)."""
+        — desired = ceil(current * (ongoing/replica) / target_ongoing).
+
+        Deployments with an SLO-configured AutoscalingConfig
+        (`target_ttft_s` / `target_queue_depth`) use the SLO policy
+        instead (`serve/autoscaling.py`): the decision consumes ONLY
+        controller-collected per-replica stats (the health-check
+        piggyback — queue depth, TTFT EMA, shed counters), normalized
+        to a load ratio that is smoothed over the same look-back
+        window and gated by the same cooldowns."""
         with self._lock:
             all_ds = [
                 ds
@@ -827,6 +900,9 @@ class ServeController:
                     r for r in ds.replicas.values() if r.state == RUNNING
                 ]
             if not running:
+                continue
+            if getattr(ds, "policy", None) is not None:
+                self._autoscale_slo(ds, ac, running)
                 continue
             total_ongoing = self._pushed_ongoing(ds, ac)
             if total_ongoing is None:
@@ -870,6 +946,48 @@ class ServeController:
                         ds.last_scale_change = now
                 else:
                     ds.last_scale_change = now
+
+    def _autoscale_slo(self, ds: _DeploymentState, ac, running):
+        """One SLO-policy scaling decision: instantaneous pressure from
+        the replicas' piggybacked metrics, smoothed over the look-back
+        window, pushed through the hysteresis/cooldown gates."""
+        now = time.monotonic()
+        with self._lock:
+            metrics = [dict(r.metrics) for r in running]
+        ratio = ds.policy.pressure(metrics)
+        window = ds.samples = [
+            (ts, v)
+            for ts, v in ds.samples
+            if now - ts < ac.look_back_period_s
+        ] + [(now, ratio)]
+        avg_ratio = sum(v for _, v in window) / len(window)
+        if ds.policy.refusal_forced:
+            # fresh sheds/rejections BYPASS the smoothing window: the
+            # deployment is refusing work NOW, and averaging a forced
+            # above-band sample into a quiet look-back would dilute it
+            # below the band — clients would keep eating 503s for a
+            # whole window before any scale-out.  The upscale cooldown
+            # still rate-limits the reaction.
+            avg_ratio = max(avg_ratio, ratio)
+        desired = ds.policy.desired_replicas(avg_ratio, len(running))
+        with self._lock:
+            delay = (
+                ac.upscale_delay_s
+                if desired > ds.target_replicas
+                else ac.downscale_delay_s
+            )
+            if desired != ds.target_replicas:
+                if now - ds.last_scale_change >= delay:
+                    logger.info(
+                        "SLO autoscale %s/%s: ratio=%.2f (avg %.2f) "
+                        "replicas %d -> %d",
+                        ds.app_name, ds.name, ratio, avg_ratio,
+                        ds.target_replicas, desired,
+                    )
+                    ds.target_replicas = desired
+                    ds.last_scale_change = now
+            else:
+                ds.last_scale_change = now
 
     def _pushed_ongoing(self, ds: _DeploymentState, ac) -> Optional[float]:
         """Sum of router-pushed in-flight counts for a deployment, or

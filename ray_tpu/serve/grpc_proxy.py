@@ -27,11 +27,29 @@ from typing import Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+from ray_tpu import exceptions as _exc
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.request import Request, Response
 
 _HEALTH = "/ray.serve.ServeAPIService/Healthz"
 _LIST = "/ray.serve.ServeAPIService/ListApplications"
+
+
+def _classify_error(e: BaseException):
+    """(status_code_name, retry_after_s | None) for a dispatch failure
+    — kept grpc-import-free so the translation is unit-testable:
+
+    - BackPressureError (direct, or a replica-side rejection wrapped
+      in TaskError) -> RESOURCE_EXHAUSTED, with the retry hint also
+      surfaced as `retry-after` trailing metadata (seconds, decimal);
+    - a deadline expiry / engine shed -> DEADLINE_EXCEEDED;
+    - anything else -> INTERNAL (unchanged)."""
+    retry_after = _exc.backpressure_retry_after(e)
+    if retry_after is not None:
+        return "RESOURCE_EXHAUSTED", retry_after
+    if _exc.is_deadline_expiry(e):
+        return "DEADLINE_EXCEEDED", None
+    return "INTERNAL", None
 
 
 def _encode(value) -> bytes:
@@ -130,8 +148,16 @@ class GRPCProxy:
             )
         except Exception as e:  # rtlint: disable=RT005
             # boundary to gRPC: ctx.abort() RAISES, surfacing e as the
-            # call's INTERNAL status — nothing is swallowed
-            await ctx.abort(grpc.StatusCode.INTERNAL, str(e))
+            # call's status — nothing is swallowed.  Overload signals
+            # map to RESOURCE_EXHAUSTED (+ retry-after trailing
+            # metadata) / DEADLINE_EXCEEDED so clients can tell
+            # "retry later" from "server bug" (see _classify_error)
+            status_name, retry_after = _classify_error(e)
+            if retry_after is not None:
+                ctx.set_trailing_metadata(
+                    (("retry-after", f"{retry_after:.3f}"),)
+                )
+            await ctx.abort(getattr(grpc.StatusCode, status_name), str(e))
         if isinstance(value, Response) and not (
             200 <= value.status_code < 300
         ):

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
 from ray_tpu.serve.kv_cache import SCRATCH_BLOCK, BlockPool, RadixCache
 
 logger = logging.getLogger(__name__)
@@ -85,7 +86,8 @@ class LlamaEngine:
     def __init__(self, cfg, params, *, slots: int = 32,
                  max_len: Optional[int] = None, chunk: int = 8,
                  block_size: int = 16, kv_blocks: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 max_queued: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -171,6 +173,17 @@ class LlamaEngine:
         self._prefill_tokens = 0      # tokens actually prefilled
         self._prefix_hits = 0         # requests with a non-empty match
         self._prefill_calls = 0       # prefill dispatches (full+suffix)
+        # overload plane: bound the admission queue and shed queued
+        # requests whose caller has (or must have) given up BEFORE
+        # they burn prefill compute.  All counters are plain ints
+        # (GIL-atomic) so submit() can reject without any engine lock.
+        self.max_queued = None if max_queued is None else int(max_queued)
+        if self.max_queued is not None and self.max_queued < 0:
+            raise ValueError(f"max_queued={max_queued} must be >= 0")
+        self._rejected_total = 0      # queue-full submit() rejections
+        self._shed_expired = 0        # queued past their deadline
+        self._shed_predicted = 0      # predicted TTFT > remaining budget
+        self._draining = False        # begin_drain(): reject new work
         self._ttft_ema_s = 0.0
         self._tick_ema_s = 0.0
         self._last_gather_blocks = 0  # W of the latest chunk dispatch
@@ -188,7 +201,32 @@ class LlamaEngine:
         self._thread.start()
 
     # -- public surface ------------------------------------------------
-    def submit(self, prompt_ids: List[int], max_new_tokens: int) -> Future:
+    def retry_after_hint_s(self) -> float:
+        """When a rejected caller should retry: the estimated time for
+        the current backlog to drain one admission wave (ticks needed
+        at the ≤16-per-tick admission budget, priced at the tick EMA).
+        A heuristic, not a promise — floored/capped so cold engines
+        (no EMA yet) and pathological backlogs still hint sanely."""
+        backlog = len(self._queue) + self._pending_admissions
+        per_tick = float(max(1, min(16, self.slots)))
+        est = self._tick_ema_s * max(1.0, backlog / per_tick)
+        if est <= 0.0:
+            est = 1.0  # no tick has completed yet: default hint
+        return max(0.05, min(30.0, est))
+
+    def begin_drain(self) -> None:
+        """Graceful scale-down entry: stop ADMITTING new requests
+        (submit() rejects with BackPressureError) while live sequences
+        decode to completion.  KV blocks release as each finishes;
+        shutdown() then returns the pool to the allocator."""
+        self._draining = True
+
+    def submit(self, prompt_ids: List[int], max_new_tokens: int,
+               timeout_s: Optional[float] = None) -> Future:
+        """`timeout_s` is the caller's remaining end-to-end budget: the
+        request carries its admission deadline through the queue, and
+        the admission loop sheds it BEFORE prefill once the deadline
+        has passed (or predictably must pass) — see _maybe_shed."""
         limit = self.max_len - 1
         if not prompt_ids or len(prompt_ids) >= limit:
             f: Future = Future()
@@ -199,13 +237,47 @@ class LlamaEngine:
         n_new = max(1, min(int(max_new_tokens), limit - len(prompt_ids)))
         # no pool-size check needed: __init__ guarantees the pool holds
         # a full max_len sequence, and T + n_new - 1 <= max_len - 1
+        now = _time.monotonic()
+        deadline = None if timeout_s is None else now + max(0.0, timeout_s)
         fut: Future = Future()
         with self._wake:
             if not self._running:
                 fut.set_exception(RuntimeError("engine is shut down"))
                 return fut
+            if self._draining:
+                self._rejected_total += 1
+                fut.set_exception(BackPressureError(
+                    "engine is draining (replica scaling down)",
+                    retry_after_s=self.retry_after_hint_s(),
+                ))
+                return fut
+            if (self.max_queued is not None
+                    and len(self._queue) + self._pending_admissions
+                    >= self.max_queued + len(self._free)):
+                # bounded queue: reject NOW — queueing past the cap
+                # only converts this request into a guaranteed timeout
+                # that still costs a prefill.  Free slots extend the
+                # bound (work that will be admitted on the next tick
+                # is not really WAITING), so max_queued=0 still means
+                # "serve when capacity is free, never queue" rather
+                # than "reject everything".  Under saturation free
+                # slots are zero and the queue is bounded at exactly
+                # max_queued.
+                self._rejected_total += 1
+                fut.set_exception(BackPressureError(
+                    f"engine queue full (max_queued={self.max_queued})",
+                    retry_after_s=self.retry_after_hint_s(),
+                ))
+                return fut
+            if deadline is not None and now >= deadline:
+                self._shed_expired += 1
+                fut.set_exception(DeadlineExceededError(
+                    "request budget already spent at submission",
+                    timeout_s=timeout_s,
+                ))
+                return fut
             self._queue.append(
-                (list(prompt_ids), n_new, fut, _time.monotonic())
+                (list(prompt_ids), n_new, fut, now, deadline)
             )
             self._wake.notify()
         return fut
@@ -260,6 +332,15 @@ class LlamaEngine:
                 "ttft_ema_s": self._ttft_ema_s,
                 "tick_ema_s": self._tick_ema_s,
                 "ticks": self._chunk_seq,
+                # overload plane (admission control + shedding):
+                # consumed by the SLO autoscaler and /api/serve
+                "max_queued": (-1 if self.max_queued is None
+                               else self.max_queued),
+                "rejected_total": self._rejected_total,
+                "shed_expired": self._shed_expired,
+                "shed_predicted": self._shed_predicted,
+                "shed_total": self._shed_expired + self._shed_predicted,
+                "draining": 1.0 if self._draining else 0.0,
             }
 
     def shutdown(self):
@@ -420,6 +501,43 @@ class LlamaEngine:
         return fn
 
     # -- admission -----------------------------------------------------
+    def _maybe_shed(self, fut: Future, deadline: Optional[float],
+                    busy: bool) -> bool:
+        """Deadline-aware load shedding, applied when a request is
+        popped for admission — the last instant before it costs a
+        prefill dispatch.  Sheds when the deadline has already passed,
+        OR — only while the engine is BUSY (`busy`: live slots or more
+        queued work behind this pop) — when the predicted
+        time-to-first-token (the TTFT EMA, which tracks queueing +
+        prefill under load) must overrun the remaining budget: a
+        backed-up engine stops doing work nobody will read.  The busy
+        gate matters because the EMA is lifetime-smoothed and never
+        decays while idle: without it, a storm-inflated EMA would keep
+        shedding deadline-carrying requests from a completely idle
+        engine forever (sheds never update the EMA, so nothing would
+        ever bring it back down).  Sheds are breaker-NEUTRAL
+        downstream (the router classifies DeadlineExceededError as
+        neutral, PR-1 convention): an overloaded-but-reachable replica
+        must not accrue breaker failures for honest sheds."""
+        if deadline is None or fut.done():
+            return False
+        now = _time.monotonic()
+        if now >= deadline:
+            self._shed_expired += 1
+            why = "deadline already expired in queue"
+        elif (busy and self._ttft_ema_s > 0.0
+                and now + self._ttft_ema_s >= deadline):
+            self._shed_predicted += 1
+            why = (f"predicted TTFT ({self._ttft_ema_s * 1e3:.0f} ms EMA) "
+                   "exceeds the remaining budget")
+        else:
+            return False
+        fut.set_exception(DeadlineExceededError(
+            f"shed before prefill: {why}",
+            timeout_s=max(0.0, deadline - now),
+        ))
+        return True
+
     def _alloc_or_evict(self, n: int) -> Optional[List[int]]:
         own = self._pool.alloc(n)
         if own is None and self._radix is not None:
@@ -615,7 +733,14 @@ class LlamaEngine:
             try:
                 t0 = _time.perf_counter()
                 requeue = []
-                for i, (prompt, n_new, fut, ts) in enumerate(admissions):
+                for i, (prompt, n_new, fut, ts, dl) in enumerate(admissions):
+                    # shed BEFORE the prefill dispatch: an expired (or,
+                    # under load, predictably-expiring) request consumes
+                    # neither a slot nor a KV block nor a compile
+                    busy = bool(self._active) or bool(self._queue)
+                    if self._maybe_shed(fut, dl, busy):
+                        self._pending_admissions -= 1
+                        continue
                     with self._lock:
                         if not self._admit(prompt, n_new, fut, ts):
                             # pool exhausted by LIVE sequences: wait for
@@ -697,7 +822,7 @@ class LlamaEngine:
                     # admissions popped from the queue but not (yet)
                     # registered in _active would otherwise hang their
                     # callers forever
-                    for _p, _n, fut, _ts in admissions:
+                    for _p, _n, fut, _ts, _dl in admissions:
                         if not fut.done():
                             fut.set_exception(e)
                     self._active.clear()

@@ -20,10 +20,37 @@ from typing import Any, Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+from ray_tpu import exceptions as _exc
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.request import Request, Response
 
 _MAX_BODY = 256 * 1024 * 1024
+
+
+def _error_response(e: BaseException):
+    """Translate a dispatch failure into an HTTP response tuple
+    (status, content_type, body, extra_headers) — the overload
+    boundary to HTTP:
+
+    - BackPressureError (router-level, or replica/engine-level wrapped
+      in a TaskError) -> 503 + `Retry-After` (delay-seconds, rounded
+      UP so a 0.2 s hint doesn't become an immediate hot retry);
+    - DeadlineExceededError / a replica-side deadline shed -> 504 (the
+      caller's budget is spent; retrying the same budget cannot help);
+    - anything else -> 500 with the traceback (unchanged behavior).
+    """
+    retry_after = _exc.backpressure_retry_after(e)
+    if retry_after is not None:
+        import math
+
+        body = f"Service Unavailable: {e}".encode()
+        return (503, "text/plain", body,
+                {"Retry-After": str(max(1, math.ceil(retry_after)))})
+    if _exc.is_deadline_expiry(e):
+        return (504, "text/plain", f"Gateway Timeout: {e}".encode(), {})
+    tb = traceback.format_exc()
+    return (500, "text/plain",
+            f"Internal Server Error: {e}\n{tb}".encode(), {})
 
 
 class _StreamOut:
@@ -87,9 +114,11 @@ class HTTPProxy:
                 try:
                     out = await self._dispatch(req)
                 except Exception as e:  # noqa: BLE001 — boundary to HTTP
-                    tb = traceback.format_exc()
-                    out = (500, "text/plain",
-                           f"Internal Server Error: {e}\n{tb}".encode(), {})
+                    # overload signals become retryable statuses (503 +
+                    # Retry-After / 504), not generic 500s; 500 bodies
+                    # carry the traceback
+                    logger.debug("dispatch of %s failed: %s", req.path, e)
+                    out = _error_response(e)
                 if isinstance(out, _StreamOut):
                     # chunked transfer: one chunk per generator item
                     # (reference: streaming responses through the proxy,
@@ -223,10 +252,12 @@ class HTTPProxy:
         except StopAsyncIteration:
             first, ended = None, True
         except Exception as e:  # noqa: BLE001 — boundary to HTTP
-            tb = traceback.format_exc()
+            # pre-commit failures translate like unary ones: a
+            # backpressured stream is a clean 503 + Retry-After
+            logger.debug("stream failed before first item: %s", e)
+            status, ctype, body, extra = _error_response(e)
             await self._write_response(
-                writer, 500, "text/plain",
-                f"Internal Server Error: {e}\n{tb}".encode(), {}, keep_alive,
+                writer, status, ctype, body, extra, keep_alive,
             )
             return
         if ended or isinstance(first, str):
@@ -265,9 +296,10 @@ class HTTPProxy:
     async def _write_response(self, writer, status: int, ctype: str,
                               body: bytes, extra: Dict[str, str],
                               keep_alive: bool):
-        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
-            status, "Status"
-        )
+        reason = {
+            200: "OK", 404: "Not Found", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout",
+        }.get(status, "Status")
         head = [
             f"HTTP/1.1 {status} {reason}",
             f"Content-Type: {ctype}",

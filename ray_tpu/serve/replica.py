@@ -15,6 +15,8 @@ import logging
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu.exceptions import BackPressureError
+
 logger = logging.getLogger(__name__)
 
 
@@ -55,6 +57,12 @@ class Replica:
         self._latency_sum_s = 0.0
         self._latency_buckets = [0] * len(LATENCY_BOUNDARIES)
         self._completed = 0  # finished requests (histogram count basis)
+        # overload plane: requests rejected at the replica cap.  The
+        # router already caps ITS OWN in-flight at max_ongoing, but N
+        # routers can overshoot the replica in aggregate — this is the
+        # authoritative per-replica bound (reference: replicas enforce
+        # max_ongoing_requests themselves and the router retries)
+        self._rejected = 0
         if isinstance(callable_def, type):
             self._callable = callable_def(*init_args, **init_kwargs)
         else:
@@ -85,6 +93,7 @@ class Replica:
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
 
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
+        self._reject_if_saturated()
         self._ongoing += 1
         self._total += 1
         t0 = time.monotonic()
@@ -124,6 +133,7 @@ class Replica:
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
 
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
+        self._reject_if_saturated()
         self._ongoing += 1
         self._total += 1
         t0 = time.monotonic()
@@ -181,6 +191,23 @@ class Replica:
             self._observe_latency(time.monotonic() - t0)
 
     # -- control plane ------------------------------------------------
+    def _reject_if_saturated(self):
+        """Per-replica admission bound: `max_ongoing_requests` holds in
+        AGGREGATE, not just per router.  Rejections carry a retry-after
+        hint priced at the replica's observed mean request latency (one
+        slot frees roughly that often under saturation); the hint rides
+        the exception message across the TaskError wire wrapping."""
+        if self._ongoing < self._max_ongoing:
+            return
+        self._rejected += 1
+        mean_s = (self._latency_sum_s / self._completed
+                  if self._completed else 0.0)
+        raise BackPressureError(
+            f"replica {self._replica_id} at "
+            f"max_ongoing_requests={self._max_ongoing}",
+            retry_after_s=max(0.05, min(30.0, mean_s or 1.0)),
+        )
+
     def _observe_latency(self, seconds: float):
         self._completed += 1
         self._latency_sum_s += seconds
@@ -195,6 +222,7 @@ class Replica:
             "ongoing": self._ongoing,
             "total": self._total,  # started (includes in-flight)
             "completed": self._completed,  # histogram count basis
+            "rejected": self._rejected,  # replica-cap backpressure
             "latency_sum_s": self._latency_sum_s,
             "latency_buckets": list(self._latency_buckets),
         }
@@ -267,10 +295,40 @@ class Replica:
         self._apply_user_config(user_config)
         return True
 
+    async def _call_user_hook(self, name: str):
+        """Optional drain-lifecycle hooks on the user callable (dunder
+        names so they can't collide with request methods): sync or
+        async, failures logged — a broken hook must not block the
+        controller's drain sequence."""
+        hook = getattr(self._callable, name, None)
+        if not callable(hook):
+            return
+        try:
+            out = hook()
+            if inspect.isawaitable(out):
+                await out
+        except Exception as e:
+            logger.debug("%s hook of %s failed: %s",
+                         name, self._replica_id, e)
+
     async def drain(self, timeout_s: float = 5.0) -> bool:
-        """Wait for in-flight requests before shutdown (reference:
-        graceful_shutdown_timeout_s handling in `replica.py`)."""
+        """Graceful drain before shutdown (reference:
+        graceful_shutdown_timeout_s handling in `replica.py`): by the
+        time this runs the controller has already removed the replica
+        from routing tables, so no NEW requests arrive except a brief
+        stale-table race.  Sequence: `__serve_drain__` tells the user
+        callable to stop admitting (the LLM engine rejects new
+        submissions but finishes live sequences), the loop waits out
+        in-flight requests, and `__serve_shutdown__` releases device
+        state (KV block pool) deterministically before the kill."""
+        await self._call_user_hook("__serve_drain__")
         deadline = time.monotonic() + timeout_s
         while self._ongoing > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.02)
-        return self._ongoing == 0
+        drained = self._ongoing == 0
+        # run the release hook even on a TIMED-OUT drain: the
+        # controller kills the replica either way, and a wedged
+        # request is exactly the case where deterministic device-state
+        # release beats actor-kill teardown
+        await self._call_user_hook("__serve_shutdown__")
+        return drained

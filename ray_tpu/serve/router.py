@@ -76,6 +76,16 @@ class Router:
         self._version = -1
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        # admission control (overload plane): the deployment's
+        # max_queued_requests, delivered with the routing table.  Caps
+        # how many of THIS router's requests may sit waiting for a
+        # replica slot; the (max_queued+1)-th waiter is rejected with
+        # BackPressureError immediately instead of burning its whole
+        # assignment timeout (reference: handle-side max_queued
+        # rejection).  -1 = unbounded (legacy behavior).
+        self._max_queued = -1
+        self._waiting = 0  # requests inside the assignment wait loop
+        self._rejected_total = 0
         import os as _os
         import uuid as _uuid
 
@@ -156,6 +166,7 @@ class Router:
             for rid, info in self._replicas.items():
                 if rid in depths:
                     info.reported_depth = depths[rid]
+            self._max_queued = int(table.get("max_queued", -1))
             self._last_refresh = time.monotonic()
 
     def _needs_refresh(self, force: bool) -> bool:
@@ -176,6 +187,11 @@ class Router:
             return {
                 "completed": self._completed_total,
                 "latency_sum_s": self._latency_sum_s,
+                # assignment-queue rejections happen ENTIRELY in this
+                # router (the request never reaches a replica), so this
+                # is the only place they can be counted; the controller
+                # delta-folds it into the deployment's overload panel
+                "rejected": self._rejected_total,
                 "incarnation": self._incarnation,
             }
 
@@ -383,6 +399,15 @@ class Router:
                 return "failure"
             if isinstance(err, _exc.DeadlineExceededError):
                 return "neutral"
+            if (_exc.is_deadline_expiry(err)
+                    or _exc.backpressure_retry_after(err) is not None):
+                # overload signals from INSIDE the replica (engine
+                # sheds / admission rejections) arrive wrapped as
+                # TaskError.  They are breaker-NEUTRAL: the replica is
+                # provably reachable (it answered), but crediting a
+                # success would reset the consecutive-failure count on
+                # every shed and let a flapping replica dodge ejection
+                return "neutral"
             return "success"
 
         async def _watch():
@@ -406,6 +431,28 @@ class Router:
 
         asyncio.run_coroutine_threadsafe(_watch(), rt_.loop)
         return out
+
+    def _enter_wait_or_reject(self):
+        """Admission control at the router: a request that found no
+        free replica either joins the bounded wait pool or is rejected
+        NOW with a typed BackPressureError (max_queued_requests from
+        the routing table; -1 = legacy unbounded wait).  The hint is
+        the table-refresh period — fresh capacity can't be discovered
+        faster than that."""
+        with self._lock:
+            if self._max_queued >= 0 and self._waiting >= self._max_queued:
+                self._rejected_total += 1
+                raise _exc.BackPressureError(
+                    f"no free replica for {self._deployment} and its "
+                    f"assignment queue is full (max_queued_requests="
+                    f"{self._max_queued}, waiting={self._waiting})",
+                    retry_after_s=max(0.1, self.REFRESH_PERIOD_S),
+                )
+            self._waiting += 1
+
+    def _leave_wait(self):
+        with self._lock:
+            self._waiting = max(0, self._waiting - 1)
 
     def _assign_timeout(self, deadline_s, timeout_s) -> TimeoutError:
         """Assignment-wait expiry: a handle-level deadline surfaces as
@@ -434,18 +481,26 @@ class Router:
         deadline = deadline_s if deadline_s is not None \
             else time.monotonic() + timeout_s
         backoff = 0.005
-        while True:
-            self._refresh()
-            info = self._try_pick(affinity)
-            if info is not None:
-                return self._submit(info, method_name, args, kwargs,
-                                    streaming=streaming,
-                                    deadline_s=deadline_s)
-            if time.monotonic() > deadline:
-                raise self._assign_timeout(deadline_s, timeout_s)
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 0.25)
-            self._refresh(force=True)
+        waiting = False
+        try:
+            while True:
+                self._refresh()
+                info = self._try_pick(affinity)
+                if info is not None:
+                    return self._submit(info, method_name, args, kwargs,
+                                        streaming=streaming,
+                                        deadline_s=deadline_s)
+                if not waiting:
+                    self._enter_wait_or_reject()
+                    waiting = True
+                if time.monotonic() > deadline:
+                    raise self._assign_timeout(deadline_s, timeout_s)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.25)
+                self._refresh(force=True)
+        finally:
+            if waiting:
+                self._leave_wait()
 
     async def assign_request_async(self, method_name: str, args: tuple,
                                    kwargs: dict, timeout_s: float = 30.0,
@@ -457,18 +512,26 @@ class Router:
         deadline = deadline_s if deadline_s is not None \
             else time.monotonic() + timeout_s
         backoff = 0.005
-        while True:
-            await self._refresh_async()
-            info = self._try_pick(affinity)
-            if info is not None:
-                return self._submit(info, method_name, args, kwargs,
-                                    streaming=streaming,
-                                    deadline_s=deadline_s)
-            if time.monotonic() > deadline:
-                raise self._assign_timeout(deadline_s, timeout_s)
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 0.25)
-            await self._refresh_async(force=True)
+        waiting = False
+        try:
+            while True:
+                await self._refresh_async()
+                info = self._try_pick(affinity)
+                if info is not None:
+                    return self._submit(info, method_name, args, kwargs,
+                                        streaming=streaming,
+                                        deadline_s=deadline_s)
+                if not waiting:
+                    self._enter_wait_or_reject()
+                    waiting = True
+                if time.monotonic() > deadline:
+                    raise self._assign_timeout(deadline_s, timeout_s)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 0.25)
+                await self._refresh_async(force=True)
+        finally:
+            if waiting:
+                self._leave_wait()
 
     def ongoing_requests(self) -> int:
         with self._lock:

@@ -56,6 +56,10 @@ class AutoscalingConfigSchema(BaseModel):
     downscale_delay_s: float = Field(default=2.0, ge=0)
     metrics_interval_s: float = Field(default=0.2, gt=0)
     look_back_period_s: float = Field(default=2.0, gt=0)
+    # SLO-driven policy (serve/autoscaling.py): either target opts in
+    target_ttft_s: Optional[float] = Field(default=None, gt=0)
+    target_queue_depth: Optional[float] = Field(default=None, gt=0)
+    hysteresis: float = Field(default=0.1, ge=0, lt=1)
 
     @field_validator("max_replicas")
     @classmethod

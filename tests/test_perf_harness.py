@@ -100,6 +100,34 @@ def test_elastic_recovery_row():
     assert row["reform_width"] == 2.0  # capacity returned: full width
 
 
+def test_overload_row():
+    """`--overload`: the overload-plane acceptance rows, structurally
+    validated like the engine-trace rows (wall-clock numbers live in
+    PERF.md):
+    - exact admission accounting: every offered request is admitted,
+      rejected, or shed — exactly once — and both overload outcomes
+      actually occurred under the storm;
+    - sheds never reach prefill (prefill dispatches == admissions)
+      and the queue never exceeds its cap;
+    - the KV block pool returns to its pre-storm free count;
+    - TTFT percentiles under 2x overload are well-formed."""
+    from ray_tpu.scripts.perf import main
+
+    results = main(["--overload"])
+    storm = results["overload_storm"]
+    assert storm["offered"] == (storm["admitted"] + storm["rejected"]
+                                + storm["shed"])
+    assert storm["rejected"] > 0 and storm["shed"] > 0
+    assert storm["shed"] == storm["shed_expired"] + storm["shed_predicted"]
+    assert storm["prefill_calls"] == storm["admitted"]
+    assert storm["queue_peak"] <= storm["queue_cap"]
+    assert storm["blocks_free_delta"] == 0
+    assert storm["admitted_tok_s"] > 0
+    ttft = results["overload_ttft"]
+    assert 0 < ttft["ttft_p50_ms"] <= ttft["ttft_p99_ms"]
+    assert ttft["concurrency"] == 2 * 4.0  # 2x the engine's slots
+
+
 def test_pin_cores_rejects_oversubscription():
     import os
 

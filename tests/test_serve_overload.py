@@ -1,0 +1,529 @@
+"""Overload plane: admission control, typed backpressure, deadline
+shedding, and SLO-driven autoscaling.
+
+Unit coverage for the BackPressureError contract (exception shape, the
+HTTP 503 + Retry-After and gRPC RESOURCE_EXHAUSTED translations, the
+router/replica/batch admission caps, the AutoscalingPolicy math), plus
+the serve-level e2e paths: saturated deployments answer 503 with a
+Retry-After header instead of timing out, and an SLO-configured
+deployment scales 1->N and back down — with graceful drain — driven
+only by controller-reported stats.  The engine-level spike storms live
+in tests/test_chaos_overload.py.
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import exceptions as exc
+from ray_tpu import serve
+
+
+# ----------------------------------------------------------------------
+# units: exception contract + proxy translations
+# ----------------------------------------------------------------------
+def test_backpressure_error_carries_hint_across_task_error():
+    e = exc.BackPressureError("queue full", retry_after_s=0.75)
+    assert e.retry_after_s == 0.75
+    assert exc.backpressure_retry_after(e) == 0.75
+    # replica-side rejections cross the wire as TaskError(message,
+    # cause_type) — the hint must survive that flattening
+    wrapped = exc.TaskError(str(e), cause_type="BackPressureError")
+    assert exc.backpressure_retry_after(wrapped) == 0.75
+    # and a mangled message still yields a usable default
+    bare = exc.TaskError("no hint here", cause_type="BackPressureError")
+    assert exc.backpressure_retry_after(bare) == 1.0
+    assert exc.backpressure_retry_after(ValueError("x")) is None
+
+
+def test_deadline_expiry_matches_both_shapes():
+    assert exc.is_deadline_expiry(exc.DeadlineExceededError("x"))
+    assert exc.is_deadline_expiry(
+        exc.TaskError("shed", cause_type="DeadlineExceededError")
+    )
+    assert not exc.is_deadline_expiry(
+        exc.TaskError("boom", cause_type="ValueError")
+    )
+
+
+def test_http_proxy_translates_backpressure_to_503_retry_after():
+    from ray_tpu.serve.proxy import _error_response
+
+    status, _ctype, body, extra = _error_response(
+        exc.BackPressureError("engine queue full", retry_after_s=2.3)
+    )
+    assert status == 503
+    assert b"engine queue full" in body
+    assert extra["Retry-After"] == "3"  # delay-seconds, rounded UP
+    # replica-side rejection (TaskError wrapping) translates the same
+    wrapped = exc.TaskError(
+        str(exc.BackPressureError("replica at cap", retry_after_s=0.2)),
+        cause_type="BackPressureError",
+    )
+    status, _ctype, _body, extra = _error_response(wrapped)
+    assert status == 503 and extra["Retry-After"] == "1"
+
+
+def test_http_proxy_translates_deadline_to_504_and_keeps_500():
+    from ray_tpu.serve.proxy import _error_response
+
+    status, _c, _b, extra = _error_response(
+        exc.DeadlineExceededError("budget spent")
+    )
+    assert status == 504 and not extra
+    status, _c, _b, extra = _error_response(
+        exc.TaskError("shed before prefill",
+                      cause_type="DeadlineExceededError")
+    )
+    assert status == 504
+    status, _c, body, _x = _error_response(ValueError("boom"))
+    assert status == 500 and b"boom" in body
+
+
+def test_grpc_proxy_classifies_overload_statuses():
+    from ray_tpu.serve.grpc_proxy import _classify_error
+
+    name, retry = _classify_error(
+        exc.BackPressureError("full", retry_after_s=0.5)
+    )
+    assert name == "RESOURCE_EXHAUSTED" and retry == 0.5
+    name, retry = _classify_error(
+        exc.TaskError("full [retry_after_s=1.500]",
+                      cause_type="BackPressureError")
+    )
+    assert name == "RESOURCE_EXHAUSTED" and retry == 1.5
+    assert _classify_error(exc.DeadlineExceededError("x")) == \
+        ("DEADLINE_EXCEEDED", None)
+    assert _classify_error(RuntimeError("x")) == ("INTERNAL", None)
+
+
+# ----------------------------------------------------------------------
+# units: admission caps (router / replica / batch queue)
+# ----------------------------------------------------------------------
+def test_router_rejects_when_assignment_queue_full():
+    from ray_tpu.serve.router import Router, _ReplicaInfo
+
+    r = Router("dep", "app")
+    info = _ReplicaInfo("r#0", None, max_ongoing=1)
+    info.local_inflight = 1  # saturated
+    r._replicas = {"r#0": info}
+    r._version = 1
+    r._max_queued = 0
+    r._last_refresh = time.monotonic()  # suppress the table fetch
+    t0 = time.monotonic()
+    with pytest.raises(exc.BackPressureError) as ei:
+        r.assign_request("m", (), {}, timeout_s=30.0)
+    # immediate, not after the 30 s assignment timeout
+    assert time.monotonic() - t0 < 1.0
+    assert ei.value.retry_after_s > 0
+    assert r._waiting == 0
+
+
+def test_router_waiters_bounded_and_released_on_timeout():
+    from ray_tpu.serve.router import Router, _ReplicaInfo
+
+    r = Router("dep", "app")
+    info = _ReplicaInfo("r#0", None, max_ongoing=1)
+    info.local_inflight = 1
+    r._replicas = {"r#0": info}
+    r._version = 1
+    r._max_queued = 1
+    r._last_refresh = time.monotonic() + 3600  # never re-fetch
+    errors = []
+
+    def _waiter():
+        try:
+            r.assign_request("m", (), {}, timeout_s=0.4)
+        except Exception as e:  # rtlint: disable=RT005 — captured for
+            # the assertions below, nothing is swallowed
+            errors.append(e)
+
+    t = threading.Thread(target=_waiter)
+    t.start()
+    deadline = time.monotonic() + 2
+    while r._waiting == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r._waiting == 1
+    # the slot is taken: the next request is over the cap -> rejected
+    with pytest.raises(exc.BackPressureError):
+        r.assign_request("m", (), {}, timeout_s=0.4)
+    t.join(timeout=5)
+    assert len(errors) == 1 and isinstance(errors[0], TimeoutError)
+    assert r._waiting == 0  # wait slot released on timeout
+
+
+def test_replica_enforces_max_ongoing_in_aggregate():
+    from ray_tpu.serve.replica import Replica
+
+    class Gated:
+        async def __call__(self, ev):
+            await ev.wait()
+            return "ok"
+
+    rep = Replica("dep", "dep#0", Gated, (), {}, max_ongoing_requests=2)
+
+    async def main():
+        ev = asyncio.Event()
+        t1 = asyncio.ensure_future(rep.handle_request("__call__", ev))
+        t2 = asyncio.ensure_future(rep.handle_request("__call__", ev))
+        await asyncio.sleep(0.05)  # both parked at the gate
+        with pytest.raises(exc.BackPressureError) as ei:
+            await rep.handle_request("__call__", ev)
+        assert ei.value.retry_after_s > 0
+        ev.set()
+        assert await t1 == "ok" and await t2 == "ok"
+
+    asyncio.run(main())
+    m = rep.get_metrics()
+    assert m["rejected"] == 1
+    assert m["completed"] == 2  # rejections never enter the histogram
+
+
+def test_batch_queue_bounded_under_stalled_downstream():
+    """Satellite fix: a stalled batched function must surface as typed
+    backpressure at the cap, not as an unbounded pending list."""
+    gates = {}
+
+    @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01,
+                 max_queued_requests=3)
+    async def handler(items):
+        await gates["release"].wait()  # stalled downstream
+        return items
+
+    async def main():
+        gates["release"] = release = asyncio.Event()
+        waiters = [asyncio.ensure_future(handler(i)) for i in range(2)]
+        await asyncio.sleep(0.1)  # batch of 2 popped, stuck in fn
+        waiters += [asyncio.ensure_future(handler(10 + i))
+                    for i in range(3)]
+        await asyncio.sleep(0.05)  # pending list now at the cap
+        with pytest.raises(exc.BackPressureError) as ei:
+            await handler(99)
+        assert ei.value.retry_after_s > 0
+        release.set()  # un-stall: queued work drains normally
+        assert sorted(await asyncio.gather(*waiters)) == [0, 1, 10, 11, 12]
+
+    asyncio.run(main())
+
+
+def test_batch_queue_cap_zero_serves_when_downstream_keeps_up():
+    """max_queued_requests=0 means "never queue behind a stalled
+    downstream" — NOT "reject everything": while no batch is
+    executing, submissions are admitted (matching the engine's
+    max_queued=0 semantics)."""
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01,
+                 max_queued_requests=0)
+    async def handler(items):
+        return [x * 2 for x in items]
+
+    async def main():
+        assert await handler(21) == 42
+        assert sorted(await asyncio.gather(*[
+            asyncio.ensure_future(handler(i)) for i in range(4)
+        ])) == [0, 2, 4, 6]
+
+    asyncio.run(main())
+
+
+def test_replica_drain_timeout_still_runs_shutdown_hook():
+    """A drain that times out on a wedged request must STILL run
+    `__serve_shutdown__`: the controller kills the replica either way,
+    and deterministic device-state release beats kill teardown exactly
+    in the stuck case."""
+    from ray_tpu.serve.replica import Replica
+
+    ran = []
+
+    class Wedged:
+        async def __call__(self, ev):
+            await ev.wait()  # never set: the request is stuck
+            return "late"
+
+        def __serve_shutdown__(self):
+            ran.append("shutdown")
+
+    rep = Replica("dep", "dep#0", Wedged, (), {})
+
+    async def main():
+        ev = asyncio.Event()
+        stuck = asyncio.ensure_future(rep.handle_request("__call__", ev))
+        await asyncio.sleep(0.05)
+        drained = await rep.drain(timeout_s=0.2)
+        assert drained is False  # the request really was stuck
+        assert ran == ["shutdown"]
+        stuck.cancel()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# units: SLO autoscaling policy
+# ----------------------------------------------------------------------
+def _metrics(rid="a", ongoing=0, depth=0.0, ttft=0.0, shed=0.0,
+             rejected=0.0):
+    return {
+        "replica_id": rid,
+        "ongoing": ongoing,
+        "rejected": rejected,
+        "engine_queue_depth": depth,
+        "user_stats": {"queue_depth": depth, "ttft_ema_s": ttft,
+                       "shed_total": shed, "rejected_total": 0.0},
+    }
+
+
+def test_slo_policy_pressure_signals():
+    from ray_tpu.serve.autoscaling import AutoscalingPolicy
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    ac = AutoscalingConfig(min_replicas=1, max_replicas=8,
+                           target_ttft_s=0.1, target_queue_depth=4.0,
+                           hysteresis=0.1)
+    assert ac.has_slo()
+    p = AutoscalingPolicy(ac)
+    # idle override: a stale lifetime TTFT EMA must not pin replicas up
+    assert p.pressure([_metrics(ttft=9.0)]) == 0.0
+    # loaded: the binding SLO (worst-replica TTFT at 3x) drives r
+    r = p.pressure([_metrics(ongoing=1, depth=8.0, ttft=0.3)])
+    assert r == pytest.approx(3.0)
+    # sheds force the ratio over the hysteresis band whatever EMAs say,
+    # and flag the reading so the controller bypasses its look-back
+    # smoothing with it (a one-tick 503 burst averaged into a quiet
+    # window must not dilute below the band)
+    m = [_metrics(ongoing=1, depth=1.0, ttft=0.01, shed=5.0)]
+    assert p.pressure(m) > 1.1
+    assert p.refusal_forced
+    # same counters next tick: the shed *rate* is zero again
+    assert p.pressure(m) < 1.0
+    assert not p.refusal_forced
+
+
+def test_slo_policy_desired_replicas_hysteresis():
+    from ray_tpu.serve.autoscaling import AutoscalingPolicy
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    p = AutoscalingPolicy(AutoscalingConfig(
+        min_replicas=1, max_replicas=8, target_ttft_s=0.1,
+        hysteresis=0.1,
+    ))
+    assert p.desired_replicas(3.0, 2) == 4    # capped at doubling
+    assert p.desired_replicas(1.2, 1) == 2
+    assert p.desired_replicas(1.05, 2) == 2   # inside the dead band
+    assert p.desired_replicas(0.95, 2) == 2   # inside the dead band
+    assert p.desired_replicas(0.4, 4) == 2    # shrink under the band
+    assert p.desired_replicas(0.0, 4) == 1    # idle -> min
+    assert p.desired_replicas(50.0, 6) == 8   # max_replicas clamp
+
+
+def test_legacy_autoscaling_config_unchanged():
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    ac = AutoscalingConfig(min_replicas=1, max_replicas=4,
+                           target_ongoing_requests=2.0)
+    assert not ac.has_slo()
+    assert ac.desired_replicas(8.0, 2) == 4
+
+
+def test_schema_accepts_slo_fields():
+    from ray_tpu.serve.schema import AutoscalingConfigSchema
+
+    s = AutoscalingConfigSchema(min_replicas=1, max_replicas=4,
+                                target_ttft_s=0.25,
+                                target_queue_depth=8.0,
+                                hysteresis=0.2)
+    cfg = s.to_config()
+    assert cfg.target_ttft_s == 0.25
+    assert cfg.target_queue_depth == 8.0
+    assert cfg.hysteresis == 0.2 and cfg.has_slo()
+    with pytest.raises(Exception):
+        AutoscalingConfigSchema(target_ttft_s=-1.0)
+
+
+# ----------------------------------------------------------------------
+# e2e: serve cluster
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=16, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    rt.shutdown()
+
+
+@pytest.fixture()
+def serve_instance(cluster):
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+_GATE_KEY = "test:overload:gate"
+_DRAIN_KEY = "test:overload:drained"
+_LOAD_KEY = "test:overload:fake_load"
+
+
+def _kv_put(key, value: bytes):
+    from ray_tpu.core.runtime import get_runtime
+
+    get_runtime().kv_put(key, value)
+
+
+def _kv_get(key):
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().kv_get(key)
+
+
+def test_http_503_with_retry_after_when_saturated(serve_instance):
+    """A saturated deployment (max_ongoing=1, max_queued_requests=0)
+    answers overflow with 503 + Retry-After instead of waiting out the
+    assignment timeout."""
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Sticky:
+        def __call__(self, request):
+            # sync on purpose: runs on the worker thread pool, where
+            # blocking KV calls are safe (the io loop is not)
+            from ray_tpu.core.runtime import get_runtime
+
+            get_runtime().kv_put(_GATE_KEY + ":entered", b"1")
+            while not get_runtime().kv_get(_GATE_KEY):
+                time.sleep(0.01)
+            return "done"
+
+    serve.run(Sticky.bind(), name="sticky", route_prefix="/sticky")
+    _kv_put(_GATE_KEY, b"")
+    _kv_put(_GATE_KEY + ":entered", b"")
+    host, port = serve.http_address()
+    url = f"http://{host}:{port}/sticky"
+    results = {}
+
+    def _first():
+        with urllib.request.urlopen(url, timeout=30) as r:
+            results["first"] = (r.status, r.read())
+
+    t = threading.Thread(target=_first)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not _kv_get(_GATE_KEY + ":entered"):
+        assert time.monotonic() < deadline, "first request never landed"
+        time.sleep(0.01)
+    # the single slot is held: overflow must be a prompt typed 503
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url, timeout=30)
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == 503
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    assert elapsed < 5.0  # nowhere near the 30 s assignment timeout
+    _kv_put(_GATE_KEY, b"1")  # release the in-flight request
+    t.join(timeout=30)
+    assert results["first"] == (200, b"done")
+    # router-side rejections never touch a replica, so only the
+    # router's pushed counter can surface them — poll until the
+    # piggyback folds it into the deployment's overload panel
+    deadline = time.monotonic() + 30
+    rejected = 0.0
+    while time.monotonic() < deadline:
+        rejected = serve.status()["sticky"]["Sticky"]["overload"][
+            "rejected_total"
+        ]
+        if rejected >= 1:
+            break
+        time.sleep(0.25)
+    assert rejected >= 1
+
+
+def test_slo_autoscaler_scales_up_down_with_graceful_drain(serve_instance):
+    """The autoscaling e2e: load signals flow replica->health-check
+    piggyback->controller->AutoscalingPolicy ONLY (no router-pushed
+    metrics are involved for SLO deployments).  High reported TTFT
+    scales 1->N; idle scales back to 1 with graceful drain — in-flight
+    requests on the victims run to completion and the drain hooks
+    fire."""
+
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ttft_s": 0.1,
+            "upscale_delay_s": 0.2, "downscale_delay_s": 0.3,
+            "look_back_period_s": 0.6, "hysteresis": 0.1,
+        },
+        max_ongoing_requests=16,
+        health_check_period_s=0.2,
+        graceful_shutdown_timeout_s=10.0,
+    )
+    class FakeEngine:
+        """Load signals come from the cluster KV so every replica
+        reports the SAME numbers — the scaling decision is then a pure
+        function of controller-collected stats."""
+
+        def stats(self):
+            from ray_tpu.core.runtime import get_runtime
+
+            raw = get_runtime().kv_get(_LOAD_KEY)
+            if not raw:
+                return {"queue_depth": 0.0, "ttft_ema_s": 0.0}
+            return json.loads(raw)
+
+        async def work(self, duration_s):
+            await asyncio.sleep(duration_s)
+            return "ok"
+
+        async def __serve_shutdown__(self):
+            # the hook runs on the actor's io loop: push the blocking
+            # KV write to a pool thread
+            def _mark():
+                from ray_tpu.core.runtime import get_runtime
+
+                get_runtime().kv_put(_DRAIN_KEY, b"1")
+
+            await asyncio.get_running_loop().run_in_executor(None, _mark)
+
+        async def __call__(self, request):
+            return "hi"
+
+    _kv_put(_LOAD_KEY, b"")
+    _kv_put(_DRAIN_KEY, b"")
+    h = serve.run(FakeEngine.bind(), name="slo", route_prefix="/slo")
+
+    def _running():
+        return serve.status()["slo"]["FakeEngine"]["running"]
+
+    assert _running() == 1
+    # sustained overload: TTFT 5x over SLO + real backlog
+    _kv_put(_LOAD_KEY, json.dumps(
+        {"queue_depth": 8.0, "ttft_ema_s": 0.5}
+    ).encode())
+    deadline = time.time() + 60
+    while time.time() < deadline and _running() < 2:
+        time.sleep(0.2)
+    assert _running() >= 2, "TTFT SLO breach never scaled the deployment"
+
+    # load vanishes while slow requests are in flight: the downscale
+    # must drain victims gracefully, not drop their work
+    responses = [h.work.remote(3.0) for _ in range(6)]
+    _kv_put(_LOAD_KEY, json.dumps(
+        {"queue_depth": 0.0, "ttft_ema_s": 0.0}
+    ).encode())
+    assert all(r.result(timeout_s=60) == "ok" for r in responses)
+    deadline = time.time() + 60
+    while time.time() < deadline and _running() != 1:
+        time.sleep(0.2)
+    assert _running() == 1, "idle deployment never scaled back down"
+    # victims leave the status table BEFORE their drain completes:
+    # poll for the hook's marker rather than racing it
+    deadline = time.time() + 30
+    while time.time() < deadline and _kv_get(_DRAIN_KEY) != b"1":
+        time.sleep(0.2)
+    assert _kv_get(_DRAIN_KEY) == b"1", "drain hook never fired"
+    # the serve panel exposes the overload aggregates
+    dep = serve.status()["slo"]["FakeEngine"]
+    assert "overload" in dep
+    assert set(dep["overload"]) == {"rejected_total", "shed_total"}
