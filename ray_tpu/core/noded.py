@@ -144,7 +144,9 @@ class NodeDaemon:
         # spill/restore mutate the store + index from the executor
         # thread (file IO must not stall the io loop — the reference
         # uses dedicated IO workers the same way)
-        self._spill_lock = _threading.Lock()
+        # REENTRANT: a restore under pressure force-spills other
+        # objects while already holding the lock
+        self._spill_lock = _threading.RLock()
         self._actor_locations: Dict[bytes, Tuple[str, str]] = {}
         self.unix_server: Optional[rpc.Server] = None
         self.tcp_server: Optional[rpc.Server] = None
@@ -876,7 +878,8 @@ class NodeDaemon:
     SPILL_HIGH = 0.80
     SPILL_LOW = 0.60
 
-    def _maybe_spill_objects(self, force: bool = False):
+    def _maybe_spill_objects(self, force: bool = False,
+                             drain: bool = False):
         """Runs on an executor thread (sync file IO); serialized by
         _spill_lock against concurrent urgent-spill requests."""
         with self._spill_lock:
@@ -885,7 +888,14 @@ class NodeDaemon:
                 return 0
             if not force and self.store.used <= self.SPILL_HIGH * cap:
                 return 0
-            target = int(self.SPILL_LOW * cap)
+            # a DRAINING forced spill evicts EVERY unpinned object: the
+            # blocked create needs a contiguous region, and free bytes
+            # above the LOW watermark may be too fragmented to satisfy
+            # it — stopping at the watermark can wedge an
+            # allocator-fragmented store forever at 60% used.  Callers
+            # escalate to drain only after watermark-target passes
+            # failed, so brief pressure doesn't dump the working set.
+            target = 0 if (force and drain) else int(self.SPILL_LOW * cap)
             os.makedirs(self._spill_dir, exist_ok=True)
             spilled = 0
             for id_bytes in self.store.spill_candidates(64):
@@ -934,14 +944,24 @@ class NodeDaemon:
                 self._spilled.pop(id_bytes, None)
                 return False
             if not self.store.contains(id_bytes):
-                try:
-                    self.store.put(id_bytes, data, allow_evict=False)
-                except Exception as e:
-                    # still pressured; caller retries after the next
-                    # spill pass frees room
-                    logger.debug("restore of %s blocked: %s",
-                                 id_bytes.hex()[:12], e)
-                    return False
+                for attempt in (0, 1):
+                    try:
+                        self.store.put(id_bytes, data, allow_evict=False)
+                        break
+                    except Exception as e:
+                        if attempt:
+                            # still pressured; caller retries after the
+                            # next spill pass frees room
+                            logger.debug("restore of %s blocked: %s",
+                                         id_bytes.hex()[:12], e)
+                            return False
+                        # make room by force-spilling OTHER unpinned
+                        # objects (full drain: the restore needs a
+                        # contiguous region NOW), then retry once — a
+                        # restore that fails here costs the borrower a
+                        # full lineage re-derivation (_spill_lock is
+                        # reentrant)
+                        self._maybe_spill_objects(force=True, drain=True)
             self._spilled.pop(id_bytes, None)
             try:
                 os.remove(path)
@@ -998,10 +1018,13 @@ class NodeDaemon:
 
     async def handle_spill_now(self, payload, conn):
         """Urgent spill on create-backpressure (the reference's create
-        queue triggering spilling, `create_request_queue.h`)."""
+        queue triggering spilling, `create_request_queue.h`).  The
+        caller escalates `drain` after watermark-target passes failed
+        to unblock its create (fragmentation)."""
+        drain = bool(payload and payload.get("drain"))
         try:
             n = await asyncio.get_running_loop().run_in_executor(
-                None, self._maybe_spill_objects, True
+                None, self._maybe_spill_objects, True, drain
             )
         except Exception:
             logger.exception("urgent spill failed")

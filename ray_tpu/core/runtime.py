@@ -202,6 +202,16 @@ class _RefCount:
     # owner-side borrower identity ledger: address -> count (reference:
     # the owner tracks WHICH workers borrow, `reference_count.h:64`)
     borrower_addrs: Dict[tuple, int] = field(default_factory=dict)
+    # Lineage pins (reference: `reference_count.h` lineage reachability):
+    # +1 per DOWNSTREAM return object whose retained lineage names this
+    # ref as a task argument.  While > 0 the entry (and its lineage
+    # entry, if owned) survives user drops, so reconstructing a lost
+    # downstream object can re-derive its inputs — without this, a
+    # multi-stage pipeline that drops intermediate refs for memory
+    # (the shuffle exchange) loses reconstructability mid-chain.
+    # Released when the downstream object's own lineage entry is popped
+    # at ITS free (cascading the release up the chain).
+    lineage: int = 0
     # creation callsite ("file:line in fn"), recorded only under
     # RT_RECORD_REF_CREATION_SITES=1 (reference:
     # RAY_record_ref_creation_sites + `ray memory` callsite column)
@@ -209,7 +219,7 @@ class _RefCount:
 
     def total(self):
         return (self.local + self.submitted + self.borrowers
-                + self.contained + self.transit)
+                + self.contained + self.transit + self.lineage)
 
 
 @dataclass
@@ -823,6 +833,7 @@ class Runtime:
             from ray_tpu.shm import StoreFullError
 
             deadline = time.time() + 30.0
+            attempts = 0
             while True:
                 try:
                     dest = self.store.create(
@@ -833,9 +844,15 @@ class Runtime:
                     if time.time() > deadline:
                         raise
                     try:
-                        self.noded_call("spill_now", None, timeout=10)
+                        # watermark spills first, full drain once the
+                        # create stays blocked (fragmentation)
+                        self.noded_call(
+                            "spill_now", {"drain": attempts >= 2},
+                            timeout=10,
+                        )
                     except Exception as e:
                         logger.debug("spill_now nudge failed: %s", e)
+                    attempts += 1
                     time.sleep(0.05)
             ser.write_chunks(chunks, dest)
             del dest
@@ -962,11 +979,13 @@ class Runtime:
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
                 spec, spec.max_retries, transit
             )
+            n_lineage = len(refs)  # one retained lineage entry per return
             for a in spec.args:
                 if isinstance(a, ArgRef):
                     rc = self.refs.get(a.id_bytes)
                     if rc:
                         rc.submitted += 1
+                        rc.lineage += n_lineage
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
         # per-shard accounting (normal tasks): pairs with the completed
         # bump at the exactly-once pop in completion.complete_task
@@ -1493,11 +1512,14 @@ class Runtime:
             self.pending_tasks[spec.task_id.binary()] = _PendingTask(
                 spec, spec.max_retries, transit
             )
+            # lineage entries exist only for retry-opted calls (above)
+            n_lineage = len(refs) if spec.max_retries > 0 else 0
             for a in spec.args:
                 if isinstance(a, ArgRef):
                     rc = self.refs.get(a.id_bytes)
                     if rc:
                         rc.submitted += 1
+                        rc.lineage += n_lineage
             if handle._address is not None:
                 self._actor_addr.setdefault(aid, tuple(handle._address))
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
@@ -1715,6 +1737,24 @@ class Runtime:
         out = _unwrap(tag, val)
         if isinstance(out, _np.ndarray):
             weakref.finalize(out, self._release_pin, id_bytes)
+        elif (isinstance(out, dict) and out
+              and all(isinstance(v, _np.ndarray) for v in out.values())):
+            # a column block (dict of arrays, each possibly a zero-copy
+            # view into this buffer): release the pin when the LAST
+            # array is collected.  The former process-lifetime pin here
+            # made every fetched block permanently unspillable, which
+            # wedged any shuffle larger than the object store.
+            release = self._release_pin
+            remaining = [len(out)]
+
+            def _dec(remaining=remaining, release=release,
+                     id_bytes=id_bytes):
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    release(id_bytes)
+
+            for v in out.values():
+                weakref.finalize(v, _dec)
         else:
             self._held_pins.add(id_bytes)  # process-lifetime pin
         return out
@@ -1852,7 +1892,13 @@ class Runtime:
             st = self.objects[ref.binary()]
             st.ready = asyncio.Event()
             st.where = None
-            self.pending_tasks[spec.task_id.binary()] = _PendingTask(spec, 0)
+            # the resubmit keeps the spec's retry budget: a worker
+            # killed DURING re-derivation (chaos mid-epoch) must retry
+            # like any other attempt, not permanently fail the object —
+            # the budget still bounds total attempts per resubmission
+            self.pending_tasks[spec.task_id.binary()] = _PendingTask(
+                spec, spec.max_retries
+            )
             if spec.actor_id is None:
                 # lineage resubmits count as submissions so per-shard
                 # submitted/completed stay balanced (shard.lock nests
@@ -2071,7 +2117,18 @@ class Runtime:
         if rc.registered and rc.owner_addr:
             self._send_remove_borrow(id_bytes, rc.owner_addr)
         st = self.objects.pop(id_bytes, None)
-        self.lineage.pop(id_bytes, None)
+        spec = self.lineage.pop(id_bytes, None)
+        if spec is not None:
+            # this object's lineage no longer needs its inputs: release
+            # the lineage pins it held on the spec's args (cascades up
+            # the chain — freeing a shuffle output unpins its pieces,
+            # which unpin the read blocks)
+            for a in spec.args:
+                if isinstance(a, ArgRef):
+                    arc = self.refs.get(a.id_bytes)
+                    if arc and arc.lineage > 0:
+                        arc.lineage -= 1
+                        self._maybe_free(a.id_bytes)
         self._release_contained(id_bytes)
         if st is None:
             return
@@ -3227,26 +3284,52 @@ class Runtime:
         return index
 
     async def _create_with_backpressure(self, id_bytes: bytes, total: int,
-                                        timeout_s: float = 30.0):
+                                        timeout_s: float = 60.0):
         """Blocking-create semantics (reference: plasma's
         create_request_queue.h — creates wait under memory pressure
         instead of failing): on a full store, ask the node daemon to
-        spill urgently and retry until the deadline."""
-        from ray_tpu.shm import StoreFullError
+        spill urgently and retry until the deadline.
+
+        Returns None when a SEALED copy already exists: a prior attempt
+        of this task (a retry after a mid-packaging failure, or a
+        lineage resubmit racing a concurrent restore) already produced
+        this return — task bodies on this plane are deterministic, so
+        the existing bytes ARE this attempt's value and the caller
+        skips the write.  An UNSEALED collision is a dead attempt's
+        partial write: delete it and recreate."""
+        from ray_tpu.shm import ObjectExistsError, StoreFullError
 
         deadline = time.time() + timeout_s
+        attempts = 0
         while True:
             try:
                 # no destructive eviction: pressure resolves by spilling
                 # (primaries survive on disk) rather than data loss
                 return self.store.create(id_bytes, total, allow_evict=False)
+            except ObjectExistsError:
+                if self.store.contains(id_bytes):  # sealed: reuse
+                    return None
+                self.store.delete(id_bytes)
+                if time.time() > deadline:
+                    raise
+                # the collision may be an unsealed entry pinned by a
+                # live writer (e.g. a concurrent restore): yield the
+                # loop instead of spinning hot until it seals or dies
+                await asyncio.sleep(0.05)  # rtlint: disable=RT006 - local store-state poll, not a networked retry storm
             except StoreFullError:
                 if time.time() > deadline:
                     raise
                 try:
-                    await self.noded.call("spill_now", None, timeout=10)
+                    # escalate: watermark-target spills first; if the
+                    # create is still blocked after a few passes (free
+                    # bytes too fragmented for a contiguous region),
+                    # drain every unpinned object
+                    await self.noded.call(
+                        "spill_now", {"drain": attempts >= 2}, timeout=10
+                    )
                 except Exception as e:
                     logger.debug("spill_now nudge failed: %s", e)
+                attempts += 1
                 await asyncio.sleep(0.05)
 
     async def _package_returns(self, spec: TaskSpec, value) -> List[Tuple]:
@@ -3304,9 +3387,10 @@ class Runtime:
             ser.write_chunks(chunks, memoryview(buf))
             return (_INLINE, bytes(buf), contained)
         dest = await self._create_with_backpressure(oid.binary(), total)
-        ser.write_chunks(chunks, dest)
-        del dest
-        self.store.seal(oid.binary())
+        if dest is not None:  # None: a prior attempt's sealed copy stands
+            ser.write_chunks(chunks, dest)
+            del dest
+            self.store.seal(oid.binary())
         return (_SHM, self.node_id, total, contained)
 
     async def _load_function(self, spec: TaskSpec):

@@ -14,9 +14,12 @@ at entry.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 try:
     import pyarrow as pa
@@ -141,7 +144,11 @@ def column_numpy(block: Block, name: str) -> np.ndarray:
         col = block.column(name)
         try:
             return col.to_numpy(zero_copy_only=False)
-        except Exception:
+        except Exception as e:
+            # nested/extension arrow types have no numpy conversion:
+            # fall back through python lists (slow path, keep visible)
+            logger.debug("arrow->numpy fast path failed for column "
+                         "%r (%s); using to_pylist", name, e)
             return np.asarray(col.to_pylist())
     return block[name]
 
@@ -181,7 +188,9 @@ def _dict_from_arrow(table) -> Dict[str, np.ndarray]:
         col = table.column(name)
         try:
             out[name] = col.to_numpy(zero_copy_only=False)
-        except Exception:
+        except Exception as e:
+            logger.debug("arrow->numpy fast path failed for column "
+                         "%r (%s); using to_pylist", name, e)
             out[name] = np.asarray(col.to_pylist())
     return out
 

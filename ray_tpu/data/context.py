@@ -23,6 +23,29 @@ class DataContext:
     max_stage_inflight_bytes: int = 256 * 1024 * 1024
     #: pipelined calls per actor in actor-pool map stages
     actor_pool_pipeline_depth: int = 2
+    #: retries for every data-plane task (read/map/shuffle map+reduce):
+    #: a SIGKILLed worker retries through the core worker-died path and
+    #: lost output blocks re-derive via lineage reconstruction, so one
+    #: dead worker never costs an epoch
+    data_task_max_retries: int = 4
+    #: hard bound on how long an admission point may block making zero
+    #: progress before surfacing a typed BackPressureError (never an
+    #: unbounded queue, never a silent hang)
+    backpressure_timeout_s: float = 120.0
+    #: rows sampled per input block when a shuffle needs range
+    #: boundaries (sort / groupby)
+    shuffle_sample_rows: int = 64
+    #: fraction of the node's object-store budget a stage may hold
+    #: in flight (pinned inputs + outputs of running tasks).  The
+    #: effective per-stage byte cap is
+    #: min(max_stage_inflight_bytes, fraction * store_capacity) — the
+    #: reference resource manager budgets operator memory against the
+    #: store the same way, which is what lets an over-memory shuffle
+    #: complete via spilling instead of wedging on pinned bytes
+    store_memory_fraction: float = 0.25
+    #: override the reduce-partition count for shuffles (None: one
+    #: partition per input block; repartition always uses its target)
+    shuffle_partitions: Optional[int] = None
 
     @staticmethod
     def get_current() -> "DataContext":

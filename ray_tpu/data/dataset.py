@@ -20,7 +20,7 @@ from ray_tpu.data import aggregate as agg_mod
 from ray_tpu.data import block as B
 from ray_tpu.data import datasource as ds_mod
 from ray_tpu.data.executor import StreamingExecutor
-from ray_tpu.data.plan import AllToAllOp, LimitOp, LogicalPlan, MapOp, ReadOp
+from ray_tpu.data.plan import LimitOp, LogicalPlan, MapOp, ReadOp
 
 DEFAULT_PARALLELISM = 8
 
@@ -29,6 +29,10 @@ class Dataset:
     def __init__(self, plan: LogicalPlan):
         self._plan = plan
         self._cached_pairs: Optional[List] = None  # materialized (ref, meta)
+        # cached elastic split coordinator: (actor_handle, equal) — set
+        # by streaming_split(elastic=True) so ingest reshards with the
+        # training mesh instead of restarting the epoch
+        self._split_coord = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -244,51 +248,25 @@ class Dataset:
         test._cached_pairs = test_pairs
         return train, test
 
-    # ---- all-to-all ---------------------------------------------------
+    # ---- all-to-all (distributed shuffle, `data/shuffle.py`) ---------
     def repartition(self, num_blocks: int) -> "Dataset":
-        def op(blocks: List[B.Block]) -> List[B.Block]:
-            full = B.concat(blocks)
-            n = B.num_rows(full)
-            bounds = np.linspace(0, n, num_blocks + 1, dtype=np.int64)
-            return [
-                B.slice_block(full, int(bounds[i]), int(bounds[i + 1]))
-                for i in builtins.range(num_blocks)
-            ]
+        from ray_tpu.data.shuffle import repartition_op
 
-        return self._with_op(AllToAllOp(op, name="AllToAll(repartition)"))
+        return self._with_op(repartition_op(num_blocks))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        def op(blocks: List[B.Block]) -> List[B.Block]:
-            k = max(1, len(blocks))
-            full = B.concat(blocks)
-            n = B.num_rows(full)
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(n)
-            shuffled = B.take_indices(full, perm)
-            bounds = np.linspace(0, n, k + 1, dtype=np.int64)
-            return [
-                B.slice_block(shuffled, int(bounds[i]), int(bounds[i + 1]))
-                for i in builtins.range(k)
-            ]
+        from ray_tpu.data.shuffle import random_shuffle_op
 
-        return self._with_op(AllToAllOp(op, name="AllToAll(random_shuffle)"))
+        return self._with_op(random_shuffle_op(seed))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        def op(blocks: List[B.Block]) -> List[B.Block]:
-            k = max(1, len(blocks))
-            full = B.concat(blocks)
-            order = np.argsort(B.column_numpy(full, key), kind="stable")
-            if descending:
-                order = order[::-1]
-            out = B.take_indices(full, order)
-            n = B.num_rows(out)
-            bounds = np.linspace(0, n, k + 1, dtype=np.int64)
-            return [
-                B.slice_block(out, int(bounds[i]), int(bounds[i + 1]))
-                for i in builtins.range(k)
-            ]
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.shuffle import sort_op
 
-        return self._with_op(AllToAllOp(op, name="AllToAll(sort)"))
+        return self._with_op(sort_op(
+            key, descending,
+            sample_rows=DataContext.get_current().shuffle_sample_rows,
+        ))
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -398,13 +376,13 @@ class Dataset:
             print(row)
 
     def count(self) -> int:
-        import ray_tpu as rt
+        from ray_tpu.data.executor import resolve_metas
 
-        total = 0
-        for _, meta in self._pairs():
-            m = meta if isinstance(meta, dict) else rt.get(meta)
-            total += m["num_rows"]
-        return total
+        # one batched metadata get, not one blocking get per block
+        return builtins.sum(
+            m["num_rows"]
+            for m in resolve_metas([meta for _, meta in self._pairs()])
+        )
 
     def schema(self) -> Optional[Dict[str, np.dtype]]:
         for blk in self._iter_blocks():
@@ -421,13 +399,12 @@ class Dataset:
         return sum(1 for _ in self._pairs())
 
     def size_bytes(self) -> int:
-        import ray_tpu as rt
+        from ray_tpu.data.executor import resolve_metas
 
-        total = 0
-        for _, meta in self._pairs():
-            m = meta if isinstance(meta, dict) else rt.get(meta)
-            total += m.get("size_bytes", 0)
-        return total
+        return builtins.sum(
+            m.get("size_bytes", 0)
+            for m in resolve_metas([meta for _, meta in self._pairs()])
+        )
 
     def to_pandas(self):
         return B.to_pandas(B.concat(list(self._iter_blocks())))
@@ -435,14 +412,10 @@ class Dataset:
     def materialize(self) -> "Dataset":
         """Execute now; the result holds block refs (reference:
         `Dataset.materialize` -> MaterializedDataset)."""
-        import ray_tpu as rt
+        from ray_tpu.data.executor import resolve_pairs
 
-        pairs = []
-        for ref, meta in self._pairs():
-            m = meta if isinstance(meta, dict) else rt.get(meta)
-            pairs.append((ref, m))
         out = Dataset(LogicalPlan([ReadOp([], name="Materialized")]))
-        out._cached_pairs = pairs
+        out._cached_pairs = resolve_pairs(list(self._pairs()))
         return out
 
     def stats(self) -> str:
@@ -461,10 +434,21 @@ class Dataset:
             out.append(d)
         return out
 
-    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        elastic: bool = False) -> List["DataIterator"]:
+        """N concurrent consumers over ONE shared streaming execution.
+
+        With ``elastic=True`` the split coordinator is cached on this
+        dataset and survives consumer re-formation: a later
+        ``streaming_split(m, elastic=True)`` RESHARDS the in-progress
+        epoch to ``m`` consumers instead of restarting it — delivered-
+        but-unacknowledged blocks are requeued, acknowledged blocks are
+        never redelivered, so every block is consumed exactly once
+        across a mesh shrink/re-grow (the elastic-training ingest
+        path, `train/backend_executor.py`)."""
         from ray_tpu.data.iterator import make_streaming_split
 
-        return make_streaming_split(self, n, equal=equal)
+        return make_streaming_split(self, n, equal=equal, elastic=elastic)
 
     # ---- writes -------------------------------------------------------
     def _write(self, write_factory, path: str) -> int:
@@ -528,29 +512,13 @@ class GroupedData:
         self._key = key
 
     def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dataset:
-        key = self._key
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.shuffle import groupby_aggregate_op
 
-        def op(blocks: List[B.Block]) -> List[B.Block]:
-            groups: Dict[Any, List[Any]] = {}
-            for blk in blocks:
-                keys = B.column_numpy(blk, key)
-                for g in np.unique(keys):
-                    idx = np.nonzero(keys == g)[0]
-                    sub = B.ensure_numpy(B.take_indices(blk, idx))
-                    gk = g.item() if hasattr(g, "item") else g
-                    st = groups.setdefault(gk, [a.init() for a in aggs])
-                    for i, a in enumerate(aggs):
-                        col = sub[a.on] if a.on else np.empty(B.num_rows(sub))
-                        st[i] = a.accumulate_block(st[i], col)
-            rows = []
-            for gk in sorted(groups):
-                row = {key: gk}
-                for a, s in zip(aggs, groups[gk]):
-                    row[a.name] = a.finalize(s)
-                rows.append(row)
-            return [B.from_rows(rows)]
-
-        return self._ds._with_op(AllToAllOp(op, name="AllToAll(groupby)"))
+        return self._ds._with_op(groupby_aggregate_op(
+            self._key, tuple(aggs),
+            sample_rows=DataContext.get_current().shuffle_sample_rows,
+        ))
 
     def count(self) -> Dataset:
         return self.aggregate(agg_mod.Count())
@@ -571,20 +539,13 @@ class GroupedData:
         return self.aggregate(agg_mod.Std(on, ddof))
 
     def map_groups(self, fn: Callable[[B.Block], Any]) -> Dataset:
-        key = self._key
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.shuffle import map_groups_op
 
-        def op(blocks: List[B.Block]) -> List[B.Block]:
-            full = B.concat(blocks)
-            keys = B.column_numpy(full, key)
-            out: List[B.Block] = []
-            for g in np.unique(keys):
-                sub = B.ensure_numpy(
-                    B.take_indices(full, np.nonzero(keys == g)[0])
-                )
-                out.append(_coerce_batch(fn(sub)))
-            return out
-
-        return self._ds._with_op(AllToAllOp(op, name="AllToAll(map_groups)"))
+        return self._ds._with_op(map_groups_op(
+            self._key, fn,
+            sample_rows=DataContext.get_current().shuffle_sample_rows,
+        ))
 
 
 def _zip_task(n_left: int, *blocks):

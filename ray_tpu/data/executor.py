@@ -11,10 +11,22 @@ object plane without ever being gathered on the driver.  Every task
 returns (block, metadata) as two objects, so the driver reads row
 counts without fetching payloads (the reference's Block/BlockMetadata
 split, `data/block.py`).
+
+Fault model: every data-plane task is submitted with
+`DataContext.data_task_max_retries`, so a worker SIGKILLed mid-epoch
+retries through the core worker-died path, and a block evicted/lost
+AFTER its task completed re-derives via lineage reconstruction when a
+consumer pulls it — the epoch keeps streaming either way.
+Unrecoverable losses (retries exhausted, lineage gone) surface as the
+core plane's typed errors (`WorkerCrashedError`, `ObjectLostError`,
+`ObjectReconstructionFailedError`) at the consuming `rt.get`, never as
+a hang.  Shuffles run as a distributed map/reduce exchange
+(`data/shuffle.py`), not a single gather task.
 """
 
 from __future__ import annotations
 
+import logging
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -23,15 +35,42 @@ from ray_tpu.data import block as B
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.plan import (
     ActorMapOp,
-    AllToAllOp,
     LimitOp,
     LogicalPlan,
     MapOp,
     ReadOp,
+    ShuffleOp,
 )
+
+logger = logging.getLogger(__name__)
 
 # (block_ref, meta_ref-or-value)
 RefPair = Tuple[Any, Any]
+
+
+def resolve_metas(metas: List[Any]) -> List[Dict[str, Any]]:
+    """Materialize a list of metadata entries with ONE batched
+    `rt.get` for the unresolved refs (dicts pass through).  The old
+    per-block blocking `rt.get` serialized the whole stream on
+    driver-side metadata fetches; batching lets metadata reads ride
+    the pipeline."""
+    refs, slots = [], []
+    out: List[Any] = list(metas)
+    for i, m in enumerate(out):
+        if not isinstance(m, dict):
+            refs.append(m)
+            slots.append(i)
+    if refs:
+        for i, v in zip(slots, rt.get(refs)):
+            out[i] = v
+    return out
+
+
+def resolve_pairs(pairs: List[RefPair]) -> List[RefPair]:
+    """(ref, meta_ref) pairs -> (ref, meta_dict) pairs, metadata
+    fetched in one batch."""
+    metas = resolve_metas([m for _, m in pairs])
+    return [(ref, m) for (ref, _), m in zip(pairs, metas)]
 
 
 def _run_read_task(read_task: Callable[[], List[B.Block]]):
@@ -44,15 +83,6 @@ def _run_map_task(fn: Callable[[B.Block], List[B.Block]], blk: B.Block):
     outs = fn(blk)
     out = B.concat(outs) if len(outs) != 1 else outs[0]
     return out, {"num_rows": B.num_rows(out), "size_bytes": B.size_bytes(out)}
-
-
-def _run_alltoall_task(fn: Callable[[List[B.Block]], List[B.Block]], *blocks):
-    outs = fn(list(blocks))
-    pairs = []
-    for b in outs:
-        ref = rt.put(b)
-        pairs.append((ref, {"num_rows": B.num_rows(b), "size_bytes": B.size_bytes(b)}))
-    return pairs
 
 
 class _BatchMapWorker:
@@ -90,13 +120,64 @@ class StreamingExecutor:
     def __init__(self, plan: LogicalPlan, *, window: Optional[int] = None,
                  num_cpus: float = 1.0):
         ctx = DataContext.get_current()
+        self.ctx = ctx
         self.plan = plan.optimized()
         self.window = window if window is not None else ctx.window
         self.max_stage_bytes = ctx.max_stage_inflight_bytes
+        # budget in-flight bytes against the node's object store: a
+        # running task PINS its inputs and outputs, and pinned bytes
+        # can neither spill nor evict — unbounded in-flight pins on a
+        # small store wedge every create.  (The 2x in the shuffle
+        # admission below accounts input + output per task.)
+        cap = self._store_capacity()
+        if cap > 0:
+            self.max_stage_bytes = min(
+                self.max_stage_bytes,
+                max(1, int(cap * ctx.store_memory_fraction)),
+            )
         self._actor_depth = ctx.actor_pool_pipeline_depth
-        self._remote_opts = {"num_cpus": num_cpus, "num_returns": 2}
+        self.task_num_cpus = num_cpus
+        self._remote_opts = {
+            "num_cpus": num_cpus,
+            "num_returns": 2,
+            # worker death mid-epoch retries instead of killing the
+            # stream; lineage reconstruction rides the same budget
+            "max_retries": ctx.data_task_max_retries,
+        }
         self._meta_sizes: Dict[bytes, int] = {}
         self.stats: Dict[str, Any] = {"stages": self.plan.describe(), "tasks": 0}
+
+    @staticmethod
+    def _store_capacity() -> int:
+        try:
+            from ray_tpu.core.runtime import get_runtime, is_initialized
+
+            if is_initialized():
+                return int(getattr(get_runtime().store, "capacity", 0) or 0)
+        except Exception as e:
+            logger.debug("object-store capacity probe failed: %s", e)
+        return 0
+
+    # -- metadata ------------------------------------------------------
+    def resolve_metas(self, metas: List[Any]) -> List[Dict[str, Any]]:
+        return resolve_metas(metas)
+
+    def resolve_pairs(self, pairs: List[RefPair]) -> List[RefPair]:
+        return resolve_pairs(pairs)
+
+    def _resolved_meta_stream(self, stream: Iterator[RefPair]
+                              ) -> Iterator[RefPair]:
+        """Stream adapter: yields (ref, meta_dict) with metadata
+        resolved in window-sized batches — a bounded lookahead instead
+        of one blocking driver get per block."""
+        buf: List[RefPair] = []
+        for pair in stream:
+            buf.append(pair)
+            if len(buf) >= self.window:
+                yield from self.resolve_pairs(buf)
+                buf = []
+        if buf:
+            yield from self.resolve_pairs(buf)
 
     # -- stage generators ---------------------------------------------
     def _read_stream(self, op: ReadOp) -> Iterator[RefPair]:
@@ -123,8 +204,8 @@ class StreamingExecutor:
         cache = self._meta_sizes
         try:
             key = meta.binary()
-        except Exception:
-            key = None
+        except AttributeError:
+            key = None  # plain value, not a ref — no cache slot
         if key is not None and key in cache:
             return cache[key]
         try:
@@ -136,8 +217,10 @@ class StreamingExecutor:
                         cache.clear()
                     cache[key] = size
                 return size
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort probe: fall through to "unknown size" but
+            # keep the cause visible for the next incident
+            logger.debug("in-flight size probe failed: %s", e)
         return 0
 
     def _map_stream(self, stream: Iterator[RefPair], op: MapOp) -> Iterator[RefPair]:
@@ -224,27 +307,30 @@ class StreamingExecutor:
             for a in actors:
                 try:
                     rt.kill(a)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # pool teardown is best-effort: the actor may
+                    # already be gone (its worker died mid-stream)
+                    logger.debug("actor pool teardown kill failed: %s", e)
 
-    def _alltoall_stream(self, stream: Iterator[RefPair],
-                         op: AllToAllOp) -> Iterator[RefPair]:
-        pairs = list(stream)  # barrier
-        refs = [p[0] for p in pairs]
-        a2a_remote = rt.remote(_run_alltoall_task).options(
-            num_cpus=self._remote_opts["num_cpus"]
-        )
-        self.stats["tasks"] += 1
-        out_pairs = rt.get(a2a_remote.remote(op.fn, *refs))
-        yield from out_pairs
+    def _shuffle_stream(self, stream: Iterator[RefPair],
+                        op: ShuffleOp) -> Iterator[RefPair]:
+        """Distributed map-partition -> reduce-partition exchange; the
+        single-task AllToAll gather barrier this replaced is gone —
+        see `data/shuffle.py` for the fault/memory model."""
+        from ray_tpu.data import shuffle as _shuffle
+
+        yield from _shuffle.run_shuffle(self, stream, op)
 
     def _limit_stream(self, stream: Iterator[RefPair], op: LimitOp) -> Iterator[RefPair]:
         remaining = op.limit
         slice_remote = rt.remote(_slice_task).options(**self._remote_opts)
-        for block_ref, meta in stream:
+        # metadata resolves in window-sized batches (bounded lookahead)
+        # so the row-count reads ride the pipeline instead of issuing
+        # one blocking driver-side get per block
+        for block_ref, meta in self._resolved_meta_stream(stream):
             if remaining <= 0:
                 break
-            n = self._meta(meta)["num_rows"]
+            n = meta["num_rows"]
             if n <= remaining:
                 remaining -= n
                 yield block_ref, meta
@@ -252,12 +338,6 @@ class StreamingExecutor:
                 self.stats["tasks"] += 1
                 yield tuple(slice_remote.remote(block_ref, remaining))
                 remaining = 0
-
-    @staticmethod
-    def _meta(meta) -> Dict[str, Any]:
-        if isinstance(meta, dict):
-            return meta
-        return rt.get(meta)
 
     # -- public --------------------------------------------------------
     def execute(self) -> Iterator[RefPair]:
@@ -270,8 +350,8 @@ class StreamingExecutor:
                 stream = self._map_stream(stream, op)
             elif isinstance(op, ActorMapOp):
                 stream = self._actor_map_stream(stream, op)
-            elif isinstance(op, AllToAllOp):
-                stream = self._alltoall_stream(stream, op)
+            elif isinstance(op, ShuffleOp):
+                stream = self._shuffle_stream(stream, op)
             elif isinstance(op, LimitOp):
                 stream = self._limit_stream(stream, op)
             else:

@@ -49,12 +49,31 @@ class ActorMapOp:
 
 
 @dataclass
-class AllToAllOp:
-    """Barrier: List[Block] -> List[Block] (repartition, shuffle, sort,
-    groupby reduce)."""
+class ShuffleOp:
+    """Distributed map-partition -> reduce-partition exchange
+    (repartition, random_shuffle, sort, groupby) — the reference's
+    push-based shuffle (`data/_internal/planner/exchange/`).  Replaces
+    the old single-task AllToAll barrier: every map task partitions one
+    input block into `num_partitions` pieces returned as separate
+    lineage-backed objects, and every reduce task merges one
+    partition's pieces, so a lost worker re-derives only its own
+    blocks and an over-memory exchange spills through the object
+    store instead of OOMing a gather task.
 
-    fn: Callable[[List[B.Block]], List[B.Block]]
-    name: str = "AllToAll"
+    `map_fn(block, block_index, num_partitions, aux) -> [P pieces]`;
+    `reduce_fn(pieces, partition_index, aux) -> block`.  `aux` is the
+    small plan-level payload (range boundaries, block offsets) built
+    by `aux_fn(samples, metas, P)` after the optional `sample_fn` pass
+    over input blocks.  Both fns MUST be deterministic: lineage
+    reconstruction re-runs them to rebuild lost blocks mid-stream.
+    """
+
+    map_fn: Callable[[B.Block, int, int, Any], List[B.Block]]
+    reduce_fn: Callable[[List[B.Block], int, Any], B.Block]
+    num_partitions: Optional[int] = None
+    sample_fn: Optional[Callable[[B.Block], Any]] = None
+    aux_fn: Optional[Callable[[List[Any], List[Dict[str, Any]], int], Any]] = None
+    name: str = "Shuffle"
 
 
 @dataclass
@@ -63,7 +82,7 @@ class LimitOp:
     name: str = "Limit"
 
 
-Op = Any  # ReadOp | MapOp | AllToAllOp | LimitOp
+Op = Any  # ReadOp | MapOp | ShuffleOp | LimitOp
 
 
 @dataclass

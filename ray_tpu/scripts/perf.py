@@ -970,6 +970,75 @@ def measure_elastic_recovery(*, num_workers: int = 2, num_steps: int = 12,
     return {"elastic_recovery": row}
 
 
+def measure_data_shuffle(*, rows: int = 3_200_000,
+                         store_mb: int = 12) -> Dict[str, Dict[str, float]]:
+    """`--config data_shuffle`: throughput of a repartition+sort
+    exchange over a dataset ~2x the object-store budget — the
+    distributed shuffle must complete THROUGH the spilling plane
+    (pinned in-flight bytes bounded by the store-aware stage budget,
+    `data/shuffle.py`), with exact row accounting.  Structural shape
+    tier-1-gated in `tests/test_perf_harness.py`; measured numbers
+    live in PERF.md."""
+    import glob
+
+    import numpy as np
+
+    import ray_tpu as rt
+    import ray_tpu.api as api
+    import ray_tpu.data as rd
+
+    if rt.is_initialized():
+        raise RuntimeError(
+            "--config data_shuffle sizes its own object store: run "
+            "with no runtime initialized"
+        )
+    store_bytes = store_mb * 1024 * 1024
+    dataset_bytes = rows * 8  # one int64 column
+    rt.init(num_workers=2, num_cpus=4, object_store_memory=store_bytes)
+    try:
+        ds = rd.range(rows, parallelism=12).repartition(8).sort(
+            "id", descending=True
+        )
+        t0 = time.perf_counter()
+        total = 0
+        checksum = 0
+        ordered = True
+        prev = None
+        for batch in ds.iter_batches(batch_size=200_000):
+            ids = batch["id"]
+            total += len(ids)
+            checksum += int(ids.sum())
+            if np.any(np.diff(ids) > 0) or (
+                prev is not None and ids[0] > prev
+            ):
+                ordered = False
+            prev = int(ids[-1])
+        elapsed = time.perf_counter() - t0
+        sd = api._session.get("session_dir")
+        spill_bytes = sum(
+            os.path.getsize(f) for f in glob.glob(f"{sd}/spilled/*.bin")
+        )
+        row = {
+            "rows": float(rows),
+            "rows_per_s": round(total / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+            "dataset_bytes": float(dataset_bytes),
+            "store_bytes": float(store_bytes),
+            "store_ratio": round(dataset_bytes / store_bytes, 2),
+            "spill_bytes": float(spill_bytes),
+            "rows_out": float(total),
+            "rows_exact": float(
+                total == rows and checksum == rows * (rows - 1) // 2
+            ),
+            "globally_sorted": float(ordered),
+        }
+    finally:
+        rt.shutdown()
+    print("data_shuffle: " + ", ".join(
+        f"{k}={v}" for k, v in row.items()), flush=True)
+    return {"data_shuffle": row}
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -1014,6 +1083,12 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "kill->first-post-recovery-step latencies")
     p.add_argument("--elastic-workers", type=int, default=2)
     p.add_argument("--elastic-steps", type=int, default=12)
+    p.add_argument("--config", default=None, choices=["data_shuffle"],
+                   help="named measurement config (data_shuffle: "
+                        "repartition+sort of a dataset ~2x the object "
+                        "store, rows/s + spill bytes)")
+    p.add_argument("--shuffle-rows", type=int, default=3_200_000)
+    p.add_argument("--shuffle-store-mb", type=int, default=12)
     p.add_argument("--envelope", action="store_true",
                    help="run the scalability-envelope rows INSTEAD of "
                         "the microbenchmark matrix (reference: "
@@ -1035,6 +1110,16 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     import signal
 
     faulthandler.register(signal.SIGUSR1)
+
+    if args.config == "data_shuffle":
+        results = measure_data_shuffle(
+            rows=args.shuffle_rows, store_mb=args.shuffle_store_mb
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
 
     if args.engine_trace or args.overload:
         # no cluster: the engine is driven in-process on the CPU backend

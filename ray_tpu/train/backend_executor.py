@@ -60,15 +60,23 @@ class ElasticWorkerLost(TrainingWorkerError):
 
 
 def _split_datasets(
-    datasets: Optional[Dict[str, Any]], n: int
+    datasets: Optional[Dict[str, Any]], n: int, *, elastic: bool = False
 ) -> List[Dict[str, Any]]:
     """Per-worker dataset shards.  `Dataset`s split via streaming_split
     (reference `train/_internal/data_config.py`); lists shard
-    round-robin; everything else is replicated."""
+    round-robin; everything else is replicated.
+
+    Elastic runs split with ``elastic=True``: the split coordinator is
+    cached on the dataset, so a re-form after a mesh shrink/re-grow
+    RESHARDS the in-progress epoch to the new width — in-flight blocks
+    of lost ranks are redelivered to survivors, consumed blocks are
+    never replayed (exactly-once ingest across the transition).  The
+    reshard rides the same loss signals the WorkerGroup monitor uses:
+    re-formation is only ever initiated by that detection plane."""
     shards: List[Dict[str, Any]] = [{} for _ in range(n)]
     for name, ds in (datasets or {}).items():
         if hasattr(ds, "streaming_split"):
-            for i, shard in enumerate(ds.streaming_split(n)):
+            for i, shard in enumerate(ds.streaming_split(n, elastic=elastic)):
                 shards[i][name] = shard
         elif isinstance(ds, (list, tuple)):
             for i in range(n):
@@ -171,7 +179,9 @@ class BackendExecutor:
                 self.worker_group, self._backend_config
             )
             n = len(self.worker_group)
-            shards = _split_datasets(datasets, n)
+            shards = _split_datasets(
+                datasets, n, elastic=self._failure_config.elastic
+            )
             refs = []
             for rank, worker in enumerate(self.worker_group.workers):
                 ctx = TrainContext(

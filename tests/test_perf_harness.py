@@ -128,6 +128,30 @@ def test_overload_row():
     assert ttft["concurrency"] == 2 * 4.0  # 2x the engine's slots
 
 
+def test_data_shuffle_row():
+    """`--config data_shuffle`: the over-memory shuffle acceptance row,
+    structurally validated (throughput numbers live in PERF.md):
+    - the dataset really exceeded the store (2x budget) and the
+      exchange completed THROUGH spilling (spill_bytes > 0);
+    - exact row accounting: every input row came out exactly once
+      (count + checksum), globally sorted — no single-task AllToAll
+      gather barrier could survive this store budget."""
+    from ray_tpu.scripts.perf import main
+
+    results = main([
+        "--config", "data_shuffle",
+        "--shuffle-rows", "3200000",
+        "--shuffle-store-mb", "12",
+    ])
+    row = results["data_shuffle"]
+    assert row["rows_per_s"] > 0
+    assert row["store_ratio"] >= 2.0
+    assert row["spill_bytes"] > 0
+    assert row["rows_out"] == row["rows"]
+    assert row["rows_exact"] == 1.0
+    assert row["globally_sorted"] == 1.0
+
+
 def test_pin_cores_rejects_oversubscription():
     import os
 
