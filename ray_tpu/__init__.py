@@ -36,12 +36,12 @@ from ray_tpu.core.object_ref import ObjectRef
 __version__ = "0.1.0"
 
 
-def timeline(filename=None):
-    """Chrome-tracing dump of recent task events (reference:
-    `ray.timeline()`)."""
+def timeline(filename=None, trace_id=None):
+    """Chrome-tracing dump of recent task events merged with the
+    cluster-collected trace spans (reference: `ray.timeline()`)."""
     from ray_tpu.util.state import timeline as _tl
 
-    return _tl(filename)
+    return _tl(filename, trace_id=trace_id)
 
 __all__ = [
     "ActorClass",

@@ -34,6 +34,7 @@ from ray_tpu.core import serialization as ser
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.retry import backoff_delay_s
 from ray_tpu.core.task_spec import TaskResult, TaskResultBatch
+from ray_tpu.metrics import metric_defs as _mdefs
 
 logger = logging.getLogger(__name__)
 
@@ -70,6 +71,7 @@ def complete_task(rt, result: TaskResult) -> list:
                     duration=(result.execution_info or {}).get("duration"),
                 )
                 _count_shard_completion(rt, pt.spec)
+                _obs_completion(rt, pt, "ok")
                 stream = rt._streams.get(result.task_id.binary())
                 if stream is not None:
                     stream.total = int(
@@ -166,6 +168,25 @@ def complete_task(rt, result: TaskResult) -> list:
                         retry_delay * 1000.0,
                         pt.retries_left,
                     )
+                    _mdefs.inc(
+                        "rt_owner_task_retries_total",
+                        tags={"shard": _shard_tag(rt, pt.spec)},
+                    )
+                    # the dead attempt's evidence in the trace: a
+                    # worker killed mid-run exports nothing, so the
+                    # OWNER records the retry decision — one instant
+                    # span per failed attempt, parented to the submit
+                    # context every attempt shares.  Lazy import: the
+                    # util package __init__ pulls core.runtime back in
+                    from ray_tpu.util import tracing as _tracing
+
+                    _tracing.record_instant(
+                        f"retry:{pt.spec.name}",
+                        getattr(pt.spec, "trace_ctx", None),
+                        kind="RETRY",
+                        attempt=pt.attempts,
+                        cause=result.status,
+                    )
                     resubmit = True
             if not resubmit:
                 if pt.deadline_timer is not None:
@@ -175,6 +196,7 @@ def complete_task(rt, result: TaskResult) -> list:
                     error=result.status,
                 )
                 _count_shard_completion(rt, pt.spec)
+                _obs_completion(rt, pt, "failed")
                 if override_err is not None:
                     envelope = ser.serialize_to_bytes(
                         override_err, tag=ser.TAG_ERROR
@@ -247,6 +269,28 @@ def _is_argref(a) -> bool:
     from ray_tpu.core.task_spec import ArgRef
 
     return isinstance(a, ArgRef)
+
+
+def _shard_tag(rt, spec) -> str:
+    if spec.actor_id is not None or not rt._shards:
+        return "actor" if spec.actor_id is not None else "0"
+    from ray_tpu.core.owner_shard import shard_index
+
+    return str(shard_index(spec.task_id.binary(), len(rt._shards)))
+
+
+def _obs_completion(rt, pt, outcome: str):
+    """Gated owner-plane metrics at the exactly-once completion commit:
+    per-shard completion counter + submit-to-completion latency.
+    Caller holds rt._state_lock; metric locks are leaves."""
+    if not _mdefs.enabled():
+        return
+    tag = _shard_tag(rt, pt.spec)
+    _mdefs.inc("rt_owner_tasks_completed_total",
+               tags={"shard": tag, "outcome": outcome})
+    _mdefs.observe("rt_owner_task_latency_seconds",
+                   max(0.0, time.monotonic() - pt.t_submit),
+                   tags={"shard": tag})
 
 
 def _count_shard_completion(rt, spec):

@@ -162,8 +162,24 @@ class Config:
     rpc_connect_timeout_s: float = 30.0
 
     # ---- metrics / events --------------------------------------------
+    #: cadence of the batched obs frames (metrics snapshot + finished
+    #: spans) every process ships to the controller — one frame per
+    #: process per interval, never a per-sample RPC
     metrics_report_interval_ms: int = 2000
     task_events_buffer_size: int = 10000
+    #: core-path metric instrumentation (owner-plane histograms,
+    #: shuffle/train counters) + the metrics half of the obs frames.
+    #: OFF by default — the disabled record helpers cost one bool test
+    #: (measured <3% storm overhead even ON: `perf.py --config
+    #: obs_overhead`, PERF.md).  RT_METRICS_ENABLED propagates to
+    #: children like the tracing flag.
+    metrics_enabled: bool = False
+    #: Prometheus `/metrics` HTTP listener on each node daemon.
+    #: 0 = disabled (default).  A positive port is bound by the HEAD
+    #: daemon (worker daemons take an ephemeral port so one host can
+    #: run many); negative = ephemeral everywhere.  The bound port is
+    #: advertised in node registration (`get_nodes` → "metrics_port").
+    metrics_http_port: int = 0
 
     # ---- paths -------------------------------------------------------
     session_dir: str = ""  # filled at init: /tmp/ray_tpu/session_<ts>

@@ -39,6 +39,8 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.time)
     is_head: bool = False
     conn: Optional[rpc.Connection] = None
+    # the daemon's Prometheus /metrics port (0 = listener disabled)
+    metrics_port: int = 0
 
 
 def filter_by_labels(nodes, label_hard, label_soft):
@@ -104,6 +106,22 @@ class Controller:
         # `dashboard/modules/event/` — lifecycle events surfaced
         # cluster-wide)
         self.cluster_events: deque = deque(maxlen=10_000)
+        # unified observability plane: collected finished spans (the
+        # driver-side trace collector, reference: the GCS-side task
+        # events + otel export pipeline) and the latest metrics
+        # snapshot per reporting process (`ray_tpu/metrics/exporter.py`)
+        from ray_tpu.metrics.exporter import MetricsSink
+
+        self.trace_spans: deque = deque(maxlen=50_000)
+        self.metrics_sink = MetricsSink()
+        # monotonic receipt counters: ring length alone cannot tell
+        # "window full" from "window exactly filled" — the timeline's
+        # truncation marker needs the real received totals
+        self._spans_received = 0
+        self._task_events_received = 0
+        # events lost at the SOURCE (reporters' __dropped__ markers):
+        # the window is incomplete even when this ring never evicted
+        self._task_events_source_dropped = 0
         self._pg_manager = None  # set by placement module
         # per-bundle actor claims: (pg_id, bundle_index) ->
         # {actor_id: demand}.  The bundle-spec admission check alone
@@ -294,6 +312,7 @@ class Controller:
             labels=payload.get("labels", {}),
             is_head=payload.get("is_head", False),
             conn=conn,
+            metrics_port=int(payload.get("metrics_port", 0) or 0),
         )
         self.nodes[node.node_id] = node
         if conn is not None:
@@ -331,6 +350,7 @@ class Controller:
                 "labels": n.labels,
                 "alive": n.alive,
                 "is_head": n.is_head,
+                "metrics_port": n.metrics_port,
             }
             for n in self.nodes.values()
         ]
@@ -726,9 +746,98 @@ class Controller:
     async def handle_report_task_events(self, payload, conn):
         """Bounded ring of task state transitions (reference:
         `gcs_task_manager.h` — the state API / timeline data source)."""
-        for ev in payload.get("events", []):
+        events = payload.get("events", [])
+        self._task_events_received += len(events)
+        for ev in events:
+            if ev.get("name") == "__dropped__":
+                # a reporter's TaskEventBuffer overflowed before the
+                # flush: the window is incomplete at the SOURCE, which
+                # the timeline's truncation flag must reflect too
+                self._task_events_source_dropped += int(
+                    ev.get("count", 0) or 0)
             self.task_events.append(ev)
         return {"ok": True}
+
+    async def handle_report_obs(self, payload, conn):
+        """One batched observability frame from one process: its
+        metrics-registry snapshot and/or its finished spans since the
+        last flush (`core/runtime.py` flush loop, `core/noded.py` obs
+        loop).  Spans are stamped with the reporter's identity here —
+        the timeline's process lanes — so producers stay dumb."""
+        payload = payload or {}
+        node_id = str(payload.get("node_id", ""))
+        kind = str(payload.get("kind", "?"))
+        pid = int(payload.get("pid", 0))
+        if payload.get("metrics"):
+            self.metrics_sink.ingest({
+                "node_id": node_id, "kind": kind, "pid": pid,
+                "metrics": payload["metrics"],
+            })
+        spans = payload.get("spans") or []
+        node8 = node_id[:8]
+        proc = f"{kind}:{pid}"
+        for s in spans:
+            if not isinstance(s, dict):
+                continue  # a malformed reporter must not poison the ring
+            s.setdefault("node", node8)
+            s.setdefault("proc", proc)
+            self.trace_spans.append(s)
+            self._spans_received += 1
+        return {"ok": True}
+
+    async def handle_cluster_metrics(self, payload, conn):
+        """Merged metric snapshots from every live reporter, each
+        sample tagged with its origin — the data behind the dashboard
+        head's cluster-wide `/metrics` exposition."""
+        return {
+            "metrics": self.metrics_sink.merged(),
+            "reporters": self.metrics_sink.reporter_count(),
+        }
+
+    async def handle_list_trace_spans(self, payload, conn):
+        payload = payload or {}
+        trace_id = payload.get("trace_id")
+        limit = payload.get("limit", 10_000)
+        out = []
+        for s in reversed(self.trace_spans):
+            if trace_id and s.get("trace_id") != trace_id:
+                continue
+            out.append(s)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    async def handle_timeline_data(self, payload, conn):
+        """Everything the whole-run timeline needs in ONE RPC: the task
+        event window, the collected span window, and HONEST truncation
+        flags (ring eviction or limit clipping — the old endpoint
+        silently capped at 50k with no signal)."""
+        payload = payload or {}
+        limit_events = int(payload.get("limit_events", 50_000))
+        limit_spans = int(payload.get("limit_spans", 50_000))
+        trace_id = payload.get("trace_id")
+        events = list(self.task_events)
+        spans = [
+            s for s in self.trace_spans
+            if not trace_id or s.get("trace_id") == trace_id
+        ]
+        events_truncated = (
+            self._task_events_received > len(self.task_events)
+            or len(events) > limit_events
+            or self._task_events_source_dropped > 0
+        )
+        spans_truncated = (
+            self._spans_received > len(self.trace_spans)
+            or len(spans) > limit_spans
+        )
+        return {
+            # guard the zero case: list[-0:] is the WHOLE list
+            "events": events[-limit_events:] if limit_events > 0 else [],
+            "spans": spans[-limit_spans:] if limit_spans > 0 else [],
+            "events_truncated": events_truncated,
+            "spans_truncated": spans_truncated,
+        }
 
     async def handle_task_state_summary(self, payload, conn):
         """state -> count over the event window, reduced IN the

@@ -36,6 +36,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ray_tpu.core import accelerators, rpc
 from ray_tpu.core.config import Config, get_config
+from ray_tpu.metrics import metric_defs as _md
 from ray_tpu.core.ids import NodeID
 from ray_tpu.core.task_spec import ActorCreationSpec, Resources, SchedulingStrategy, TaskResult, TaskSpec, fits as _fits, match_labels
 from ray_tpu.shm import ObjectExistsError, ShmStore
@@ -152,6 +153,10 @@ class NodeDaemon:
         self.tcp_server: Optional[rpc.Server] = None
         self.tcp_port: int = 0
         self.controller_port: int = 0
+        # Prometheus /metrics listener (cfg.metrics_http_port); the
+        # bound port is advertised in node registration
+        self._metrics_server = None
+        self.metrics_http_port: int = 0
 
     # ------------------------------------------------------------------
     # startup
@@ -199,11 +204,15 @@ class NodeDaemon:
             self.controller.start_health_checks()
             self.controller_addr = (self._advertise, self.controller_port)
 
+        if self.cfg.metrics_http_port != 0:
+            await self._start_metrics_http(bind)
+
         # register with the controller like any node
         await self._connect_controller()
         for _ in range(self.num_workers):
             self._spawn_worker()
         asyncio.ensure_future(self._retry_queue_loop())
+        asyncio.ensure_future(self._obs_report_loop())
         if self.cfg.memory_monitor_refresh_ms > 0:
             asyncio.ensure_future(self._memory_monitor_loop())
         logger.info(
@@ -233,6 +242,7 @@ class NodeDaemon:
                 "resources": dict(self.total_resources),
                 "is_head": self.is_head,
                 "labels": dict(self.node_labels),
+                "metrics_port": self.metrics_http_port,
             },
         )
         # re-adopt: tell the (possibly restarted) controller which
@@ -529,6 +539,19 @@ class NodeDaemon:
     # scheduling (reference: local_task_manager.cc:122 dispatch loop)
     # ------------------------------------------------------------------
     async def handle_submit_task(self, spec: TaskSpec, conn):
+        # the daemon's hop in a trace: tasks routed through the node
+        # scheduler (spread/affinity/pg/labels, lease-infeasible
+        # spillback) appear as an instant `sched:` span so the merged
+        # timeline shows WHERE a task waited (driver vs daemon vs
+        # worker).  Guarded by the spec carrying a context at all —
+        # costs one attribute test when tracing is off.
+        if spec.trace_ctx is not None:
+            from ray_tpu.util import tracing as _tracing
+
+            _tracing.record_instant(
+                f"sched:{spec.name}", spec.trace_ctx, kind="INTERNAL",
+                node=self.node_id[:8],
+            )
         strat = spec.strategy
         if strat.kind == "placement_group" and strat.pg_id is not None:
             target = await self.controller_conn.call(
@@ -898,6 +921,7 @@ class NodeDaemon:
             target = 0 if (force and drain) else int(self.SPILL_LOW * cap)
             os.makedirs(self._spill_dir, exist_ok=True)
             spilled = 0
+            spilled_bytes = 0
             for id_bytes in self.store.spill_candidates(64):
                 if self.store.used <= target:
                     break
@@ -927,7 +951,9 @@ class NodeDaemon:
                     continue
                 self._spilled[id_bytes] = path
                 spilled += 1
+                spilled_bytes += len(data)
             if spilled:
+                _md.inc("rt_object_spill_bytes_total", float(spilled_bytes))
                 logger.info("spilled %d objects to disk (store %.0f%% full)",
                             spilled, 100 * self.store.used / cap)
             return spilled
@@ -967,7 +993,92 @@ class NodeDaemon:
                 os.remove(path)
             except OSError:
                 pass
+            _md.inc("rt_object_restore_bytes_total", float(len(data)))
             return True
+
+    # ------------------------------------------------------------------
+    # observability plane: /metrics HTTP + batched obs frames
+    # ------------------------------------------------------------------
+    async def _start_metrics_http(self, bind: str):
+        """Prometheus text exposition for THIS daemon's registry
+        (reference: the per-node metrics agent's scrape endpoint).  A
+        positive cfg port is taken literally only by the head daemon —
+        worker daemons on the same host bind ephemeral ports — and a
+        bind failure degrades to ephemeral instead of killing boot."""
+        from ray_tpu.util import httpd
+
+        want = self.cfg.metrics_http_port
+        port = want if (want > 0 and self.is_head) else 0
+        try:
+            self._metrics_server, self.metrics_http_port = (
+                await httpd.serve_http(bind, port, self._metrics_dispatch)
+            )
+        except OSError as e:
+            if port == 0:
+                logger.warning("metrics HTTP listener failed: %s", e)
+                return
+            logger.warning(
+                "metrics port %d unavailable (%s); using ephemeral",
+                port, e,
+            )
+            self._metrics_server, self.metrics_http_port = (
+                await httpd.serve_http(bind, 0, self._metrics_dispatch)
+            )
+        logger.info("noded %s /metrics on %s:%d",
+                    self.node_name, bind, self.metrics_http_port)
+
+    async def _metrics_dispatch(self, req):
+        from ray_tpu.metrics.registry import export_text
+
+        if req.path.rstrip("/") == "/metrics":
+            self._refresh_store_gauges()
+            return 200, "text/plain; version=0.0.4", export_text().encode()
+        return 404, "text/plain", b"not found"
+
+    def _refresh_store_gauges(self):
+        """Object-plane level gauges, recomputed at scrape/report time
+        (no hot-path cost; bypasses the metrics_enabled gate the same
+        way the dashboard's builtin gauges do)."""
+        from ray_tpu.metrics import metric_defs as _mdefs
+
+        if self.store is None:
+            return
+        _mdefs.metric("rt_object_store_used_bytes").set(
+            float(self.store.used))
+        _mdefs.metric("rt_object_store_capacity_bytes").set(
+            float(self.store.capacity))
+        _mdefs.metric("rt_object_store_objects").set(
+            float(self.store.count))
+        _mdefs.metric("rt_object_spilled_objects").set(
+            float(len(self._spilled)))
+
+    async def _obs_report_loop(self):
+        """One batched `report_obs` frame per interval on the existing
+        controller connection: this daemon's metrics snapshot plus any
+        scheduling spans recorded since the last flush.  Mirrors the
+        runtime-side flush loop (`core/runtime.py`); never a
+        per-sample RPC."""
+        from ray_tpu.metrics import exporter as _mexp
+
+        period_s = max(0.5, self.cfg.metrics_report_interval_ms / 1000.0)
+        while True:
+            await asyncio.sleep(period_s)
+            conn = self.controller_conn
+            if conn is None or conn.closed:
+                # reconnect loop restores it; spans stay in the bounded
+                # export queue meanwhile (overflow there is COUNTED —
+                # draining before this check would discard them silently)
+                continue
+            payload = _mexp.build_obs_payload(
+                self.node_id, "noded", os.getpid(),
+                refresh=self._refresh_store_gauges,
+            )
+            if payload is None:
+                continue
+            try:
+                conn.send("report_obs", payload)
+            except Exception as e:
+                logger.debug("daemon obs frame dropped: %s", e)
 
     async def handle_cancel_task(self, payload, conn):
         """Drop a still-queued task (reference:
@@ -2048,6 +2159,7 @@ class NodeDaemon:
             "store_used": self.store.used if self.store else 0,
             "store_capacity": self.store.capacity if self.store else 0,
             "store_objects": self.store.count if self.store else 0,
+            "metrics_port": self.metrics_http_port,
         }
 
     # ------------------------------------------------------------------
@@ -2072,6 +2184,9 @@ class NodeDaemon:
             await self.unix_server.stop()
         if self.tcp_server:
             await self.tcp_server.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            await self._metrics_server.wait_closed()
         if self.store:
             self.store.close()
             ShmStore.unlink(self.shm_name)

@@ -30,12 +30,14 @@ import asyncio
 import logging
 import os
 import threading
+import time
 from collections import deque
 from typing import Dict, Optional, Tuple
 
 from ray_tpu.core import rpc
 from ray_tpu.core.retry import backoff_delay_s
 from ray_tpu.core.task_spec import TaskResult, TaskSpec
+from ray_tpu.metrics import metric_defs as _mdefs
 
 logger = logging.getLogger(__name__)
 
@@ -301,6 +303,7 @@ class OwnerShard:
                         pool.requesting = False
                         return
                 want = max(1, min(short, rt.cfg.lease_request_batch))
+                t_lease = time.monotonic()
                 try:
                     reply = await self.noded.call(
                         "request_lease",
@@ -322,6 +325,11 @@ class OwnerShard:
                     ))
                     continue
                 rpc_failures = 0
+                _mdefs.observe(
+                    "rt_owner_lease_latency_seconds",
+                    time.monotonic() - t_lease,
+                    tags={"shard": str(self.index)},
+                )
                 grants, err = _parse_lease_reply(reply)
                 if err == "env_error":
                     # the daemon cannot materialize this runtime env at
@@ -352,6 +360,8 @@ class OwnerShard:
                     ))
                     continue
                 dry_rounds = 0
+                _mdefs.inc("rt_owner_lease_grants_total", float(len(grants)),
+                           tags={"shard": str(self.index)})
                 for worker_id, socket_path in grants:
                     await self._adopt_grant(pool, worker_id, socket_path)
         except Exception:

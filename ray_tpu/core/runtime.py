@@ -60,6 +60,7 @@ from ray_tpu.core.owner_shard import (
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.retry import RetryBudget, backoff_delay_s
+from ray_tpu.metrics import metric_defs as _mdefs
 from ray_tpu.core.task_spec import (
     STREAMING,
     ActorCreationSpec,
@@ -237,6 +238,10 @@ class _PendingTask:
     # full timeout_s of every already-finished call; survives retries
     # (the deadline covers the whole lineage)
     deadline_timer: Optional[object] = None
+    # registration instant: basis of the submit->final-completion
+    # latency histogram (`rt_owner_task_latency_seconds`); always
+    # stamped (one clock read), only OBSERVED when metrics are on
+    t_submit: float = field(default_factory=time.monotonic)
 
 
 # Process-wide per-actor sequence numbers: every caller path (handles,
@@ -383,7 +388,16 @@ class Runtime:
         self._actor_connect_attempts: Dict[bytes, int] = {}
         from ray_tpu.core.task_events import TaskEventBuffer
 
-        self.task_events = TaskEventBuffer()
+        self.task_events = TaskEventBuffer(
+            max_buffer=self.cfg.task_events_buffer_size
+        )
+        # config can enable core-path metrics without the env flag
+        # (init(_system_config={"metrics_enabled": True})); set_enabled
+        # mirrors it into the env so spawned children inherit
+        if self.cfg.metrics_enabled:
+            from ray_tpu.metrics import metric_defs as _md
+
+            _md.set_enabled(True)
         # executor-side completion coalescing (core/completion.py):
         # results for one owner ship as one frame per loop tick
         self._result_coalescer = _completion.ResultCoalescer(self)
@@ -566,6 +580,10 @@ class Runtime:
                     await asyncio.sleep(0.05)  # let the write flush
                 except Exception as e:
                     logger.debug("final task-event report dropped: %s", e)
+            # ... and the last obs frame (spans/metrics of a short-lived
+            # process would otherwise never reach the collector)
+            if self._ship_obs_frame():
+                await asyncio.sleep(0.05)
             if self._server:
                 await self._server.stop()
             for s in self._shards:
@@ -992,6 +1010,8 @@ class Runtime:
         shard = self._shard_for(spec.task_id.binary())
         with shard.lock:
             shard.submitted += 1
+        _mdefs.inc("rt_owner_tasks_submitted_total",
+                   tags={"shard": str(shard.index)})
         if spec.deadline_s is not None:
             self._arm_deadline(spec)
         self._push_or_queue(spec)
@@ -1523,6 +1543,7 @@ class Runtime:
             if handle._address is not None:
                 self._actor_addr.setdefault(aid, tuple(handle._address))
         self.task_events.record(spec.task_id.binary(), spec.name, "SUBMITTED")
+        _mdefs.inc("rt_owner_tasks_submitted_total", tags={"shard": "actor"})
         if spec.deadline_s is not None:
             self._arm_deadline(spec)
         self._push_actor_task(aid, spec)
@@ -1683,9 +1704,18 @@ class Runtime:
     # ------------------------------------------------------------------
     async def _flush_task_events_loop(self):
         """Batched flush to the controller (reference:
-        `task_event_buffer.h:220` periodic flush — never the hot path)."""
+        `task_event_buffer.h:220` periodic flush — never the hot path).
+        The same loop carries the observability plane's frames: every
+        `metrics_report_interval_ms` it ships ONE `report_obs` frame
+        holding this process's metrics-registry snapshot and the spans
+        finished since the last flush — batched like the task events,
+        never a per-sample RPC."""
         from ray_tpu.core.task_events import FLUSH_PERIOD_S
 
+        obs_period_s = max(
+            FLUSH_PERIOD_S, self.cfg.metrics_report_interval_ms / 1000.0
+        )
+        last_obs = 0.0
         while not self._shutdown:
             await asyncio.sleep(FLUSH_PERIOD_S)
             events = self.task_events.drain()
@@ -1696,6 +1726,36 @@ class Runtime:
                     )
                 except Exception as e:
                     logger.debug("task-event report dropped: %s", e)
+            now = time.monotonic()
+            if now - last_obs >= obs_period_s:
+                last_obs = now
+                self._ship_obs_frame()
+
+    def _ship_obs_frame(self) -> bool:
+        """Send one batched obs frame (metrics snapshot + drained
+        spans) to the controller; a no-op when both planes are off or
+        there is nothing to report.  Returns True when a frame went
+        out."""
+        from ray_tpu.metrics import exporter as _mexp
+        from ray_tpu.metrics import metric_defs as _md
+
+        if self.controller is None or self.controller.closed:
+            # reconnect restores it; spans stay in the bounded export
+            # queue (overflow there is counted), not drained into a
+            # frame that can never be sent
+            return False
+        payload = _mexp.build_obs_payload(
+            self.node_id or "", self.mode, os.getpid()
+        )
+        if payload is None:
+            return False
+        try:
+            self.controller.send("report_obs", payload)
+            _md.inc("rt_obs_frames_sent_total")
+        except Exception as e:
+            logger.debug("obs frame dropped: %s", e)
+            return False
+        return True
 
     def _complete_task(self, result: TaskResult) -> list:
         """Owner-side exactly-once completion (moved to
@@ -1913,6 +1973,7 @@ class Runtime:
                     if rc:
                         rc.submitted += 1
         logger.info("reconstructing %s via lineage resubmit", ref.hex())
+        _mdefs.inc("rt_object_reconstructions_total")
         if spec.actor_id is not None:
             # actor-task returns re-execute ON the actor: route through
             # the ordered actor queue with a fresh sequence number (the

@@ -5,12 +5,20 @@ buffers per-task state transitions locally and flushes them to the
 control plane in periodic batches (never on the hot path), where the
 GCS-task-manager-equivalent keeps a bounded ring the state API and
 timeline read from (`gcs_task_manager.h`, `util/state/api.py`).
+
+Bounded with eviction accounting: when the buffer is full, the OLDEST
+buffered event is evicted (the freshest state transition is the one the
+dashboard needs), every eviction is counted, and the count surfaces
+both as a `__dropped__` marker event in the next drain and as the
+`rt_task_events_dropped_total` metric — a flush loop that cannot keep
+up is itself observable.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 FLUSH_PERIOD_S = 0.5
@@ -18,10 +26,13 @@ MAX_BUFFER = 10_000
 
 
 class TaskEventBuffer:
-    def __init__(self):
+    def __init__(self, max_buffer: int = 0):
         self._lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
+        self._max = int(max_buffer) if max_buffer and max_buffer > 0 \
+            else MAX_BUFFER
+        self._events: deque = deque()
         self._dropped = 0
+        self._dropped_total = 0  # monotonic, for tests/introspection
 
     def record(self, task_id: bytes, name: str, state: str,
                node_id: str = "", worker_id: str = "",
@@ -41,16 +52,32 @@ class TaskEventBuffer:
         if duration is not None:
             ev["duration"] = duration
         with self._lock:
-            if len(self._events) >= MAX_BUFFER:
+            if len(self._events) >= self._max:
+                # evict oldest: under sustained overload the window
+                # slides forward instead of freezing at the first
+                # MAX_BUFFER events
+                self._events.popleft()
                 self._dropped += 1
-                return
+                self._dropped_total += 1
             self._events.append(ev)
+
+    @property
+    def dropped_total(self) -> int:
+        with self._lock:
+            return self._dropped_total
 
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:
-            out, self._events = self._events, []
+            out = list(self._events)
+            self._events.clear()
             dropped, self._dropped = self._dropped, 0
         if dropped:
+            # ONE metric touch per flush, not per evicted event: under
+            # sustained overload every record() hits the drop path, so
+            # a per-event inc would tax exactly the storm being observed
+            from ray_tpu.metrics import metric_defs as _md
+
+            _md.metric("rt_task_events_dropped_total").inc(dropped)
             out.append({
                 "task_id": "", "name": "__dropped__", "state": "DROPPED",
                 "ts": time.time(), "count": dropped,

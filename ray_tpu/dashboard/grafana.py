@@ -14,10 +14,13 @@ dashboard's `/metrics` handler.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.util.metrics import Gauge
+
+logger = logging.getLogger(__name__)
 
 # -- built-in cluster metrics -------------------------------------------
 _builtin: Dict[str, Gauge] = {}
@@ -79,6 +82,24 @@ async def update_builtin_metrics(ctl):
                  ("app", "deployment"))
     req.clear()
     lat.clear()
+    # LLM-engine panel bridge: the per-replica stats() piggyback the
+    # controller already collects (queue depth, pool occupancy, radix
+    # hit rate, shed/reject counters) re-exported under the CATALOGED
+    # names (`ray_tpu/metrics/metric_defs.py`) — the registry view and
+    # /api/serve stay one source of truth, nothing is double-polled
+    from ray_tpu.metrics import metric_defs as _mdefs
+
+    _ENGINE_BRIDGE = {
+        "rt_serve_engine_queue_depth": "queue_depth",
+        "rt_serve_engine_block_occupancy": "block_occupancy",
+        "rt_serve_engine_prefix_hit_rate": "prefix_hit_rate",
+        "rt_serve_engine_ttft_ema_seconds": "ttft_ema_s",
+        "rt_serve_engine_rejected_total": "rejected_total",
+        "rt_serve_engine_shed_total": "shed_total",
+    }
+    eng_gauges = {name: _mdefs.metric(name) for name in _ENGINE_BRIDGE}
+    for eg in eng_gauges.values():
+        eg.clear()  # dead replicas must not export stale series
     for app, deployments in (status or {}).items():
         for dep, info in deployments.items():
             tags = {"app": app, "deployment": dep}
@@ -87,6 +108,18 @@ async def update_builtin_metrics(ctl):
                   {**tags, "kind": "target"})
             req.set(float(info.get("completed", 0.0)), tags)
             lat.set(float(info.get("latency_sum_s", 0.0)), tags)
+            for rid, rinfo in (info.get("replicas") or {}).items():
+                engine = rinfo.get("engine")
+                if not isinstance(engine, dict):
+                    continue
+                rtags = {**tags, "replica": rid}
+                for mname, skey in _ENGINE_BRIDGE.items():
+                    try:
+                        eng_gauges[mname].set(float(engine.get(skey, 0.0)),
+                                              rtags)
+                    except (TypeError, ValueError):
+                        logger.debug("engine stat %s=%r not numeric",
+                                     skey, engine.get(skey))
     # per-replica series (reference: `serve/metrics.py` replica-tagged
     # request counter / queue gauge / latency histogram) so autoscaling
     # decisions are auditable from /metrics
@@ -168,6 +201,48 @@ DEFAULT_PANELS: List[Panel] = [
               "rate(rt_serve_latency_seconds_sum[5m]) / "
               "rate(rt_serve_requests_total[5m])",
               "{{app}}/{{deployment}}")]),
+    # ---- unified observability plane (ray_tpu/metrics catalog) ------
+    Panel("Task throughput", unit="ops",
+          targets=[Target(
+              "sum by (shard) (rate(rt_owner_tasks_completed_total[1m]))",
+              "shard {{shard}}")],
+          description="owner-plane completions/s per shard "
+                      "(RT_METRICS_ENABLED=1)"),
+    Panel("Task latency p99", unit="s",
+          targets=[Target(
+              "histogram_quantile(0.99, sum by (le) "
+              "(rate(rt_owner_task_latency_seconds_bucket[5m])))",
+              "p99")],
+          description="submit to final completion, owner-side"),
+    Panel("Object store occupancy", unit="bytes",
+          targets=[Target("rt_object_store_used_bytes", "{{node}} used"),
+                   Target("rt_object_store_capacity_bytes",
+                          "{{node}} capacity")]),
+    Panel("Spill / restore rate", unit="Bps",
+          targets=[Target("rate(rt_object_spill_bytes_total[5m])",
+                          "{{node}} spill"),
+                   Target("rate(rt_object_restore_bytes_total[5m])",
+                          "{{node}} restore")]),
+    Panel("Shuffle backpressure + reconstructions",
+          targets=[Target("rate(rt_shuffle_backpressure_total[5m])",
+                          "backpressure {{phase}}"),
+                   Target("rate(rt_object_reconstructions_total[5m])",
+                          "lineage reconstructions")],
+          description="sustained nonzero = store budget or partition "
+                      "count needs tuning"),
+    Panel("Engine queue depth",
+          targets=[Target("rt_serve_engine_queue_depth",
+                          "{{app}}/{{deployment}}/{{replica}}")],
+          description="bridged from the replicas' stats() piggyback"),
+    Panel("Train step time p50", unit="s",
+          targets=[Target(
+              "histogram_quantile(0.5, sum by (le) "
+              "(rate(rt_train_step_seconds_bucket[5m])))", "p50")]),
+    Panel("Dropped task events",
+          targets=[Target("rate(rt_task_events_dropped_total[5m])",
+                          "{{proc}}")],
+          description="nonzero = the event flush cannot keep up; "
+                      "raise RT_TASK_EVENTS_BUFFER_SIZE"),
 ]
 
 

@@ -10,10 +10,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.serve.request import Request
 from ray_tpu.util import httpd
+
+logger = logging.getLogger(__name__)
 
 _SPA_CACHE: Optional[str] = None
 
@@ -134,7 +137,8 @@ class DashboardHead:
             try:
                 body = req.json()
                 entrypoint = body["entrypoint"]
-            except Exception:
+            except Exception as e:
+                logger.debug("malformed job submission body: %s", e)
                 return httpd.json_response(
                     {"error": "body must be JSON with 'entrypoint'"},
                     status=400,
@@ -216,7 +220,10 @@ class DashboardHead:
                         "node_id": n["node_id"],
                         "method": "memory_table",
                     }, timeout=20)
-                except Exception:
+                except Exception as e:
+                    # node died between listing and the call
+                    logger.debug("memory_table from %s failed: %s",
+                                 n["node_id"][:8], e)
                     continue
                 if t:
                     tables.append(t)
@@ -272,19 +279,24 @@ class DashboardHead:
 
             return httpd.json_response(default_dashboard())
         if path == "/api/timeline":
-            events = await self._ctl("list_task_events", {"limit": 50_000})
-            trace = [
-                {
-                    "name": ev["name"], "cat": "task", "ph": "X",
-                    "ts": ev["ts"] * 1e6 - ev["duration"] * 1e6,
-                    "dur": ev["duration"] * 1e6,
-                    "pid": ev.get("node_id", "cluster"),
-                    "tid": ev.get("worker_id", ev["task_id"][:8]),
-                }
-                for ev in events
-                if ev["state"] in ("FINISHED", "FAILED") and ev.get("duration")
-            ]
-            return httpd.json_response(trace)
+            # whole-run merged timeline (dashboard/timeline.py): task
+            # events + collected spans in one Chrome-trace document,
+            # with honest truncation flags.  ?trace_id= narrows the
+            # span set to one logical request's lineage.
+            from ray_tpu.dashboard.timeline import build_chrome_trace
+
+            limit = int(req.query_params.get("limit", "50000"))
+            data = await self._ctl("timeline_data", {
+                "trace_id": req.query_params.get("trace_id"),
+                "limit_events": limit,
+                "limit_spans": limit,
+            }) or {}
+            return httpd.json_response(build_chrome_trace(
+                data.get("events", []),
+                data.get("spans", []),
+                events_truncated=data.get("events_truncated", False),
+                spans_truncated=data.get("spans_truncated", False),
+            ))
         if path == "/api/serve":
             try:
                 from ray_tpu.serve.api import _get_controller_async
@@ -294,7 +306,10 @@ class DashboardHead:
                 ref = controller.get_serve_status.remote()
                 status = await get_runtime()._get_one(ref)
                 return httpd.json_response(status)
-            except Exception:
+            except Exception as e:
+                # no serve controller deployed yet: an empty status is
+                # the correct answer, not an error page
+                logger.debug("serve status unavailable: %s", e)
                 return httpd.json_response({})
         if path == "/api/serve/applications":
             # REST deploy (reference: `dashboard/modules/serve/` REST API
@@ -386,7 +401,7 @@ class DashboardHead:
                 await loop.run_in_executor(None, _list)
             )
         if path == "/metrics":
-            from ray_tpu.util.metrics import export_text
+            from ray_tpu.metrics.registry import render_exposition, snapshot
 
             # refresh the built-in cluster gauges at scrape time so the
             # Prometheus view (and the generated Grafana dashboard)
@@ -395,9 +410,39 @@ class DashboardHead:
                 from ray_tpu.dashboard.grafana import update_builtin_metrics
 
                 await update_builtin_metrics(self._ctl)
-            except Exception:
-                pass
-            return 200, "text/plain; version=0.0.4", export_text().encode()
+            except Exception as e:
+                logger.debug("builtin gauge refresh failed: %s", e)
+            # one scrape serves the whole cluster: this process's
+            # registry (builtin gauges, serve bridge) merged with the
+            # controller sink's collected per-process snapshots, every
+            # sample origin-tagged node/proc so series stay distinct.
+            # The sink also holds THIS process's reporter (the obs
+            # frame loop ships it) — filter that copy out, or every
+            # local series would export twice and double any
+            # sum()/rate() aggregation over it
+            import os as _os
+
+            from ray_tpu.core.runtime import get_runtime
+
+            rt_ = get_runtime()
+            me = {"node": (rt_.node_id or "")[:8],
+                  "proc": f"{rt_.mode}:{_os.getpid()}"}
+            merged = snapshot(extra_tags=me)
+            try:
+                cluster = await self._ctl("cluster_metrics", {}) or {}
+                for m in cluster.get("metrics", []):
+                    samples = [
+                        s for s in m.get("samples", ())
+                        if not ((s[0] or {}).get("proc") == me["proc"]
+                                and (s[0] or {}).get("node") == me["node"])
+                    ]
+                    if samples:
+                        merged.append({**m, "samples": samples})
+            except Exception as e:
+                # local exposition still serves (degraded, not down)
+                logger.debug("cluster metrics fetch failed: %s", e)
+            return (200, "text/plain; version=0.0.4",
+                    render_exposition(merged).encode())
         return 404, "text/plain", b"not found"
 
 

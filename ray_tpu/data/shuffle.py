@@ -32,6 +32,7 @@ drop/duplicate rows across the recovery boundary.
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -39,6 +40,8 @@ import numpy as np
 
 from ray_tpu.data import block as B
 from ray_tpu.exceptions import BackPressureError
+from ray_tpu.metrics import metric_defs as _mdefs
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -128,12 +131,22 @@ def run_shuffle(executor, stream: Iterator[Tuple[Any, Any]], op
         samples = rt.get(sample_refs)
     aux = op.aux_fn(samples, metas, P) if op.aux_fn is not None else None
 
+    # umbrella span for the whole exchange: every map/reduce/sample
+    # task submitted below nests under it, so the map→reduce lineage of
+    # one shuffle shares ONE trace id in the collected timeline.
+    # Explicit (not a `with` block): this function is a generator, and
+    # a context manager across yields would leak the ambient trace
+    # context into the consumer (see util/tracing.py).
+    ex_span = _tracing.start_span(op.name, kind="SHUFFLE")
+    ex_ctx = _tracing.ctx_of(ex_span)
+
     # 3. map phase: count- and byte-bounded admission.  The byte cost
     # of a running map task is ~2x its input (pinned input + created
     # pieces); pinned bytes can neither spill nor evict, so the sum of
     # in-flight costs must stay under the store-aware stage budget or
     # an over-memory shuffle wedges every create.
-    outstanding: Dict[Any, int] = {}  # completion ref -> est task bytes
+    # completion ref -> (est task bytes, admit instant, phase)
+    outstanding: Dict[Any, tuple] = {}
     inflight_bytes = 0
 
     def _drain_one(where: str) -> None:
@@ -144,6 +157,13 @@ def run_shuffle(executor, stream: Iterator[Tuple[Any, Any]], op
             list(outstanding), num_returns=1, timeout=bp_timeout,
         )
         if not done:
+            phase = where.split()[0]
+            _mdefs.inc("rt_shuffle_backpressure_total",
+                       tags={"phase": phase})
+            _tracing.record_instant(
+                f"backpressure:{op.name}", ex_ctx, kind="BACKPRESSURE",
+                where=where,
+            )
             raise BackPressureError(
                 f"shuffle {where} made no progress for "
                 f"{bp_timeout:.0f}s at {len(outstanding)} in-flight "
@@ -151,8 +171,12 @@ def run_shuffle(executor, stream: Iterator[Tuple[Any, Any]], op
                 f"(stage budget {max_bytes} bytes)",
                 retry_after_s=bp_timeout,
             )
+        now = time.monotonic()
         for m in done:
-            inflight_bytes -= outstanding.pop(m)
+            cost, t_admit, phase = outstanding.pop(m)
+            inflight_bytes -= cost
+            _mdefs.observe("rt_shuffle_partition_seconds", now - t_admit,
+                           tags={"phase": phase})
 
     def _admit(cost: int, where: str) -> None:
         while len(outstanding) >= ctx_window or (
@@ -168,53 +192,62 @@ def run_shuffle(executor, stream: Iterator[Tuple[Any, Any]], op
     map_outs: List[Optional[List[Any]]] = [None] * n_in
     map_meta_refs: List[Any] = []
     rows_in = 0
-    for i, (ref, _) in enumerate(pairs):
-        cost = 2 * int(metas[i].get("size_bytes", 0))
-        rows_in += int(metas[i].get("num_rows", 0))
-        _admit(cost, "map admission")
-        rets = map_remote.remote(op.map_fn, i, P, aux, ref)
-        executor.stats["tasks"] += 1
-        map_outs[i] = list(rets[:P])
-        map_meta_refs.append(rets[P])
-        outstanding[rets[P]] = cost
-        inflight_bytes += cost
-    while outstanding:
-        _drain_one("map drain")
+    try:
+        for i, (ref, _) in enumerate(pairs):
+            cost = 2 * int(metas[i].get("size_bytes", 0))
+            rows_in += int(metas[i].get("num_rows", 0))
+            _admit(cost, "map admission")
+            with _tracing.use_context(ex_ctx):
+                rets = map_remote.remote(op.map_fn, i, P, aux, ref)
+            executor.stats["tasks"] += 1
+            map_outs[i] = list(rets[:P])
+            map_meta_refs.append(rets[P])
+            outstanding[rets[P]] = (cost, time.monotonic(), "map")
+            inflight_bytes += cost
+        while outstanding:
+            _drain_one("map drain")
+        _mdefs.inc("rt_shuffle_rows_total", float(rows_in))
 
-    # per-partition sizes from the map metas (one batched get): exact
-    # row accounting + byte-accounted reduce admission
-    map_metas = rt.get(map_meta_refs)
-    part_rows = [0] * P
-    part_bytes = [0] * P
-    for m in map_metas:
-        for r in range(P):
-            part_rows[r] += int(m["rows"][r])
-            part_bytes[r] += int(m["bytes"][r])
-    executor.stats.setdefault("shuffle", []).append(
-        {"op": op.name, "inputs": n_in, "partitions": P,
-         "rows_in": rows_in, "rows_mapped": sum(part_rows)}
-    )
-
-    # 4. reduce phase: byte-accounted bounded in-flight partitions,
-    # streamed downstream in partition order as they are admitted
-    red_remote = rt.remote(_shuffle_reduce_task).options(
-        num_cpus=executor.task_num_cpus,
-        num_returns=2,
-        max_retries=retries,
-    )
-    for r in range(P):
-        cost = 2 * part_bytes[r]  # pinned pieces + merged output
-        _admit(cost, f"reduce admission (partition {r})")
-        pieces = [map_outs[i][r] for i in range(n_in)]
-        block_ref, meta_ref = red_remote.remote(
-            op.reduce_fn, r, aux, *pieces
+        # per-partition sizes from the map metas (one batched get):
+        # exact row accounting + byte-accounted reduce admission
+        map_metas = rt.get(map_meta_refs)
+        part_rows = [0] * P
+        part_bytes = [0] * P
+        for m in map_metas:
+            for r in range(P):
+                part_rows[r] += int(m["rows"][r])
+                part_bytes[r] += int(m["bytes"][r])
+        executor.stats.setdefault("shuffle", []).append(
+            {"op": op.name, "inputs": n_in, "partitions": P,
+             "rows_in": rows_in, "rows_mapped": sum(part_rows)}
         )
-        executor.stats["tasks"] += 1
-        outstanding[meta_ref] = cost
-        inflight_bytes += cost
-        for i in range(n_in):  # release piece refs as they are consumed
-            map_outs[i][r] = None
-        yield block_ref, meta_ref
+
+        # 4. reduce phase: byte-accounted bounded in-flight partitions,
+        # streamed downstream in partition order as they are admitted
+        red_remote = rt.remote(_shuffle_reduce_task).options(
+            num_cpus=executor.task_num_cpus,
+            num_returns=2,
+            max_retries=retries,
+        )
+        for r in range(P):
+            cost = 2 * part_bytes[r]  # pinned pieces + merged output
+            _admit(cost, f"reduce admission (partition {r})")
+            pieces = [map_outs[i][r] for i in range(n_in)]
+            with _tracing.use_context(ex_ctx):
+                block_ref, meta_ref = red_remote.remote(
+                    op.reduce_fn, r, aux, *pieces
+                )
+            executor.stats["tasks"] += 1
+            outstanding[meta_ref] = (cost, time.monotonic(), "reduce")
+            inflight_bytes += cost
+            for i in range(n_in):  # release pieces as they are consumed
+                map_outs[i][r] = None
+            yield block_ref, meta_ref
+    finally:
+        # runs at exhaustion AND at abandonment (generator close), so
+        # the umbrella span always lands in the trace with its real
+        # duration
+        _tracing.finish_span(ex_span)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,52 @@
+"""Unified metrics plane: tagged primitives, a central catalog, and
+cluster-wide collection.
+
+- :mod:`ray_tpu.metrics.registry` — Counter / Gauge / Histogram and the
+  per-process registry (`snapshot()` / `render_exposition()` /
+  `export_text()`).
+- :mod:`ray_tpu.metrics.metric_defs` — the `metric_defs.h`-analogue
+  catalog of every core metric name, plus the gated `inc/observe/
+  set_gauge` helpers the hot subsystems call.
+- :mod:`ray_tpu.metrics.exporter` — batched frame collection and the
+  controller-side :class:`MetricsSink` behind the dashboard's merged
+  `/metrics`.
+
+User code keeps importing the primitives from `ray_tpu.util.metrics`
+(the reference's path); that module is a re-export of the registry.
+"""
+
+from ray_tpu.metrics.metric_defs import (
+    CATALOG,
+    enabled,
+    inc,
+    metric,
+    observe,
+    set_enabled,
+    set_gauge,
+)
+from ray_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    export_text,
+    render_exposition,
+    snapshot,
+)
+
+__all__ = [
+    "CATALOG",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Metric",
+    "enabled",
+    "export_text",
+    "inc",
+    "metric",
+    "observe",
+    "render_exposition",
+    "set_enabled",
+    "set_gauge",
+    "snapshot",
+]

@@ -1,0 +1,130 @@
+"""Cluster metrics collection: batched frames, controller-side sink.
+
+Reference: the per-node metrics agent + dashboard aggregation
+(`dashboard/modules/metrics/`, `src/ray/stats/`) — every process
+exports its registry periodically, an aggregator keys the snapshots by
+reporter, and one scrape endpoint serves the merged view.
+
+The shipping here rides paths that already exist (the same discipline
+as PR 7's `ResultCoalescer`): drivers and workers attach their registry
+snapshot to the periodic task-event flush frame, node daemons ship one
+`report_obs` frame per interval on their controller connection — ONE
+frame per process per interval, NEVER a per-sample RPC.  The controller
+keeps only the LATEST snapshot per reporter (metrics are level-based;
+counters are cumulative in the reporting process), so a hot worker
+cannot grow controller memory: the sink is bounded by live reporters
+and expires the dead ones by wall age.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.metrics import registry as _registry
+
+# a reporter silent this long is presumed dead and its series vanish
+# from the merged exposition (matches prometheus staleness handling)
+REPORTER_TTL_S = 30.0
+
+
+def collect_frame(node_id: str, kind: str, pid: int) -> Optional[Dict]:
+    """This process's registry as one wire-ready obs frame; None when
+    the registry holds no samples (nothing to ship, no empty frames on
+    the wire)."""
+    snap = _registry.snapshot()
+    if not any(m["samples"] for m in snap):
+        return None
+    return {
+        "node_id": node_id,
+        "kind": kind,
+        "pid": int(pid),
+        "metrics": snap,
+    }
+
+
+def build_obs_payload(node_id: str, kind: str, pid: int,
+                      refresh: Optional[Callable[[], None]] = None
+                      ) -> Optional[Dict]:
+    """THE `report_obs` frame shape, built in one place for every
+    reporter kind (driver/worker flush loop in `core/runtime.py`, the
+    daemon loop in `core/noded.py`): drained spans + this process's
+    registry snapshot, or None when both planes have nothing.
+    `refresh` runs scrape-time gauge updates (the daemon's store
+    levels) only when metrics are actually on.  Callers must check
+    their connection BEFORE calling: a drained span that cannot be
+    sent is silently lost, while one left in the export queue is
+    either shipped next tick or counted as dropped."""
+    from ray_tpu.metrics import metric_defs as _md
+    from ray_tpu.util import tracing as _tracing
+
+    spans = _tracing.drain_export() if _tracing.is_enabled() else []
+    metrics_snap = None
+    if _md.enabled():
+        if refresh is not None:
+            refresh()
+        frame = collect_frame(node_id, kind, pid)
+        if frame is not None:
+            metrics_snap = frame["metrics"]
+    if not spans and metrics_snap is None:
+        return None
+    payload: Dict = {"node_id": node_id, "kind": kind, "pid": int(pid)}
+    if metrics_snap is not None:
+        payload["metrics"] = metrics_snap
+    if spans:
+        payload["spans"] = spans
+    return payload
+
+
+class MetricsSink:
+    """Controller-side collection: latest snapshot per reporter.
+
+    Single-threaded by construction — every touch happens inside
+    controller handlers on the controller's io loop, so no lock."""
+
+    def __init__(self, ttl_s: float = REPORTER_TTL_S):
+        self.ttl_s = ttl_s
+        # (node_id, kind, pid) -> (wall_ts, [metric snapshots])
+        self._by_reporter: Dict[Tuple[str, str, int], Tuple[float, List]] = {}
+
+    def _purge(self, now: float):
+        dead = [k for k, (ts, _) in self._by_reporter.items()
+                if now - ts > self.ttl_s]
+        for k in dead:
+            del self._by_reporter[k]
+
+    def ingest(self, frame: Dict):
+        now = time.time()
+        # purge on the WRITE path too: with no scraper, reporter churn
+        # (new jobs, respawned workers) would otherwise grow this dict
+        # without bound — merged() alone only purges when someone reads
+        self._purge(now)
+        key = (
+            str(frame.get("node_id", "")),
+            str(frame.get("kind", "")),
+            int(frame.get("pid", 0)),
+        )
+        self._by_reporter[key] = (now, frame.get("metrics") or [])
+
+    def merged(self) -> List[Dict]:
+        """Snapshots from every live reporter, each sample tagged with
+        its origin (`node`, `proc`) so series from different processes
+        stay distinct in the merged exposition."""
+        self._purge(time.time())
+        out: List[Dict] = []
+        for (node_id, kind, pid), (_, snaps) in self._by_reporter.items():
+            origin = {"node": node_id[:8], "proc": f"{kind}:{pid}"}
+            for m in snaps:
+                out.append({
+                    "name": m.get("name", ""),
+                    "type": m.get("type", "gauge"),
+                    "help": m.get("help", ""),
+                    "samples": [
+                        [{**(labels or {}), **origin}, value]
+                        for labels, value in m.get("samples", ())
+                    ],
+                })
+        return out
+
+    def reporter_count(self) -> int:
+        return len(self._by_reporter)

@@ -1,0 +1,215 @@
+"""Metric primitives + the per-process registry.
+
+Reference: `python/ray/util/metrics.py` (the user-facing Counter /
+Gauge / Histogram) over `src/ray/stats/metric.h` (the tagged metric
+core).  Every process — driver, node daemon, worker — holds ONE
+registry; `snapshot()` freezes it into plain data that travels the
+control plane (the batched obs frames `core/runtime.py` /
+`core/noded.py` ship to the controller), and `render_exposition()`
+turns any pile of snapshots — local or collected cluster-wide — into
+Prometheus text exposition.  The split is what lets the dashboard head
+serve one merged `/metrics` for the whole cluster without a per-sample
+RPC anywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merge(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return merged
+
+    def _samples(self) -> List[Tuple[Dict[str, str], float]]:
+        raise NotImplementedError
+
+    def _type(self) -> str:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _label_key(self._merge(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self):
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+    def _type(self):
+        return "counter"
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_label_key(self._merge(tags))] = float(value)
+
+    def clear(self):
+        """Drop all tagged series — refresh-style exporters that
+        recompute the full tag set each pass call this first so
+        vanished tag values (a deleted app, a drained state) stop
+        exporting stale samples."""
+        with self._lock:
+            self._values.clear()
+
+    def _samples(self):
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+    def _type(self):
+        return "gauge"
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.1, 1, 10, 100]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _label_key(self._merge(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1)
+            )
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    return
+            counts[-1] += 1
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                labels = dict(key)
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    out.append(({**labels, "le": str(b)}, float(cum)))
+                cum += counts[-1]
+                out.append(({**labels, "le": "+Inf"}, float(cum)))
+                out.append(({**labels, "__count__": "1"}, float(cum)))
+                out.append(({**labels, "__sum__": "1"}, self._sums[key]))
+        return out
+
+    def _type(self):
+        return "histogram"
+
+
+# ----------------------------------------------------------------------
+# snapshot / exposition
+# ----------------------------------------------------------------------
+def snapshot(extra_tags: Optional[Dict[str, str]] = None) -> List[Dict]:
+    """Freeze the registry into plain wire-encodable data: one dict per
+    metric — `{"name", "type", "help", "samples": [[labels, value]]}` —
+    with histogram samples in the marker form `_samples()` emits.
+    `extra_tags` (e.g. node/proc identity) fold into every sample's
+    labels so snapshots from many processes merge without collisions."""
+    with _registry_lock:
+        metrics = list(_registry)
+    out: List[Dict] = []
+    for m in metrics:
+        samples = m._samples()
+        if extra_tags:
+            samples = [({**labels, **extra_tags}, v) for labels, v in samples]
+        out.append({
+            "name": m.name,
+            "type": m._type(),
+            "help": m.description,
+            "samples": [[labels, v] for labels, v in samples],
+        })
+    return out
+
+
+def _sample_lines(name: str, samples) -> List[str]:
+    lines = []
+    for labels, value in samples:
+        labels = dict(labels)
+        if labels.pop("__sum__", None) is not None:
+            sname = f"{name}_sum"
+        elif labels.pop("__count__", None) is not None:
+            sname = f"{name}_count"
+        elif "le" in labels:
+            sname = f"{name}_bucket"
+        else:
+            sname = name
+        if labels:
+            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            lines.append(f"{sname}{{{inner}}} {value}")
+        else:
+            lines.append(f"{sname} {value}")
+    return lines
+
+
+def render_exposition(metric_snapshots: Sequence[Dict]) -> str:
+    """Prometheus text exposition over any collection of metric
+    snapshots (local and/or collected from other processes).  Snapshots
+    sharing a name merge under one HELP/TYPE header — exposition
+    requires each metric family to appear exactly once."""
+    by_name: Dict[str, Dict] = {}
+    order: List[str] = []
+    for snap in metric_snapshots:
+        name = snap["name"]
+        ent = by_name.get(name)
+        if ent is None:
+            ent = by_name[name] = {
+                "type": snap.get("type", "gauge"),
+                "help": snap.get("help", ""),
+                "samples": [],
+            }
+            order.append(name)
+        ent["samples"].extend(snap.get("samples", ()))
+    lines: List[str] = []
+    for name in order:
+        ent = by_name[name]
+        if ent["help"]:
+            lines.append(f"# HELP {name} {ent['help']}")
+        lines.append(f"# TYPE {name} {ent['type']}")
+        lines.extend(_sample_lines(name, ent["samples"]))
+    return "\n".join(lines) + "\n"
+
+
+def export_text() -> str:
+    """Prometheus text exposition of this process's registry."""
+    return render_exposition(snapshot())

@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.core.retry import backoff_delay_s
+from ray_tpu.metrics import metric_defs as _mdefs
 from ray_tpu.train.backend import BackendConfig, JaxConfig
 from ray_tpu.train.backend_executor import (
     BackendExecutor,
@@ -167,6 +168,8 @@ class DataParallelTrainer(BaseTrainer):
                 self._start_with_capacity_wait(executor, reform)
                 width = len(executor.worker_group)
                 if reform:
+                    _mdefs.inc("rt_train_elastic_events_total",
+                               tags={"kind": "reform"})
                     self._elastic_events.append({
                         "kind": "reform", "width": width,
                         "target": self.scaling_config.num_workers,
@@ -181,10 +184,18 @@ class DataParallelTrainer(BaseTrainer):
                 stop_requested = False
                 pause_for_regrow = False
                 regrow_last_probe = time.monotonic()
+                t_last_round = time.monotonic()
                 while True:
                     results = executor.get_next_results()
                     if results is None:
                         break
+                    # wall time between delivered rounds — the driver's
+                    # view of step time, including report/backpressure
+                    _mdefs.observe(
+                        "rt_train_step_seconds",
+                        time.monotonic() - t_last_round,
+                    )
+                    t_last_round = time.monotonic()
                     iteration += 1
                     rank0 = results[0]
                     metrics = dict(rank0.metrics or {})
@@ -231,6 +242,8 @@ class DataParallelTrainer(BaseTrainer):
                             pause_for_regrow = True
                             executor.request_stop_all()
                 if pause_for_regrow:
+                    _mdefs.inc("rt_train_elastic_events_total",
+                               tags={"kind": "regrow"})
                     self._elastic_events.append({
                         "kind": "regrow", "width_from": width,
                         "iteration": iteration, "wall": time.time(),
@@ -245,6 +258,8 @@ class DataParallelTrainer(BaseTrainer):
                 break
             except ElasticWorkerLost as e:
                 failovers += 1
+                _mdefs.inc("rt_train_elastic_events_total",
+                           tags={"kind": "shrink"})
                 self._elastic_events.append({
                     "kind": "shrink", "lost_ranks": dict(e.lost_ranks),
                     "width": e.width, "iteration": iteration,

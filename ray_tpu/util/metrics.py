@@ -1,160 +1,31 @@
 """Application metrics: Counter / Gauge / Histogram.
 
-Reference: `python/ray/util/metrics.py` — the user-facing metric types
-(also used internally by the libraries), collected in a per-process
-registry and exported in Prometheus text exposition format (the
-reference exports via the per-node metrics agent; here `export_text()`
-serves the same scrape format directly).
+Reference: `python/ray/util/metrics.py` — the user-facing metric types.
+The implementation moved to :mod:`ray_tpu.metrics.registry` when the
+unified observability plane landed (central catalog in
+`ray_tpu/metrics/metric_defs.py`, cluster-wide collection in
+`ray_tpu/metrics/exporter.py`); this module stays as the stable
+user-facing import path, matching the reference's layout.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from ray_tpu.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    export_text,
+    render_exposition,
+    snapshot,
+)
 
-_registry_lock = threading.Lock()
-_registry: List["Metric"] = []
-
-
-def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
-    return tuple(sorted((labels or {}).items()))
-
-
-class Metric:
-    def __init__(self, name: str, description: str = "",
-                 tag_keys: Sequence[str] = ()):
-        self.name = name
-        self.description = description
-        self.tag_keys = tuple(tag_keys)
-        self._default_tags: Dict[str, str] = {}
-        self._lock = threading.Lock()
-        with _registry_lock:
-            _registry.append(self)
-
-    def set_default_tags(self, tags: Dict[str, str]):
-        self._default_tags = dict(tags)
-        return self
-
-    def _merge(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
-        merged = dict(self._default_tags)
-        merged.update(tags or {})
-        return merged
-
-    def _samples(self) -> List[Tuple[Dict[str, str], float]]:
-        raise NotImplementedError
-
-    def _type(self) -> str:
-        raise NotImplementedError
-
-
-class Counter(Metric):
-    def __init__(self, name, description="", tag_keys=()):
-        super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, float] = {}
-
-    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
-        if value < 0:
-            raise ValueError("counters only increase")
-        key = _label_key(self._merge(tags))
-        with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + value
-
-    def _samples(self):
-        with self._lock:
-            return [(dict(k), v) for k, v in self._values.items()]
-
-    def _type(self):
-        return "counter"
-
-
-class Gauge(Metric):
-    def __init__(self, name, description="", tag_keys=()):
-        super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, float] = {}
-
-    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
-        with self._lock:
-            self._values[_label_key(self._merge(tags))] = float(value)
-
-    def clear(self):
-        """Drop all tagged series — refresh-style exporters that
-        recompute the full tag set each pass call this first so
-        vanished tag values (a deleted app, a drained state) stop
-        exporting stale samples."""
-        with self._lock:
-            self._values.clear()
-
-    def _samples(self):
-        with self._lock:
-            return [(dict(k), v) for k, v in self._values.items()]
-
-    def _type(self):
-        return "gauge"
-
-
-class Histogram(Metric):
-    def __init__(self, name, description="", boundaries: Sequence[float] = (),
-                 tag_keys=()):
-        super().__init__(name, description, tag_keys)
-        self.boundaries = sorted(boundaries) or [0.1, 1, 10, 100]
-        self._counts: Dict[Tuple, List[int]] = {}
-        self._sums: Dict[Tuple, float] = {}
-
-    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _label_key(self._merge(tags))
-        with self._lock:
-            counts = self._counts.setdefault(
-                key, [0] * (len(self.boundaries) + 1)
-            )
-            self._sums[key] = self._sums.get(key, 0.0) + value
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    counts[i] += 1
-                    return
-            counts[-1] += 1
-
-    def _samples(self):
-        out = []
-        with self._lock:
-            for key, counts in self._counts.items():
-                labels = dict(key)
-                cum = 0
-                for b, c in zip(self.boundaries, counts):
-                    cum += c
-                    out.append(({**labels, "le": str(b)}, float(cum)))
-                cum += counts[-1]
-                out.append(({**labels, "le": "+Inf"}, float(cum)))
-                out.append(({**labels, "__count__": "1"}, float(cum)))
-                out.append(({**labels, "__sum__": "1"}, self._sums[key]))
-        return out
-
-    def _type(self):
-        return "histogram"
-
-
-def export_text() -> str:
-    """Prometheus text exposition of every registered metric."""
-    lines: List[str] = []
-    with _registry_lock:
-        metrics = list(_registry)
-    for m in metrics:
-        if m.description:
-            lines.append(f"# HELP {m.name} {m.description}")
-        lines.append(f"# TYPE {m.name} {m._type()}")
-        for labels, value in m._samples():
-            if "__sum__" in labels:
-                labels = {k: v for k, v in labels.items() if k != "__sum__"}
-                name = f"{m.name}_sum"
-            elif "__count__" in labels:
-                labels = {k: v for k, v in labels.items() if k != "__count__"}
-                name = f"{m.name}_count"
-            elif "le" in labels:
-                name = f"{m.name}_bucket"
-            else:
-                name = m.name
-            if labels:
-                inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-                lines.append(f"{name}{{{inner}}} {value}")
-            else:
-                lines.append(f"{name} {value}")
-    return "\n".join(lines) + "\n"
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Metric",
+    "export_text",
+    "render_exposition",
+    "snapshot",
+]

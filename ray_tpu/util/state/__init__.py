@@ -116,27 +116,28 @@ def _sum_resources(nodes) -> Dict[str, float]:
     return total
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-tracing events from the task event log (reference:
-    `ray.timeline()` — `_private/state.py:948` chrome_tracing_dump).
-    Load the output in chrome://tracing or Perfetto."""
-    events = list_tasks(limit=50_000)
-    # FINISHED events carry the execution duration; place complete
-    # events ("X") at ts-duration for each finished task
-    trace: List[Dict[str, Any]] = []
-    for ev in events:
-        if ev["state"] in ("FINISHED", "FAILED") and ev.get("duration"):
-            dur_us = ev["duration"] * 1e6
-            trace.append({
-                "name": ev["name"],
-                "cat": "task",
-                "ph": "X",
-                "ts": ev["ts"] * 1e6 - dur_us,
-                "dur": dur_us,
-                "pid": ev.get("node_id", "cluster"),
-                "tid": ev.get("worker_id", ev["task_id"][:8]),
-                "args": {"task_id": ev["task_id"], "state": ev["state"]},
-            })
+def timeline(filename: Optional[str] = None,
+             trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-tracing events from the task event log MERGED with the
+    cluster-collected trace spans (reference: `ray.timeline()` —
+    `_private/state.py:948` chrome_tracing_dump, plus the otel span
+    view the reference splits across tools).  One builder feeds this
+    and `GET /api/timeline` (`dashboard/timeline.py`), so the two
+    surfaces can never drift.  Load the output in chrome://tracing or
+    Perfetto; `trace_id` narrows the span set to one request's
+    lineage."""
+    from ray_tpu.dashboard.timeline import build_chrome_trace
+
+    data = get_runtime().controller_call(
+        "timeline_data", {"trace_id": trace_id}
+    ) or {}
+    doc = build_chrome_trace(
+        data.get("events", []),
+        data.get("spans", []),
+        events_truncated=data.get("events_truncated", False),
+        spans_truncated=data.get("spans_truncated", False),
+    )
+    trace = doc["traceEvents"]
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -1,25 +1,43 @@
-"""Distributed tracing: span capture with cross-task context propagation.
+"""Distributed tracing: span capture with cross-task context propagation
+and batched cluster-wide collection.
 
 Reference: `python/ray/util/tracing/tracing_helper.py` — opt-in
 OpenTelemetry tracing where remote calls and task execution are wrapped
 in spans and the trace context rides the task metadata
 (`_DictPropagator:165`).  The same design here without the otel
 dependency: spans are plain dicts, the context propagates inside
-`TaskSpec.trace_ctx`, and a pluggable exporter receives finished spans
-(wire an OTLP exporter there when the package exists; the default
-keeps an in-process ring readable via `get_spans`).
+`TaskSpec.trace_ctx` (tasks, actor calls, serve handle hops, shuffle
+map→reduce lineage all ride it), and finished spans batch-export to the
+driver's controller — one frame per process per flush period, riding
+the task-event flush that already runs (`core/runtime.py`) or the node
+daemon's obs loop (`core/noded.py`).  The controller keeps a bounded
+ring keyed by trace id that `/api/timeline` merges with task events
+into one whole-run Chrome trace (`dashboard/timeline.py`).
 
 Usage:
     from ray_tpu.util import tracing
-    tracing.enable()           # in the driver, before submitting
-    ... rt.remote work ...
-    spans = tracing.get_spans()   # every process exports its own spans
+    tracing.enable()           # in the driver, before rt.init
+    with tracing.span("my-phase"):
+        ... rt.remote work ...
+    spans = tracing.get_spans()       # this process's ring
+    # cluster-collected spans: rt.timeline() / GET /api/timeline
+
+Overhead knobs (all off/neutral by default — tracing itself defaults
+OFF):
+    RT_TRACING_ENABLED=1     master switch (propagates to children)
+    RT_TRACE_SAMPLE=0.1      head-sample: fraction of NEW traces kept.
+                             Decided once at the root; a sampled-out
+                             root propagates its NEGATIVE decision
+                             (ambient + over the wire), so no
+                             descendant re-rolls into orphan fragments
+                             and the whole lineage does zero span work
 """
 
 from __future__ import annotations
 
 import contextvars
 import os
+import random
 import threading
 import time
 import uuid
@@ -27,10 +45,24 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 _ENV_FLAG = "RT_TRACING_ENABLED"
+_ENV_SAMPLE = "RT_TRACE_SAMPLE"
+
+# finished spans awaiting batch export to the controller; bounded so a
+# span storm between flushes degrades to counted drops, never to
+# unbounded memory
+EXPORT_BUFFER = 20_000
 
 _lock = threading.Lock()
 _spans: deque = deque(maxlen=10_000)
+_export_queue: deque = deque()
+_export_dropped = 0
 _exporter: Optional[Callable[[Dict[str, Any]], None]] = None
+# sampling rng: per-process, seeded from entropy; RT_TRACE_SEED pins it
+# for deterministic tests
+_sample_rng = random.Random(
+    int(os.environ["RT_TRACE_SEED"]) if os.environ.get("RT_TRACE_SEED")
+    else None
+)
 # contextvar, NOT threading.local: async actor tasks interleave on one
 # event-loop thread and must each carry their own active span
 _ctx_var: contextvars.ContextVar = contextvars.ContextVar(
@@ -52,6 +84,33 @@ def is_enabled() -> bool:
     return os.environ.get(_ENV_FLAG, "") == "1"
 
 
+def sample_rate() -> float:
+    try:
+        return min(1.0, max(0.0, float(os.environ.get(_ENV_SAMPLE, "1"))))
+    except ValueError:
+        return 1.0
+
+
+def _sampled() -> bool:
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    return _sample_rng.random() < rate
+
+
+# The NEGATIVE sampling decision, made once at a trace's root and then
+# propagated exactly like a real context — through the ambient
+# contextvar AND over the wire in `TaskSpec.trace_ctx` — so no
+# descendant (nested submit, worker execution, daemon hop) ever
+# re-rolls sampling into an orphan fragment trace.  Falsy trace_id ==
+# "this lineage does no span work".
+NOT_SAMPLED: Dict[str, str] = {"trace_id": "", "span_id": ""}
+
+
+def _is_not_sampled(ctx: Optional[Dict[str, str]]) -> bool:
+    return ctx is not None and not ctx.get("trace_id")
+
+
 def set_span_exporter(fn: Optional[Callable[[Dict[str, Any]], None]]):
     """Every finished span is passed to fn (e.g. an OTLP exporter);
     None restores the in-process ring only."""
@@ -67,6 +126,25 @@ def get_spans() -> List[Dict[str, Any]]:
 def clear_spans():
     with _lock:
         _spans.clear()
+        _export_queue.clear()
+
+
+def drain_export() -> List[Dict[str, Any]]:
+    """Pop every span queued for cluster collection (called by the
+    periodic obs flush; one batched frame per period).  Drops since the
+    last drain surface as `rt_trace_spans_dropped_total`."""
+    global _export_dropped
+    with _lock:
+        out = list(_export_queue)
+        _export_queue.clear()
+        dropped, _export_dropped = _export_dropped, 0
+    if dropped:
+        from ray_tpu.metrics import metric_defs as _md
+
+        # unconditional: a drop is the signal that sampling/flush
+        # cadence needs tuning — it must not itself be sampled away
+        _md.metric("rt_trace_spans_dropped_total").inc(dropped)
+    return out
 
 
 def _new_id() -> str:
@@ -80,8 +158,13 @@ def current_context() -> Optional[Dict[str, str]]:
 
 
 def _record(span: Dict[str, Any]):
+    global _export_dropped
     with _lock:
         _spans.append(span)
+        if len(_export_queue) < EXPORT_BUFFER:
+            _export_queue.append(span)
+        else:
+            _export_dropped += 1
     if _exporter is not None:
         try:
             _exporter(span)
@@ -91,10 +174,16 @@ def _record(span: Dict[str, Any]):
 
 def make_submit_ctx(task_name: str) -> Optional[Dict[str, str]]:
     """Called at task submission: returns the trace context to embed in
-    the spec, recording a zero-duration 'submit' span."""
+    the spec, recording a zero-duration 'submit' span.  A NEW root is
+    head-sampled (RT_TRACE_SAMPLE); a propagated parent is always kept
+    — sampling is decided once per trace, at its root."""
     if not is_enabled():
         return None
     parent = current_context()
+    if _is_not_sampled(parent):
+        return dict(NOT_SAMPLED)  # propagate the decision, no span
+    if parent is None and not _sampled():
+        return dict(NOT_SAMPLED)
     trace_id = parent["trace_id"] if parent else _new_id()
     span_id = _new_id()
     now = time.time()
@@ -110,6 +199,147 @@ def make_submit_ctx(task_name: str) -> Optional[Dict[str, str]]:
     return {"trace_id": trace_id, "span_id": span_id}
 
 
+def record_instant(name: str, trace_ctx: Optional[Dict[str, str]],
+                   kind: str = "INTERNAL", **attrs):
+    """Zero-duration span parented to `trace_ctx` — how owner-side
+    retry attempts and daemon-side scheduling hops appear in a trace
+    without wrapping any execution."""
+    if trace_ctx is None or not trace_ctx.get("trace_id") \
+            or not is_enabled():
+        return
+    now = time.time()
+    span = {
+        "name": name,
+        "trace_id": trace_ctx["trace_id"],
+        "span_id": _new_id(),
+        "parent_id": trace_ctx.get("span_id"),
+        "start": now,
+        "end": now,
+        "kind": kind,
+    }
+    if attrs:
+        span["attrs"] = attrs
+    _record(span)
+
+
+class span:
+    """Context manager for a driver-side (or any in-process) span:
+    everything submitted inside is parented under it, so a multi-stage
+    operation (a shuffle's map→reduce lineage, a user phase) shares one
+    trace id end to end."""
+
+    def __init__(self, name: str, kind: str = "INTERNAL"):
+        self._name = name
+        self._kind = kind
+        self._span: Optional[Dict[str, Any]] = None
+        self._token = None
+
+    def __enter__(self):
+        if not is_enabled():
+            return self
+        parent = current_context()
+        if _is_not_sampled(parent):
+            return self  # decision already made upstream
+        if parent is None and not _sampled():
+            # make the negative decision ambient so everything inside
+            # this block (and everything it submits) skips uniformly
+            self._token = _ctx_var.set(dict(NOT_SAMPLED))
+            return self
+        trace_id = parent["trace_id"] if parent else _new_id()
+        span_id = _new_id()
+        self._span = {
+            "name": self._name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent["span_id"] if parent else None,
+            "start": time.time(),
+            "kind": self._kind,
+        }
+        self._token = _ctx_var.set(
+            {"trace_id": trace_id, "span_id": span_id}
+        )
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._span["end"] = time.time()
+            if exc_type is not None:
+                self._span["error"] = exc_type.__name__
+            _record(self._span)
+        if self._token is not None:
+            _ctx_var.reset(self._token)
+            self._token = None
+        return False
+
+
+# -- explicit-context helpers (generator-shaped drivers) ---------------
+# A `with span(...)` around a generator body would leak the ambient
+# contextvar into the CALLER between yields (contextvars do not revert
+# at generator suspension).  Drivers shaped like that (the shuffle
+# exchange) open a span explicitly and scope the ambient context only
+# around each submission batch.
+def start_span(name: str, kind: str = "INTERNAL") -> Optional[Dict[str, Any]]:
+    """Open a span WITHOUT touching the ambient context; parent is the
+    caller's current context.  Finish with `finish_span`; pass
+    `ctx_of(span)` to `use_context` around submissions that should nest
+    under it.  None when tracing is off; when the root is sampled out
+    it returns the NOT_SAMPLED record, whose ctx_of() propagates the
+    negative decision to every submission scoped under it."""
+    if not is_enabled():
+        return None
+    parent = current_context()
+    if _is_not_sampled(parent):
+        return dict(NOT_SAMPLED)
+    if parent is None and not _sampled():
+        return dict(NOT_SAMPLED)
+    trace_id = parent["trace_id"] if parent else _new_id()
+    return {
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": _new_id(),
+        "parent_id": parent["span_id"] if parent else None,
+        "start": time.time(),
+        "kind": kind,
+    }
+
+
+def ctx_of(span_rec: Optional[Dict[str, Any]]) -> Optional[Dict[str, str]]:
+    if span_rec is None:
+        return None
+    return {"trace_id": span_rec["trace_id"], "span_id": span_rec["span_id"]}
+
+
+def finish_span(span_rec: Optional[Dict[str, Any]],
+                error: Optional[str] = None):
+    if span_rec is None or not span_rec.get("trace_id"):
+        return  # tracing off, or a NOT_SAMPLED marker — nothing opened
+    span_rec["end"] = time.time()
+    if error:
+        span_rec["error"] = error
+    _record(span_rec)
+
+
+class use_context:
+    """Temporarily install `ctx` as the ambient trace context (set +
+    reset in the same frame — safe inside generator bodies).  None is
+    a no-op, so call sites need no tracing-enabled branches."""
+
+    def __init__(self, ctx: Optional[Dict[str, str]]):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self):
+        if self._ctx is not None:
+            self._token = _ctx_var.set(dict(self._ctx))
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            _ctx_var.reset(self._token)
+            self._token = None
+        return False
+
+
 class execution_span:
     """Context manager wrapping task execution on the worker; nested
     submits from inside pick up this span as their parent."""
@@ -117,11 +347,17 @@ class execution_span:
     def __init__(self, task_name: str, trace_ctx: Optional[Dict[str, str]]):
         self._name = task_name
         self._ctx = trace_ctx
-        self._prev = None
+        self._token = None
         self._span: Optional[Dict[str, Any]] = None
 
     def __enter__(self):
         if self._ctx is None:
+            return self
+        if not self._ctx.get("trace_id"):
+            # NOT_SAMPLED lineage arriving over the wire: record
+            # nothing, but keep the negative decision ambient so
+            # nested submits from this task skip too (never re-roll)
+            self._token = _ctx_var.set(dict(NOT_SAMPLED))
             return self
         span_id = _new_id()
         self._span = {
@@ -143,5 +379,7 @@ class execution_span:
             if exc_type is not None:
                 self._span["error"] = exc_type.__name__
             _record(self._span)
+        if self._token is not None:
             _ctx_var.reset(self._token)
+            self._token = None
         return False

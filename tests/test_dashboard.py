@@ -57,6 +57,10 @@ def test_api_endpoints(dash):
 
     status, body = _get(dash + "/api/timeline")
     assert status == 200
+    doc = json.loads(body)
+    # object format: merged trace document with honest truncation flags
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["truncated"] is False  # tiny run: nothing clipped
 
     status, body = _get(dash + "/metrics")
     assert status == 200

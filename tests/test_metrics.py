@@ -1,0 +1,237 @@
+"""Unified metrics plane tests (reference: `python/ray/tests/
+test_metrics_agent.py` over `src/ray/stats/metric_defs.h`): the
+cataloged registry, snapshot/exposition round-trip, the controller-side
+sink, and the task-event buffer's eviction accounting.
+
+No cluster: everything here is the in-process half of the plane (the
+wire half is covered by `test_observability.py`)."""
+
+import threading
+
+import pytest
+
+from ray_tpu.core.task_events import TaskEventBuffer
+from ray_tpu.metrics import metric_defs as mdefs
+from ray_tpu.metrics.exporter import MetricsSink, collect_frame
+from ray_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    render_exposition,
+    snapshot,
+)
+
+
+# ---------------------------------------------------------------------
+# catalog
+# ---------------------------------------------------------------------
+def test_catalog_lazy_singleton_and_unknown_name():
+    m1 = mdefs.metric("rt_owner_tasks_submitted_total")
+    m2 = mdefs.metric("rt_owner_tasks_submitted_total")
+    assert m1 is m2
+    assert m1._type() == "counter"
+    h = mdefs.metric("rt_owner_task_latency_seconds")
+    assert h._type() == "histogram" and h.boundaries  # cataloged buckets
+    with pytest.raises(KeyError):
+        mdefs.metric("rt_not_in_the_catalog_total")
+
+
+def test_catalog_entries_instantiate_with_declared_types():
+    for name, (typ, help_, _tags, bounds) in mdefs.CATALOG.items():
+        m = mdefs.metric(name)
+        assert m._type() == typ, name
+        assert m.description == help_, name
+        if typ == "histogram":
+            assert list(m.boundaries) == sorted(bounds), name
+
+
+def test_gated_helpers_noop_when_disabled():
+    was = mdefs.enabled()
+    mdefs.set_enabled(False)
+    try:
+        c = mdefs.metric("rt_owner_lease_grants_total")
+        before = dict(c._values)
+        mdefs.inc("rt_owner_lease_grants_total", 5.0,
+                  tags={"shard": "gate-test"})
+        mdefs.observe("rt_owner_lease_latency_seconds", 1.0,
+                      tags={"shard": "gate-test"})
+        assert dict(c._values) == before  # nothing recorded
+        mdefs.set_enabled(True)
+        mdefs.inc("rt_owner_lease_grants_total", 5.0,
+                  tags={"shard": "gate-test"})
+        assert any("gate-test" in str(k) for k in c._values)
+    finally:
+        mdefs.set_enabled(was)
+
+
+def test_set_enabled_mirrors_env_for_children():
+    import os
+
+    was = mdefs.enabled()
+    try:
+        mdefs.set_enabled(True)
+        assert os.environ.get("RT_METRICS_ENABLED") == "1"
+        mdefs.set_enabled(False)
+        assert "RT_METRICS_ENABLED" not in os.environ
+    finally:
+        mdefs.set_enabled(was)
+
+
+# ---------------------------------------------------------------------
+# snapshot / exposition
+# ---------------------------------------------------------------------
+def test_snapshot_and_exposition_round_trip():
+    c = Counter("t_obs_requests_total", "requests", ("route",))
+    c.inc(3, tags={"route": "/a"})
+    h = Histogram("t_obs_latency_seconds", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    text = render_exposition(snapshot())
+    assert "# TYPE t_obs_requests_total counter" in text
+    assert 't_obs_requests_total{route="/a"} 3.0' in text
+    assert 't_obs_latency_seconds_bucket{le="0.1"} 1.0' in text
+    assert 't_obs_latency_seconds_bucket{le="+Inf"} 2.0' in text
+    assert "t_obs_latency_seconds_count 2.0" in text
+    assert "t_obs_latency_seconds_sum 5.05" in text
+
+
+def test_exposition_merges_same_family_under_one_header():
+    # two processes' snapshots of the same metric family must share ONE
+    # HELP/TYPE header (Prometheus rejects duplicates), with samples
+    # kept distinct by their origin tags
+    snaps = [
+        {"name": "t_obs_merge_total", "type": "counter", "help": "m",
+         "samples": [[{"proc": "a"}, 1.0]]},
+        {"name": "t_obs_merge_total", "type": "counter", "help": "m",
+         "samples": [[{"proc": "b"}, 2.0]]},
+    ]
+    text = render_exposition(snaps)
+    assert text.count("# TYPE t_obs_merge_total counter") == 1
+    assert 't_obs_merge_total{proc="a"} 1.0' in text
+    assert 't_obs_merge_total{proc="b"} 2.0' in text
+
+
+def test_snapshot_extra_tags_fold_into_every_sample():
+    g = Gauge("t_obs_tagged_gauge")
+    g.set(4.2)
+    snap = [m for m in snapshot(extra_tags={"node": "n1"})
+            if m["name"] == "t_obs_tagged_gauge"]
+    assert snap and all(
+        labels.get("node") == "n1" for labels, _ in snap[0]["samples"]
+    )
+
+
+# ---------------------------------------------------------------------
+# controller-side sink
+# ---------------------------------------------------------------------
+def test_sink_latest_snapshot_wins_and_origin_tags():
+    sink = MetricsSink()
+    frame = {"node_id": "node1234abcd", "kind": "worker", "pid": 7,
+             "metrics": [{"name": "x_total", "type": "counter",
+                          "help": "", "samples": [[{}, 1.0]]}]}
+    sink.ingest(frame)
+    sink.ingest({**frame, "metrics": [
+        {"name": "x_total", "type": "counter", "help": "",
+         "samples": [[{}, 9.0]]}]})
+    assert sink.reporter_count() == 1  # same reporter: latest wins
+    merged = sink.merged()
+    assert len(merged) == 1
+    [[labels, value]] = merged[0]["samples"]
+    assert value == 9.0
+    assert labels == {"node": "node1234", "proc": "worker:7"}
+
+
+def test_sink_expires_silent_reporters():
+    import time
+
+    sink = MetricsSink(ttl_s=0.05)
+    sink.ingest({"node_id": "n", "kind": "noded", "pid": 1,
+                 "metrics": [{"name": "y", "samples": [[{}, 1.0]]}]})
+    assert sink.reporter_count() == 1
+    time.sleep(0.08)
+    assert sink.merged() == []  # staleness: dead series vanish
+    assert sink.reporter_count() == 0
+
+
+def test_collect_frame_skips_empty_registry():
+    # a process whose registry holds no samples ships nothing: frames
+    # only exist when there is data (collect_frame returns None) —
+    # proven against a name guaranteed fresh in this process
+    frame = collect_frame("n", "driver", 1)
+    if frame is not None:  # other tests already populated the registry
+        assert frame["metrics"]
+    c = Counter("t_obs_frame_total")
+    c.inc()
+    frame = collect_frame("nodeX", "driver", 42)
+    assert frame is not None and frame["pid"] == 42
+    names = [m["name"] for m in frame["metrics"]]
+    assert "t_obs_frame_total" in names
+
+
+# ---------------------------------------------------------------------
+# TaskEventBuffer: bounded-size eviction accounting
+# ---------------------------------------------------------------------
+def test_task_event_buffer_record_drain_order():
+    buf = TaskEventBuffer(max_buffer=10)
+    for i in range(5):
+        buf.record(bytes([i]), f"t{i}", "SUBMITTED")
+    out = buf.drain()
+    assert [e["name"] for e in out] == [f"t{i}" for i in range(5)]
+    assert buf.drain() == []  # drained clean
+    assert buf.dropped_total == 0
+
+
+def test_task_event_buffer_evicts_oldest_and_accounts():
+    buf = TaskEventBuffer(max_buffer=3)
+    for i in range(5):
+        buf.record(bytes([i]), f"t{i}", "SUBMITTED")
+    out = buf.drain()
+    # the WINDOW slid forward: newest 3 survive, oldest 2 evicted,
+    # and the drain carries an explicit marker event
+    assert [e["name"] for e in out[:-1]] == ["t2", "t3", "t4"]
+    marker = out[-1]
+    assert marker["name"] == "__dropped__" and marker["count"] == 2
+    assert buf.dropped_total == 2
+    # the dropped counter also surfaced as the cataloged metric
+    m = mdefs.metric("rt_task_events_dropped_total")
+    assert sum(v for _, v in m._samples()) >= 2
+
+
+def test_task_event_buffer_concurrent_writers():
+    """Record/drain under concurrent writers: nothing is lost silently
+    — every event is either drained or counted as dropped — and each
+    writer's events stay in its submission order across drains."""
+    buf = TaskEventBuffer(max_buffer=64)
+    n_threads, per_thread = 4, 500
+    drained: list = []
+    stop = threading.Event()
+
+    def writer(tid: int):
+        for seq in range(per_thread):
+            buf.record(bytes([tid]), f"w{tid}", str(seq))
+
+    def drainer():
+        while not stop.is_set():
+            drained.extend(buf.drain())
+        drained.extend(buf.drain())
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    d = threading.Thread(target=drainer)
+    d.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    d.join()
+    events = [e for e in drained if e["name"] != "__dropped__"]
+    marker_total = sum(e["count"] for e in drained
+                      if e["name"] == "__dropped__")
+    assert marker_total == buf.dropped_total
+    assert len(events) + buf.dropped_total == n_threads * per_thread
+    # per-writer order survives eviction (oldest-first) and draining
+    for t in range(n_threads):
+        seqs = [int(e["state"]) for e in events if e["name"] == f"w{t}"]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs)  # no duplicates

@@ -1,0 +1,295 @@
+"""Unified observability plane, end to end (reference: the metrics
+agent + otel tracing + dashboard timeline stack): a distributed run —
+multi-worker task graph through a serve handle hop — must produce ONE
+merged Chrome-trace timeline containing driver, daemon, and worker
+spans correlated by trace id, and `/metrics` must serve Prometheus
+text exposition with the cataloged metric names collected from every
+process."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+from ray_tpu.core.controller import Controller
+from ray_tpu.dashboard.timeline import build_chrome_trace
+from ray_tpu.metrics import metric_defs as mdefs
+from ray_tpu.util import tracing
+
+
+# ---------------------------------------------------------------------
+# timeline builder units (no cluster)
+# ---------------------------------------------------------------------
+def _ev(tid, state, ts, dur=None, **kw):
+    ev = {"task_id": tid, "name": kw.pop("name", "t"), "state": state,
+          "ts": ts, **kw}
+    if dur is not None:
+        ev["duration"] = dur
+    return ev
+
+
+def test_timeline_finished_tasks_are_complete_slices():
+    doc = build_chrome_trace([_ev("aa", "SUBMITTED", 1.0),
+                              _ev("aa", "FINISHED", 2.0, dur=0.5)])
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["dur"] == pytest.approx(0.5e6)
+    # terminal latest state: the task must NOT also appear in-flight
+    assert not [e for e in doc["traceEvents"] if e["ph"] == "B"]
+    assert doc["truncated"] is False
+
+
+def test_timeline_emits_running_tasks_as_begin_events():
+    # in-flight work is VISIBLE (ph:"B"), not silently dropped — the
+    # old endpoint rendered finished tasks only
+    doc = build_chrome_trace([_ev("aa", "SUBMITTED", 1.0),
+                              _ev("bb", "RUNNING", 2.0),
+                              _ev("cc", "FINISHED", 3.0, dur=1.0)])
+    bs = {e["args"]["task_id"]: e for e in doc["traceEvents"]
+          if e["ph"] == "B"}
+    assert set(bs) == {"aa", "bb"}
+    assert bs["bb"]["args"]["state"] == "RUNNING"
+
+
+def test_timeline_terminal_state_wins_timestamp_ties():
+    # events from different processes land in arbitrary order: a
+    # FINISHED at the same ts as RUNNING must close the task
+    doc = build_chrome_trace([_ev("aa", "FINISHED", 2.0, dur=0.5),
+                              _ev("aa", "RUNNING", 2.0)])
+    assert not [e for e in doc["traceEvents"] if e["ph"] == "B"]
+
+
+def test_timeline_merges_spans_with_truncation_flags():
+    span = {"name": "submit:f", "trace_id": "t1", "span_id": "s1",
+            "parent_id": None, "start": 1.0, "end": 1.25,
+            "kind": "PRODUCER", "node": "n1", "proc": "driver:7",
+            "attrs": {"attempt": 2}}
+    doc = build_chrome_trace([], [span], spans_truncated=True)
+    [e] = doc["traceEvents"]
+    assert e["cat"] == "span" and e["tid"] == "driver:7"
+    assert e["args"]["trace_id"] == "t1" and e["args"]["attempt"] == 2
+    assert e["dur"] == pytest.approx(0.25e6)
+    assert doc["truncated"] is True and doc["events_truncated"] is False
+
+
+# ---------------------------------------------------------------------
+# controller collection units (no cluster)
+# ---------------------------------------------------------------------
+class _FakeConn:
+    def send(self, *a, **k):
+        pass
+
+
+def test_controller_obs_frame_stamps_origin_and_collects():
+    ctl = Controller()
+    reply = asyncio.run(ctl.handle_report_obs({
+        "node_id": "node1234beef", "kind": "worker", "pid": 9,
+        "spans": [{"name": "run:f", "trace_id": "t1", "span_id": "a",
+                   "start": 1.0, "end": 2.0},
+                  "garbage-not-a-dict"],
+        "metrics": [{"name": "rt_obs_frames_sent_total",
+                     "type": "counter", "help": "",
+                     "samples": [[{}, 3.0]]}],
+    }, _FakeConn()))
+    assert reply == {"ok": True}
+    spans = asyncio.run(ctl.handle_list_trace_spans(
+        {"trace_id": "t1"}, _FakeConn()))
+    assert len(spans) == 1  # the malformed entry was refused
+    assert spans[0]["node"] == "node1234" and spans[0]["proc"] == "worker:9"
+    merged = asyncio.run(ctl.handle_cluster_metrics({}, _FakeConn()))
+    assert merged["reporters"] == 1
+    [[labels, value]] = merged["metrics"][0]["samples"]
+    assert value == 3.0 and labels["proc"] == "worker:9"
+
+
+def test_controller_timeline_data_reports_source_drops():
+    # a reporter's TaskEventBuffer overflowed (__dropped__ marker in
+    # its flush): the window is incomplete at the SOURCE, so the
+    # timeline must say truncated even though this ring never evicted
+    ctl = Controller()
+    asyncio.run(ctl.handle_report_task_events({
+        "events": [{"task_id": "aa", "name": "t", "state": "FINISHED",
+                    "ts": 1.0, "duration": 0.1},
+                   {"task_id": "", "name": "__dropped__",
+                    "state": "DROPPED", "ts": 2.0, "count": 7}],
+    }, _FakeConn()))
+    data = asyncio.run(ctl.handle_timeline_data({}, _FakeConn()))
+    assert data["events_truncated"] is True
+    assert data["spans_truncated"] is False
+
+
+def test_controller_timeline_data_reports_ring_eviction():
+    from collections import deque
+
+    ctl = Controller()
+    ctl.trace_spans = deque(maxlen=3)  # tiny ring for the test
+    for i in range(5):
+        asyncio.run(ctl.handle_report_obs({
+            "node_id": "n", "kind": "driver", "pid": 1,
+            "spans": [{"name": f"s{i}", "trace_id": "t",
+                       "span_id": str(i), "start": float(i)}],
+        }, _FakeConn()))
+    data = asyncio.run(ctl.handle_timeline_data({}, _FakeConn()))
+    assert [s["name"] for s in data["spans"]] == ["s2", "s3", "s4"]
+    assert data["spans_truncated"] is True  # eviction is never silent
+    assert data["events_truncated"] is False
+
+
+# ---------------------------------------------------------------------
+# the distributed acceptance run
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def obs_cluster():
+    tracing.enable()          # before init: every process inherits
+    mdefs.set_enabled(True)   # mirrors RT_METRICS_ENABLED for children
+    rt.init(num_workers=4, num_cpus=16, ignore_reinit_error=True,
+            _system_config={
+                "metrics_enabled": True,
+                # ephemeral Prometheus listener on every daemon
+                "metrics_http_port": -1,
+                # fast obs frames so collection asserts converge quickly
+                "metrics_report_interval_ms": 300,
+            })
+    yield
+    serve.shutdown()
+    rt.shutdown()
+    mdefs.set_enabled(False)
+    tracing.disable()
+
+
+@rt.remote
+def _obs_leaf(x):
+    return x + 1
+
+
+@serve.deployment
+class _ObsPipeline:
+    def __call__(self, x):
+        # the serve hop fans out a multi-worker task graph
+        refs = [_obs_leaf.remote(x + i) for i in range(3)]
+        return sum(rt.get(refs))
+
+
+def _controller_spans(trace_id, min_procs, timeout=20.0):
+    """Poll the driver-side collector until spans for `trace_id` from
+    at least `min_procs` distinct processes arrived (obs frames ship on
+    a cadence; worker/daemon frames ride their own connections)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    deadline = time.time() + timeout
+    spans = []
+    while time.time() < deadline:
+        spans = get_runtime().controller_call(
+            "list_trace_spans", {"trace_id": trace_id}) or []
+        kinds = {s.get("proc", "?").split(":")[0] for s in spans}
+        if len(kinds) >= min_procs:
+            return spans
+        time.sleep(0.4)
+    return spans
+
+
+def test_distributed_run_one_merged_trace(obs_cluster):
+    """THE acceptance criterion: driver, daemon, and worker spans of
+    one distributed request — serve handle hop fanning out tasks, plus
+    a daemon-routed SPREAD task — correlate under ONE trace id in the
+    collected timeline."""
+    h = serve.run(_ObsPipeline.bind(), name="obsapp",
+                  route_prefix="/obsapp")
+    tracing.clear_spans()
+    with tracing.span("obs-e2e-root"):
+        assert h.remote(10).result(timeout_s=30) == 36  # 11+12+13
+        # SPREAD routes through the node daemon's scheduler: its
+        # sched: hop is the daemon's span in this trace
+        assert rt.get(_obs_leaf.options(
+            scheduling_strategy="SPREAD").remote(1), timeout=30) == 2
+    root = [s for s in tracing.get_spans()
+            if s["name"] == "obs-e2e-root"][-1]
+    trace_id = root["trace_id"]
+
+    spans = _controller_spans(trace_id, min_procs=3)
+    by_proc = {}
+    for s in spans:
+        by_proc.setdefault(s.get("proc", "?").split(":")[0], []).append(s)
+    assert "driver" in by_proc, f"no driver spans: {sorted(by_proc)}"
+    assert "worker" in by_proc, f"no worker spans: {sorted(by_proc)}"
+    assert "noded" in by_proc, f"no daemon spans: {sorted(by_proc)}"
+    # every collected span carries the ONE trace id (server filtered)
+    assert all(s["trace_id"] == trace_id for s in spans)
+    # the worker side really ran under the trace (execution spans)
+    assert any(s["name"].startswith("run:") for s in by_proc["worker"])
+    assert any(s["name"].startswith("sched:") for s in by_proc["noded"])
+    # ... and rt.timeline() renders the same correlation as ONE
+    # chrome-trace document (shared builder with /api/timeline)
+    trace = rt.timeline(trace_id=trace_id)
+    span_events = [e for e in trace if e.get("cat") == "span"]
+    assert {e["args"]["trace_id"] for e in span_events} == {trace_id}
+    assert {e["tid"].split(":")[0] for e in span_events} >= {
+        "driver", "worker", "noded"}
+
+
+def _http_get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_timeline_and_metrics_exposition(obs_cluster):
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dashboard import start_dashboard
+
+    rt.get([_obs_leaf.remote(i) for i in range(3)], timeout=30)
+    head, (host, port) = start_dashboard()
+    try:
+        # -- /api/timeline: the merged object-format document ---------
+        deadline = time.time() + 15
+        doc = {}
+        while time.time() < deadline:
+            status, body = _http_get(f"http://{host}:{port}/api/timeline")
+            assert status == 200
+            doc = json.loads(body)
+            if [e for e in doc["traceEvents"] if e.get("cat") == "span"]:
+                break
+            time.sleep(0.4)
+        assert {"traceEvents", "truncated", "events_truncated",
+                "spans_truncated"} <= set(doc)
+        cats = {e.get("cat") for e in doc["traceEvents"]}
+        assert {"task", "span"} <= cats  # events AND spans, one doc
+        # -- /metrics: cluster-merged Prometheus text exposition ------
+        deadline = time.time() + 15
+        text = ""
+        while time.time() < deadline:
+            status, body = _http_get(f"http://{host}:{port}/metrics")
+            assert status == 200
+            text = body.decode()
+            if "rt_owner_tasks_submitted_total" in text:
+                break
+            time.sleep(0.4)
+        # cataloged core metrics, collected from OTHER processes (the
+        # origin tags prove the samples crossed the wire)
+        assert "# TYPE rt_owner_tasks_submitted_total counter" in text
+        assert 'proc="driver:' in text
+        assert "rt_owner_task_latency_seconds_bucket" in text
+        # no double export: the head process's registry is in the sink
+        # too (its own obs frames) — each (name, labelset) must appear
+        # exactly once or sum()/rate() aggregations double-count
+        samples = [ln for ln in text.splitlines()
+                   if ln and not ln.startswith("#")]
+        dupes = {ln for ln in samples if samples.count(ln) > 1}
+        assert not dupes, sorted(dupes)[:5]
+        # -- each daemon's own /metrics listener ----------------------
+        nodes = get_runtime().controller_call("get_nodes")
+        ports = [n["metrics_port"] for n in nodes if n["alive"]]
+        assert all(p > 0 for p in ports)
+        status, body = _http_get(f"http://127.0.0.1:{ports[0]}/metrics")
+        assert status == 200
+        assert "rt_object_store_used_bytes" in body.decode()
+        status, _ = _http_get(f"http://{host}:{port}/api/timeline?limit=5")
+        assert status == 200
+    finally:
+        try:
+            rt.get(head.stop.remote(), timeout=5)
+            rt.kill(head)
+        except Exception as e:
+            print(f"dashboard teardown: {e}")  # best-effort cleanup

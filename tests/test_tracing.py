@@ -59,3 +59,194 @@ def test_context_propagates_to_nested_tasks(cluster):
     # same trace id as the driver's root submit for parent
     roots = [s for s in tracing.get_spans() if s["name"] == "submit:parent"]
     assert roots and roots[-1]["trace_id"] == sub["trace_id"]
+
+
+def test_context_propagates_through_actor_calls(cluster):
+    @rt.remote
+    def grandchild():
+        return 1
+
+    @rt.remote
+    class Middle:
+        def call(self):
+            # actor method body: submits a nested task; both must ride
+            # the caller's trace
+            rt.get(grandchild.remote())
+            return [s for s in tracing.get_spans()
+                    if s["name"] == "submit:grandchild"][-1]
+
+    with tracing.span("actor-hop-root"):
+        a = Middle.remote()
+        sub = rt.get(a.call.remote(), timeout=60)
+    rt.kill(a)
+    roots = [s for s in tracing.get_spans() if s["name"] == "actor-hop-root"]
+    assert roots, "driver root span not recorded"
+    # driver root -> actor call -> nested task: ONE trace id end to end
+    assert sub["trace_id"] == roots[-1]["trace_id"]
+    assert sub["parent_id"] is not None
+
+
+def test_retry_attempts_visible_in_trace(cluster):
+    """A worker death mid-task leaves no span from the dead attempt —
+    the OWNER records the retry decision as an instant span, so every
+    attempt is visible in the trace: one submit span (the shared
+    submit context), one `retry:` instant per failed attempt, one
+    `run:` span from the attempt that survived (asserted worker-side:
+    span collection is exercised separately in test_observability)."""
+    import time as _t
+
+    key = f"{_t.time()}"
+
+    @rt.remote(max_retries=2)
+    def flaky():
+        import os
+
+        marker = f"/tmp/rt_trace_flaky_{key}"
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)
+        os.remove(marker)
+        # the run span is still open here (recorded at exit): the
+        # ambient context carries its ids
+        return tracing.current_context()
+
+    tracing.clear_spans()
+    run_ctx = rt.get(flaky.remote(), timeout=60)
+    submits = [s for s in tracing.get_spans() if s["name"] == "submit:flaky"]
+    retries = [s for s in tracing.get_spans() if s["name"] == "retry:flaky"]
+    assert len(submits) == 1  # ONE submit context covers all attempts
+    assert len(retries) == 1, "owner did not record the dead attempt"
+    trace_id = submits[0]["trace_id"]
+    assert retries[0]["trace_id"] == trace_id
+    assert retries[0]["attrs"]["attempt"] >= 1
+    assert retries[0]["start"] == retries[0]["end"]  # instant span
+    # the surviving attempt's execution rode the same trace
+    assert run_ctx is not None and run_ctx["trace_id"] == trace_id
+
+
+def test_span_context_manager_and_explicit_helpers(cluster):
+    tracing.clear_spans()
+    with tracing.span("outer") as _:
+        ctx = tracing.current_context()
+        assert ctx is not None
+        tracing.record_instant("blip", ctx, kind="TEST", detail="x")
+    outer = [s for s in tracing.get_spans() if s["name"] == "outer"][-1]
+    blip = [s for s in tracing.get_spans() if s["name"] == "blip"][-1]
+    assert blip["trace_id"] == outer["trace_id"]
+    assert blip["parent_id"] == outer["span_id"]
+    assert blip["attrs"] == {"detail": "x"}
+    # explicit-context helpers (generator-shaped drivers): start/finish
+    # never touch the ambient context; use_context scopes it exactly
+    assert tracing.current_context() is None
+    rec = tracing.start_span("explicit", kind="SHUFFLE")
+    with tracing.use_context(tracing.ctx_of(rec)):
+        assert tracing.current_context()["trace_id"] == rec["trace_id"]
+        tracing.record_instant("inner", tracing.current_context())
+    assert tracing.current_context() is None
+    tracing.finish_span(rec, error="boom")
+    done = [s for s in tracing.get_spans() if s["name"] == "explicit"][-1]
+    assert done["error"] == "boom" and done["end"] >= done["start"]
+    # None context: every helper is a no-op, no branches at call sites
+    tracing.record_instant("ignored", None)
+    with tracing.use_context(None):
+        assert tracing.current_context() is None
+    tracing.finish_span(None)
+    assert not [s for s in tracing.get_spans() if s["name"] == "ignored"]
+
+
+def test_head_sampling_decides_once_at_the_root(cluster, monkeypatch):
+    # rate 0: a NEW root is sampled out -> the NEGATIVE decision (a
+    # falsy-trace_id sentinel) propagates so nothing downstream
+    # re-rolls, and nothing records
+    monkeypatch.setenv("RT_TRACE_SAMPLE", "0")
+    ctx = tracing.make_submit_ctx("storm-task")
+    assert ctx is not None and not ctx["trace_id"]  # NOT_SAMPLED marker
+    with tracing.span("unsampled"):
+        # the decision is ambient: a child submit inside the block
+        # gets the marker WITHOUT re-rolling (rate is irrelevant now)
+        monkeypatch.setenv("RT_TRACE_SAMPLE", "1")
+        child = tracing.make_submit_ctx("child-of-unsampled")
+        assert child is not None and not child["trace_id"]
+        monkeypatch.setenv("RT_TRACE_SAMPLE", "0")
+    assert tracing.current_context() is None  # scope restored
+    # the explicit-context helpers propagate the decision the same way
+    rec = tracing.start_span("unsampled-exchange")
+    assert not rec["trace_id"]
+    with tracing.use_context(tracing.ctx_of(rec)):
+        sub = tracing.make_submit_ctx("map-task")
+        assert sub is not None and not sub["trace_id"]
+    tracing.finish_span(rec)  # no-op, records nothing
+    assert not [s for s in tracing.get_spans()
+                if s["name"] in ("submit:storm-task", "unsampled",
+                                 "submit:child-of-unsampled",
+                                 "unsampled-exchange",
+                                 "submit:map-task")]
+    # ... but a PROPAGATED real parent is always kept: sampling is
+    # decided once per trace, at its root, never re-rolled downstream
+    parent = {"trace_id": "t1", "span_id": "s1"}
+    tok = tracing._ctx_var.set(parent)
+    try:
+        ctx = tracing.make_submit_ctx("downstream")
+    finally:
+        tracing._ctx_var.reset(tok)
+    assert ctx is not None and ctx["trace_id"] == "t1"
+    monkeypatch.setenv("RT_TRACE_SAMPLE", "not-a-number")
+    assert tracing.sample_rate() == 1.0  # malformed -> keep everything
+
+
+def test_sampled_out_lineage_does_no_span_work_across_the_wire(
+        cluster, monkeypatch):
+    """The NOT_SAMPLED marker rides TaskSpec.trace_ctx: a task of a
+    sampled-out trace records no run span on its worker, and its
+    NESTED submit inherits the negative decision instead of re-rolling
+    into an orphan fragment trace."""
+
+    @rt.remote
+    def probe_child():
+        return 1
+
+    @rt.remote
+    def probe():
+        rt.get(probe_child.remote())
+        ctx = tracing.current_context()
+        subs = [s for s in tracing.get_spans()
+                if s["name"] == "submit:probe_child"]
+        return {"ctx": ctx, "child_submits": len(subs)}
+
+    tracing.clear_spans()
+    monkeypatch.setenv("RT_TRACE_SAMPLE", "0")
+    try:
+        out = rt.get(probe.remote(), timeout=60)
+    finally:
+        monkeypatch.setenv("RT_TRACE_SAMPLE", "1")
+    # worker executed under the ambient negative decision...
+    assert out["ctx"] is not None and not out["ctx"]["trace_id"]
+    # ...so the nested submit did NOT become an orphan root trace
+    assert out["child_submits"] == 0
+    assert not [s for s in tracing.get_spans()
+                if s["name"] == "submit:probe"]
+
+
+def test_drain_export_batches_and_counts_drops(cluster):
+    tracing.clear_spans()
+    with tracing.span("export-me"):
+        pass
+    batch = tracing.drain_export()
+    assert any(s["name"] == "export-me" for s in batch)
+    assert tracing.drain_export() == []  # drained clean
+    # overflow past the export buffer degrades to counted drops
+    old = tracing.EXPORT_BUFFER
+    tracing.EXPORT_BUFFER = 2
+    try:
+        for i in range(4):
+            with tracing.span(f"burst{i}"):
+                pass
+        batch = tracing.drain_export()
+        assert len(batch) == 2
+        from ray_tpu.metrics import metric_defs as mdefs
+
+        dropped = sum(v for _, v in mdefs.metric(
+            "rt_trace_spans_dropped_total")._samples())
+        assert dropped >= 2  # surfaced unconditionally, gate or not
+    finally:
+        tracing.EXPORT_BUFFER = old
