@@ -42,6 +42,28 @@ _INLINE = "inline"
 _SHM = "shm"
 
 
+def _is_backpressure_error(result: TaskResult) -> bool:
+    """True when a task failed with a (possibly TaskError-wrapped)
+    `BackPressureError` — the system's own typed try-again-later
+    signal (a store clamped against a full spill disk, a bounded queue
+    refusing admission).  Such failures are retriable regardless of
+    `retry_exceptions`: they carry a retry hint by construction and
+    say nothing about the user code.  Retry amplification stays
+    bounded by max_retries AND the runtime retry budget, which drains
+    under correlated overload exactly as designed."""
+    if result.status != "error" or not result.error:
+        return False
+    try:
+        _tag, err = ser.deserialize(memoryview(result.error))
+    except Exception as e:  # undecodable error envelope: not our signal
+        logger.debug("error envelope of %s undecodable while "
+                     "classifying backpressure: %s",
+                     result.task_id.hex()[:12], e)
+        return False
+    return (isinstance(err, BaseException)
+            and exc.backpressure_retry_after(err) is not None)
+
+
 def complete_task(rt, result: TaskResult) -> list:
     """Owner-side final/retry completion of one task.  Returns the
     pending ACK futures of contained-borrow registrations made while
@@ -117,7 +139,10 @@ def complete_task(rt, result: TaskResult) -> list:
                 return acks
             # failure path
             retriable = result.status == "worker_died" or (
-                result.status == "error" and pt.spec.retry_exceptions
+                result.status == "error" and (
+                    pt.spec.retry_exceptions
+                    or _is_backpressure_error(result)
+                )
             )
             if (pt.spec.actor_id is not None
                     and result.status == "worker_died"):

@@ -45,6 +45,25 @@ class Config:
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
     #: fraction of store capacity above which eviction kicks in
     object_store_eviction_watermark: float = 1.0
+    #: end-to-end object integrity: checksum at spill/transfer source,
+    #: verify on restore-from-spill and node-to-node receive (mismatch
+    #: -> quarantine / re-fetch, then treat-as-lost so lineage
+    #: re-derives).  The spill-path cost is one CRC pass per object
+    #: (measured ≤5%: PERF.md data_shuffle integrity on/off row).
+    object_integrity: bool = True
+    #: ALSO verify on local shm get (hot path; opt-in — a local read
+    #: of a sealed shm segment is not a storage fault domain)
+    object_integrity_verify_get: bool = False
+    #: stop ELECTING new spills when the spill filesystem has less
+    #: than this many bytes free — backpressure surfaces as a typed
+    #: BackPressureError at the producer instead of an ENOSPC crash
+    #: mid-write when the disk is actually full
+    spill_disk_min_free_bytes: int = 64 * 1024 * 1024
+    #: attempts per spill-restore disk read before the restore is
+    #: declared failed and the object falls back to lineage
+    #: reconstruction (EIO is often transient; each retry backs off
+    #: through core/retry.py's jittered schedule)
+    disk_io_retries: int = 3
 
     # ---- scheduling --------------------------------------------------
     #: FLOOR of the retry backoff schedule (legacy knob; reference

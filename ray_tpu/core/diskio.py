@@ -1,0 +1,266 @@
+"""Disk I/O chokepoint + the injectable disk-fault model.
+
+Every byte the object plane persists or reads back from disk — spill
+files, spill manifests, restore reads, controller snapshots — passes
+through :func:`write_file` / :func:`read_file` here.  That single seam
+is what makes storage failure a *testable* domain: `DiskChaos` is the
+disk-side sibling of `rpc.NetworkChaos` (`core/rpc.py:60`) and injects
+the four storage faults that matter, deterministically, from a seed:
+
+- **ENOSPC**: the write raises ``OSError(errno.ENOSPC)`` before any
+  byte lands (a full disk refuses the allocation).
+- **EIO**: a read or write raises ``OSError(errno.EIO)`` (a dying
+  device; often transient — callers retry through `core/retry.py`).
+- **torn write**: a *prefix* of the data is persisted, then the write
+  fails with EIO — the crash-mid-write shape that leaves a short file
+  behind when the caller skips the atomic tmp+rename dance.
+- **bit flip**: one bit of the persisted (or read-back) payload flips
+  *silently* — the fault class only end-to-end checksums can catch.
+
+Faults match by path substring (``match``), draw from one seeded RNG,
+and can be bounded (``max_faults``) to model transient errors.  Enable
+per process via :func:`set_disk_chaos`, or for spawned daemons/workers
+via ``RT_DISK_CHAOS`` (JSON kwargs) in their environment — mirroring
+``RT_CHAOS`` exactly.
+
+The real I/O path stays boring: atomic writes are tmp + ``os.replace``
+with the tmp unlinked on any failure, so a failed write never leaves a
+half-file where a reader will trust it.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class DiskChaos:
+    """Seeded, deterministic disk-fault model applied at the
+    `diskio` chokepoint.
+
+    Probabilities are per-operation; ``match`` restricts faults to
+    paths containing the substring (e.g. ``"spilled"`` hits only the
+    spill directory, leaving session logs alone).  ``max_faults``
+    bounds the TOTAL number of injected faults (0 = unlimited) —
+    ``max_faults=2`` with ``eio_prob=1.0`` models a device that fails
+    twice then recovers, which is what retry-path tests want.
+    ``free_bytes`` (when not None) overrides what
+    :func:`free_bytes` reports, so low-disk watermark behavior is
+    testable without actually filling a disk.
+    """
+
+    def __init__(self, enospc_prob: float = 0.0, eio_prob: float = 0.0,
+                 torn_write_prob: float = 0.0, bit_flip_prob: float = 0.0,
+                 eio_read_prob: Optional[float] = None,
+                 eio_write_prob: Optional[float] = None,
+                 match: str = "", seed: int = 0, max_faults: int = 0,
+                 free_bytes: Optional[int] = None):
+        import random
+
+        self.enospc_prob = float(enospc_prob)
+        self.eio_prob = float(eio_prob)
+        # per-direction EIO overrides (default: the shared eio_prob) —
+        # a restore-retry test wants a device that fails READS only
+        self.eio_read_prob = float(
+            eio_prob if eio_read_prob is None else eio_read_prob
+        )
+        self.eio_write_prob = float(
+            eio_prob if eio_write_prob is None else eio_write_prob
+        )
+        self.torn_write_prob = float(torn_write_prob)
+        self.bit_flip_prob = float(bit_flip_prob)
+        self.match = match
+        self.seed = int(seed)
+        self.max_faults = int(max_faults)
+        self.free_bytes = free_bytes
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # injected-fault ledger: kind -> count (tests and the perf
+        # harness read this to prove the schedule actually fired)
+        self.faults: Dict[str, int] = {}
+
+    def _charge(self, kind: str) -> bool:
+        """Record one fault of `kind`; False when the budget is spent."""
+        total = sum(self.faults.values())
+        if self.max_faults and total >= self.max_faults:
+            return False
+        self.faults[kind] = self.faults.get(kind, 0) + 1
+        return True
+
+    def plan_write(self, path: str, size: int):
+        """-> (fault_kind or None, torn_prefix_len, flip_bit_index)
+        for one write of `size` bytes to `path`."""
+        with self._lock:
+            if self.match and self.match not in path:
+                return None, 0, 0
+            r = self._rng
+            if self.enospc_prob and r.random() < self.enospc_prob:
+                if self._charge("enospc"):
+                    return "enospc", 0, 0
+            if self.torn_write_prob and r.random() < self.torn_write_prob:
+                if self._charge("torn_write"):
+                    return "torn_write", r.randrange(max(1, size)), 0
+            if self.eio_write_prob and r.random() < self.eio_write_prob:
+                if self._charge("eio_write"):
+                    return "eio", 0, 0
+            if (self.bit_flip_prob and size > 0
+                    and r.random() < self.bit_flip_prob):
+                if self._charge("bit_flip_write"):
+                    return "bit_flip", 0, r.randrange(size * 8)
+            return None, 0, 0
+
+    def plan_read(self, path: str, size: int):
+        """-> (fault_kind or None, flip_bit_index) for one read."""
+        with self._lock:
+            if self.match and self.match not in path:
+                return None, 0
+            r = self._rng
+            if self.eio_read_prob and r.random() < self.eio_read_prob:
+                if self._charge("eio_read"):
+                    return "eio", 0
+            if (self.bit_flip_prob and size > 0
+                    and r.random() < self.bit_flip_prob):
+                if self._charge("bit_flip_read"):
+                    return "bit_flip", r.randrange(size * 8)
+            return None, 0
+
+    def plan_free_bytes(self) -> Optional[int]:
+        return self.free_bytes
+
+    def __repr__(self):
+        knobs = {k: v for k, v in (
+            ("enospc", self.enospc_prob), ("eio", self.eio_prob),
+            ("torn", self.torn_write_prob), ("flip", self.bit_flip_prob),
+        ) if v}
+        return (f"DiskChaos(seed={self.seed}, match={self.match!r}, "
+                f"{knobs}, injected={dict(self.faults)})")
+
+
+_chaos: Optional[DiskChaos] = None
+_chaos_env_checked = False
+
+
+def set_disk_chaos(chaos: Optional[DiskChaos]) -> None:
+    """Install (or clear, with None) this process's disk-fault model."""
+    global _chaos, _chaos_env_checked
+    _chaos = chaos
+    _chaos_env_checked = True
+
+
+def get_disk_chaos() -> Optional[DiskChaos]:
+    """Active disk-fault model; lazily constructed from RT_DISK_CHAOS
+    for child processes (daemons/workers inherit the env)."""
+    global _chaos, _chaos_env_checked
+    if not _chaos_env_checked:
+        _chaos_env_checked = True
+        import json as _json
+
+        raw = os.environ.get("RT_DISK_CHAOS")
+        if raw:
+            try:
+                _chaos = DiskChaos(**_json.loads(raw))
+            except Exception:
+                logger.warning("bad RT_DISK_CHAOS %r ignored", raw)
+    return _chaos
+
+
+def _flip_bit(data: bytes, bit_index: int) -> bytes:
+    buf = bytearray(data)
+    buf[bit_index // 8] ^= 1 << (bit_index % 8)
+    return bytes(buf)
+
+
+def write_file(path: str, data, atomic: bool = True) -> None:
+    """Persist `data` at `path` through the fault seam.
+
+    atomic=True (the default, and what every spill/manifest/snapshot
+    writer uses) stages to ``path + ".tmp"`` and ``os.replace``s, so a
+    failed write never leaves a half-file under the final name; the
+    tmp is unlinked on ANY failure.  Raises OSError on fault — real
+    (the disk's) or injected (DiskChaos's); callers cannot tell the
+    difference, which is the point.
+    """
+    data = bytes(data)
+    chaos = get_disk_chaos()
+    fault, torn_len, flip_bit = (None, 0, 0)
+    if chaos is not None:
+        fault, torn_len, flip_bit = chaos.plan_write(path, len(data))
+    if fault == "enospc":
+        raise OSError(errno.ENOSPC, "no space left on device (injected)",
+                      path)
+    if fault == "bit_flip":
+        data = _flip_bit(data, flip_bit)
+    target = path + ".tmp" if atomic else path
+    try:
+        with open(target, "wb") as f:
+            if fault == "torn_write":
+                f.write(data[:torn_len])
+                f.flush()
+                raise OSError(errno.EIO,
+                              "I/O error mid-write (injected torn write)",
+                              path)
+            f.write(data)
+            if fault == "eio":
+                raise OSError(errno.EIO, "I/O error (injected)", path)
+        if atomic:
+            os.replace(target, path)
+    except BaseException:
+        if atomic:
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+        else:
+            # non-atomic writers asked for in-place semantics; a torn
+            # short file IS the observable failure mode they model
+            pass
+        raise
+
+
+def read_file(path: str) -> bytes:
+    """Read `path` fully through the fault seam.  Raises OSError on
+    real or injected faults; a bit-flip fault returns silently
+    corrupted bytes — detecting that is the checksum layer's job."""
+    chaos = get_disk_chaos()
+    if chaos is not None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        fault, flip_bit = chaos.plan_read(path, size)
+        if fault == "eio":
+            raise OSError(errno.EIO, "I/O error (injected)", path)
+    else:
+        fault, flip_bit = None, 0
+    with open(path, "rb") as f:
+        data = f.read()
+    if fault == "bit_flip" and data:
+        data = _flip_bit(data, flip_bit % (len(data) * 8))
+    return data
+
+
+def free_bytes(path: str) -> int:
+    """Free bytes on the filesystem holding `path` (the low-disk
+    watermark input).  DiskChaos's `free_bytes` override wins, so
+    disk-full *election* behavior is testable on a roomy disk."""
+    chaos = get_disk_chaos()
+    if chaos is not None:
+        override = chaos.plan_free_bytes()
+        if override is not None:
+            return int(override)
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        # a path that doesn't exist yet: judge its parent; total
+        # failure degrades to "plenty" (the write itself still fails
+        # loudly if the disk really is full)
+        try:
+            st = os.statvfs(os.path.dirname(path) or ".")
+        except OSError:
+            return 1 << 62
+    return st.f_bavail * st.f_frsize

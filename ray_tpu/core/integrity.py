@@ -1,0 +1,72 @@
+"""Object-plane checksums.
+
+Reference analog: plasma seals objects immutably
+(`src/ray/object_manager/plasma/`) and the reference ships chunk
+checksums on its object-transfer path; PR 9 gave *checkpoints*
+CRC-verified atomic commits (`train/checkpoint_manager.py`).  This
+module extends that discipline to the object plane: one checksum
+function, one algorithm tag, used by the spill manifest, the restore
+verifier, and the node-to-node transfer path.
+
+Algorithm: CRC32C (Castagnoli) when a native implementation is
+importable (``google_crc32c`` or ``crc32c``), else ``zlib.crc32``
+(IEEE) — the stdlib has no C-speed CRC32C and a pure-Python one would
+cost ~100x on the spill path, blowing the ≤5% overhead budget.  The
+chosen algorithm rides next to every stored checksum as ``ALGO`` so
+both ends of a verification always agree; a mismatch in *algorithm*
+(one node with the native lib, one without) degrades to
+skip-verification rather than a false corruption alarm.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+__all__ = ["ALGO", "checksum", "checksum_update", "verify"]
+
+
+def _pick_impl():
+    try:  # native CRC32C, preferred
+        import google_crc32c  # type: ignore
+
+        def _crc32c(data, crc=0):
+            return google_crc32c.extend(crc, bytes(data))
+
+        return "crc32c", _crc32c
+    except ImportError:
+        pass
+    try:
+        import crc32c as _c  # type: ignore
+
+        def _crc32c(data, crc=0):
+            return _c.crc32c(bytes(data), crc)
+
+        return "crc32c", _crc32c
+    except ImportError:
+        pass
+    return "crc32", lambda data, crc=0: zlib.crc32(data, crc)
+
+
+ALGO, _impl = _pick_impl()
+
+
+def checksum(data) -> int:
+    """Checksum of a bytes-like (memoryviews accepted without copy)."""
+    return _impl(data) & 0xFFFFFFFF
+
+
+def checksum_update(crc: int, chunk) -> int:
+    """Incremental form: fold `chunk` into a running checksum."""
+    return _impl(chunk, crc) & 0xFFFFFFFF
+
+
+def verify(data, expected: Optional[int], algo: Optional[str]) -> bool:
+    """True when `data` matches `expected` — or when no comparable
+    checksum exists (expected None, or computed under a different
+    algorithm than this process can reproduce)."""
+    if expected is None:
+        return True
+    if algo is not None and algo != ALGO:
+        return True  # cross-algorithm: nothing to compare against
+    return checksum(data) == (expected & 0xFFFFFFFF)

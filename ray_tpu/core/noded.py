@@ -34,7 +34,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from ray_tpu.core import accelerators, rpc
+from ray_tpu.core import accelerators, diskio as _diskio, rpc
+from ray_tpu.core import integrity as _integrity
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.metrics import metric_defs as _md
 from ray_tpu.core.ids import NodeID
@@ -44,6 +45,31 @@ from ray_tpu.shm import ObjectExistsError, ShmStore
 logger = logging.getLogger(__name__)
 
 _PIPELINE_DEPTH = 4  # tasks pushed to one leased worker ahead of completion
+
+
+def _fault_metric(name: str, tags=None, value: float = 1.0):
+    """Integrity/storage-fault counters bypass the metrics_enabled
+    gate: they record rare failure events, not hot-path samples, and
+    the chaos acceptance tests read them with instrumentation off."""
+    try:
+        _md.metric(name).inc(value, tags=tags)
+    except Exception:  # metrics must never break a fault path
+        logger.debug("fault metric %s failed", name, exc_info=True)
+
+
+@dataclass
+class _SpillEntry:
+    """One disk-spilled primary copy: where it lives and the checksum
+    its bytes carried when they left the shm store (the spill
+    manifest; reference: `local_object_manager.h:41` url_with_offset
+    records).  A JSON sidecar (`<path>.meta`) mirrors this entry for
+    diagnostics and for verification after the in-memory index is
+    gone."""
+
+    path: str
+    size: int
+    crc: Optional[int] = None
+    algo: Optional[str] = None
 
 
 @dataclass
@@ -64,6 +90,13 @@ class WorkerState:
     # ONLY that env hash (reference: worker-pool runtime-env matching);
     # clean tasks never run on a tainted worker
     env_hash: Optional[str] = None
+    # mid-task get() is parked on an unavailable object: its lease
+    # CPUs are RELEASED back to the node (reference: blocked-worker
+    # accounting in the raylet — `node_manager.cc` HandleTaskBlocked)
+    # so dependency-producing work can run.  Without this, lineage
+    # reconstruction deadlocks the moment every worker slot holds a
+    # consumer blocked on an object only a queued task can re-derive.
+    blocked: bool = False
 
     @property
     def idle(self):
@@ -136,10 +169,16 @@ class NodeDaemon:
         self._inflight_pull_bytes = 0
         self._pull_cv: Optional[asyncio.Condition] = None
         self._chan_pool = None  # dedicated pool for blocking ring writes
-        # disk-spilled primary copies: id -> file path (reference:
+        # disk-spilled primary copies: id -> _SpillEntry (reference:
         # `local_object_manager.h:41` spilling/restoring)
-        self._spilled: Dict[bytes, str] = {}
+        self._spilled: Dict[bytes, _SpillEntry] = {}
         self._spill_dir = os.path.join(session_dir, "spilled")
+        self._quarantine_dir = os.path.join(self._spill_dir, "quarantine")
+        # low-disk latch: set when the spill filesystem is below the
+        # free-bytes watermark (or a write hit real ENOSPC); spill_now
+        # replies carry it so producers clamp with a typed
+        # BackPressureError instead of spinning against a full disk
+        self._spill_disk_full = False
         import threading as _threading
 
         # spill/restore mutate the store + index from the executor
@@ -660,8 +699,15 @@ class NodeDaemon:
             # workers it cannot lease — they can never serve plain
             # tasks and each boot costs seconds and memory
             head = None
+        # blocked workers (parked mid-task on an unavailable object)
+        # don't count toward the pool: when every slot holds a blocked
+        # consumer, the queued producer tasks need a fresh worker or
+        # the node deadlocks on its own lineage reconstruction
+        unblocked = sum(
+            1 for ws in self.workers.values() if not ws.blocked
+        )
         if q and (
-            len(self.workers) + self._pending_spawns < self.num_workers
+            unblocked + self._pending_spawns < self.num_workers
             or (head is not None and self._pending_spawns == 0
                 and len(self.workers) <= self.num_workers * 2)
         ):
@@ -724,6 +770,7 @@ class NodeDaemon:
                 w.kind == "worker"
                 and w.actor_id is None
                 and w.leased_to is None
+                and not w.blocked  # parked mid-get: don't stack work
                 and w.lease is not None
                 and w.lease == demand
                 and w.env_hash == spec.env_hash
@@ -763,8 +810,13 @@ class NodeDaemon:
 
     def _release_lease(self, w: WorkerState):
         if w.lease is not None and not w.in_flight:
-            for k, v in w.lease.items():
-                self.available[k] = self.available.get(k, 0.0) + v
+            if not w.blocked:
+                # a blocked worker's lease resources were already
+                # returned at block time; re-adding them here would
+                # mint resources out of thin air
+                for k, v in w.lease.items():
+                    self.available[k] = self.available.get(k, 0.0) + v
+            w.blocked = False
             w.lease = None
         if w.idle:
             w.busy_since = None
@@ -904,7 +956,18 @@ class NodeDaemon:
     def _maybe_spill_objects(self, force: bool = False,
                              drain: bool = False):
         """Runs on an executor thread (sync file IO); serialized by
-        _spill_lock against concurrent urgent-spill requests."""
+        _spill_lock against concurrent urgent-spill requests.
+
+        All file I/O rides the `core/diskio.py` chokepoint (atomic
+        tmp+rename, DiskChaos-injectable).  Failure discipline: a
+        write that fails UN-ELECTS its object — the bytes were never
+        deleted from shm and the atomic write left no partial file —
+        so a flaky disk degrades spill throughput, never data.  Real
+        or injected ENOSPC latches `_spill_disk_full` and ends the
+        pass; the low-disk watermark stops *electing* spills before
+        the disk is actually full."""
+        import errno as _errno
+
         with self._spill_lock:
             cap = self.store.capacity
             if cap <= 0:
@@ -920,6 +983,18 @@ class NodeDaemon:
             # failed, so brief pressure doesn't dump the working set.
             target = 0 if (force and drain) else int(self.SPILL_LOW * cap)
             os.makedirs(self._spill_dir, exist_ok=True)
+            if (_diskio.free_bytes(self._spill_dir)
+                    < self.cfg.spill_disk_min_free_bytes):
+                if not self._spill_disk_full:
+                    logger.warning(
+                        "spill disk below the free-space watermark "
+                        "(%d MB): not electing new spills",
+                        self.cfg.spill_disk_min_free_bytes >> 20,
+                    )
+                self._spill_disk_full = True
+                _fault_metric("rt_spill_disk_full_total")
+                return 0
+            self._spill_disk_full = False
             spilled = 0
             spilled_bytes = 0
             for id_bytes in self.store.spill_candidates(64):
@@ -936,20 +1011,42 @@ class NodeDaemon:
                 finally:
                     del view
                     self.store.release(id_bytes)
+                crc = (_integrity.checksum(data)
+                       if self.cfg.object_integrity else None)
                 path = os.path.join(self._spill_dir, id_bytes.hex() + ".bin")
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                os.replace(tmp, path)
+                try:
+                    _diskio.write_file(path, data)
+                except OSError as e:
+                    # un-elected: still resident in shm, no partial file
+                    if e.errno == _errno.ENOSPC:
+                        self._spill_disk_full = True
+                        _fault_metric("rt_spill_disk_full_total")
+                        logger.warning("spill hit ENOSPC; disk full — "
+                                       "ending the pass")
+                        break
+                    _fault_metric("rt_spill_errors_total",
+                                  tags={"op": "spill"})
+                    logger.warning("spill write of %s failed: %s",
+                                   id_bytes.hex()[:12], e)
+                    continue
+                if crc is not None:
+                    try:  # diagnostics sidecar; the in-memory manifest
+                        # entry is authoritative for verification
+                        _diskio.write_file(path + ".meta", json.dumps({
+                            "size": len(data), "crc": crc,
+                            "algo": _integrity.ALGO,
+                        }).encode())
+                    except OSError as e:
+                        logger.debug("spill meta for %s not written: %s",
+                                     id_bytes.hex()[:12], e)
                 if not self.store.delete(id_bytes):
                     # pinned between candidate scan and delete: the
                     # bytes stay resident, the file is garbage
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
+                    self._remove_spill_files(path)
                     continue
-                self._spilled[id_bytes] = path
+                self._spilled[id_bytes] = _SpillEntry(
+                    path, len(data), crc, _integrity.ALGO
+                )
                 spilled += 1
                 spilled_bytes += len(data)
             if spilled:
@@ -958,43 +1055,136 @@ class NodeDaemon:
                             spilled, 100 * self.store.used / cap)
             return spilled
 
-    def _restore_spilled(self, id_bytes: bytes) -> bool:
-        with self._spill_lock:
-            path = self._spilled.get(id_bytes)
-            if path is None:
-                return False
+    @staticmethod
+    def _remove_spill_files(path: str):
+        for p in (path, path + ".meta"):
             try:
-                with open(path, "rb") as f:
-                    data = f.read()
-            except OSError:
-                self._spilled.pop(id_bytes, None)
-                return False
-            if not self.store.contains(id_bytes):
-                for attempt in (0, 1):
-                    try:
-                        self.store.put(id_bytes, data, allow_evict=False)
-                        break
-                    except Exception as e:
-                        if attempt:
-                            # still pressured; caller retries after the
-                            # next spill pass frees room
-                            logger.debug("restore of %s blocked: %s",
-                                         id_bytes.hex()[:12], e)
-                            return False
-                        # make room by force-spilling OTHER unpinned
-                        # objects (full drain: the restore needs a
-                        # contiguous region NOW), then retry once — a
-                        # restore that fails here costs the borrower a
-                        # full lineage re-derivation (_spill_lock is
-                        # reentrant)
-                        self._maybe_spill_objects(force=True, drain=True)
-            self._spilled.pop(id_bytes, None)
-            try:
-                os.remove(path)
+                os.remove(p)
             except OSError:
                 pass
+
+    def _quarantine_spilled(self, id_bytes: bytes, ent: _SpillEntry,
+                            reason: str):
+        """A spilled file failed verification: move it (and its
+        sidecar) aside for post-mortem instead of deleting the
+        evidence, count the event, and drop the manifest entry so the
+        caller falls through to lineage reconstruction."""
+        os.makedirs(self._quarantine_dir, exist_ok=True)
+        for p in (ent.path, ent.path + ".meta"):
+            try:
+                os.replace(p, os.path.join(self._quarantine_dir,
+                                           os.path.basename(p)))
+            except OSError:
+                pass
+        self._spilled.pop(id_bytes, None)
+        _fault_metric("rt_object_integrity_errors_total",
+                      tags={"path": "restore"})
+        _fault_metric("rt_object_quarantined_total")
+        logger.error(
+            "spilled object %s failed verification (%s): quarantined to "
+            "%s; the object is treated as lost and re-derives via "
+            "lineage where retained",
+            id_bytes.hex()[:12], reason, self._quarantine_dir,
+        )
+
+    def _restore_spilled(self, id_bytes: bytes) -> bool:
+        import errno as _errno
+
+        from ray_tpu.core.retry import backoff_delay_s as _backoff
+
+        with self._spill_lock:
+            ent = self._spilled.get(id_bytes)
+            if ent is None:
+                return False
+            # EIO is often transient (a device resetting): retry the
+            # read through the jittered backoff schedule before
+            # charging the caller a full lineage re-derivation
+            data = None
+            attempts = max(1, self.cfg.disk_io_retries)
+            for attempt in range(attempts):
+                try:
+                    data = _diskio.read_file(ent.path)
+                    break
+                except OSError as e:
+                    _fault_metric("rt_spill_errors_total",
+                                  tags={"op": "restore"})
+                    if (attempt + 1 >= attempts
+                            or e.errno not in (_errno.EIO, _errno.EAGAIN)):
+                        logger.warning(
+                            "restore read of %s failed after %d "
+                            "attempt(s): %s", id_bytes.hex()[:12],
+                            attempt + 1, e,
+                        )
+                        self._spilled.pop(id_bytes, None)
+                        self._remove_spill_files(ent.path)
+                        return False
+                    time.sleep(_backoff(attempt, base_s=0.02, cap_s=0.25))
+            if len(data) != ent.size:
+                self._quarantine_spilled(
+                    id_bytes, ent,
+                    f"size {len(data)} != recorded {ent.size}",
+                )
+                return False
+            if (self.cfg.object_integrity
+                    and not _integrity.verify(data, ent.crc, ent.algo)):
+                self._quarantine_spilled(
+                    id_bytes, ent,
+                    f"checksum mismatch ({ent.algo} "
+                    f"{_integrity.checksum(data):#x} != recorded "
+                    f"{(ent.crc or 0):#x})",
+                )
+                return False
+            if not self.store.contains(id_bytes):
+                if not self._restore_into_store(id_bytes, data):
+                    return False
+            self._spilled.pop(id_bytes, None)
+            self._remove_spill_files(ent.path)
             _md.inc("rt_object_restore_bytes_total", float(len(data)))
             return True
+
+    def _restore_into_store(self, id_bytes: bytes, data: bytes) -> bool:
+        """Create+copy+seal with the partial allocation released on ANY
+        failure — an unsealed create would otherwise hold store bytes
+        until a creator-death reap that never comes (the daemon is the
+        creator and it is alive)."""
+        for attempt in (0, 1):
+            try:
+                dest = self.store.create(id_bytes, len(data),
+                                         allow_evict=False)
+            except ObjectExistsError:
+                return True  # raced another restore path
+            except Exception as e:
+                if attempt:
+                    # still pressured; caller retries after the
+                    # next spill pass frees room
+                    logger.debug("restore of %s blocked: %s",
+                                 id_bytes.hex()[:12], e)
+                    return False
+                # make room by force-spilling OTHER unpinned
+                # objects (full drain: the restore needs a
+                # contiguous region NOW), then retry once — a
+                # restore that fails here costs the borrower a
+                # full lineage re-derivation (_spill_lock is
+                # reentrant)
+                self._maybe_spill_objects(force=True, drain=True)
+                continue
+            try:
+                dest[:] = data
+                self.store.seal(id_bytes)
+                return True
+            except Exception:
+                logger.exception("restore copy/seal of %s failed; "
+                                 "releasing the partial allocation",
+                                 id_bytes.hex()[:12])
+                try:
+                    del dest
+                    # abort, not delete: the unsealed create holds its
+                    # creator pin, which a bare delete refuses to free
+                    self.store.abort(id_bytes)
+                except Exception as de:
+                    logger.debug("partial-restore abort failed: %s", de)
+                return False
+        return False
 
     # ------------------------------------------------------------------
     # observability plane: /metrics HTTP + batched obs frames
@@ -1140,7 +1330,10 @@ class NodeDaemon:
         except Exception:
             logger.exception("urgent spill failed")
             n = 0
-        return {"spilled": n}
+        # disk_full tells the blocked producer to clamp with a typed
+        # BackPressureError instead of spinning out its create deadline
+        # against a disk that cannot absorb another spill
+        return {"spilled": n, "disk_full": self._spill_disk_full}
 
     async def _maybe_spill(self, spec: TaskSpec):
         """Spillback: if this node can never or not-soon run the task,
@@ -1385,7 +1578,16 @@ class NodeDaemon:
             w.busy_since = time.time()
             return (w.worker_id, w.socket_path)
         self._reclaim_idle_pinned(tpu_n, env_hash)
-        if self._pending_spawns == 0 and len(self.workers) <= self.num_workers * 2:
+        # blocked workers don't count toward the spawn cap (reference:
+        # blocked workers are excluded from the pool-size accounting,
+        # which is how Ray runs more workers than cores while gets are
+        # parked): when every slot holds a consumer blocked on an
+        # object only a queued producer can re-derive, the producer
+        # needs a fresh worker or the node deadlocks
+        unblocked = sum(
+            1 for ws in self.workers.values() if not ws.blocked
+        )
+        if self._pending_spawns == 0 and unblocked <= self.num_workers * 2:
             try:
                 self._spawn_worker(
                     container=((env_hash, container) if container else None)
@@ -1397,6 +1599,38 @@ class NodeDaemon:
                 # a runtime-env error instead of retrying forever
                 return {"env_error": f"container worker spawn failed: {e}"}
         return None
+
+    # ------------------------------------------------------------------
+    # blocked-worker CPU release (reference: raylet HandleTaskBlocked /
+    # HandleTaskUnblocked): a worker whose in-task get() parks on an
+    # unavailable object hands its lease resources back so the work
+    # that PRODUCES the object (spill restores are daemon-side, but
+    # lineage re-derivation needs a worker slot) can be scheduled —
+    # possibly on a freshly spawned worker when the whole pool is
+    # blocked.  Unblock re-charges the resources; the node may run
+    # transiently oversubscribed, exactly like the reference.
+    # ------------------------------------------------------------------
+    async def handle_worker_blocked(self, payload, conn):
+        wid = self._conn_worker.get(conn)
+        w = self.workers.get(wid) if wid else None
+        if w is None or w.blocked or w.lease is None:
+            return {"ok": False}
+        w.blocked = True
+        for k, v in w.lease.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+        self._schedule()
+        return {"ok": True}
+
+    async def handle_worker_unblocked(self, payload, conn):
+        wid = self._conn_worker.get(conn)
+        w = self.workers.get(wid) if wid else None
+        if w is None or not w.blocked:
+            return {"ok": False}
+        w.blocked = False
+        if w.lease is not None:
+            for k, v in w.lease.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+        return {"ok": True}
 
     async def handle_return_lease(self, payload, conn):
         w = self.workers.get(payload["worker_id"])
@@ -1747,29 +1981,66 @@ class NodeDaemon:
         return {"ok": True}
 
     async def _pull_into_store(self, id_bytes: bytes, node_id: str):
+        """One attempt re-fetches on checksum mismatch — a transient
+        transfer corruption costs one round trip; a SECOND mismatch
+        means the source's copy itself is bad, and the object is
+        treated as lost (`ObjectCorruptionError` rides the error reply
+        back to the owner, whose lineage path re-derives it)."""
+        from ray_tpu.exceptions import ObjectCorruptionError
+
         c = await self._node_conn(node_id)
         chunk = self.cfg.object_transfer_chunk_bytes
-        # single round trip for the common small case: fetch_object
-        # returns the bytes directly, or ("too_large", size) when the
-        # object needs the chunked path
-        reply = await c.call(
-            "fetch_object", {"id": id_bytes, "max_bytes": chunk}, timeout=120
+        for attempt in (0, 1):
+            # single round trip for the common small case: fetch_object
+            # returns ("obj", bytes, crc, algo), or ("too_large", size,
+            # crc, algo) when the object needs the chunked path
+            reply = await c.call(
+                "fetch_object", {"id": id_bytes, "max_bytes": chunk},
+                timeout=120,
+            )
+            if reply is None:
+                raise rpc.RpcError("object not on remote node")
+            if isinstance(reply, tuple) and reply[0] == "too_large":
+                size, crc, algo = reply[1], reply[2], reply[3]
+                if await self._pull_chunked(c, id_bytes, size, crc, algo):
+                    return
+            else:
+                data, crc, algo = (
+                    reply[1:4] if isinstance(reply, tuple) else (reply, None, None)
+                )
+                ok = (not self.cfg.object_integrity
+                      or _integrity.verify(data, crc, algo))
+                if ok:
+                    if not self.store.contains(id_bytes):
+                        self.store.put(id_bytes, data)
+                    return
+            _fault_metric("rt_object_integrity_errors_total",
+                          tags={"path": "transfer"})
+            logger.warning(
+                "object %s failed checksum on receive from %s "
+                "(attempt %d)%s", id_bytes.hex()[:12], node_id[:8],
+                attempt + 1, "" if attempt == 0 else "; treating as lost",
+            )
+        raise ObjectCorruptionError(
+            f"object {id_bytes.hex()} failed checksum verification on "
+            f"node-to-node receive twice; the source copy is corrupt",
         )
-        if reply is None:
-            raise rpc.RpcError("object not on remote node")
-        if not (isinstance(reply, tuple) and reply[0] == "too_large"):
-            if not self.store.contains(id_bytes):
-                self.store.put(id_bytes, reply)
-            return
-        size = reply[1]
+
+    async def _pull_chunked(self, c, id_bytes: bytes, size: int,
+                            crc, algo) -> bool:
+        """Chunked pull into a pre-created shm buffer; verifies the
+        assembled object against the source's checksum BEFORE sealing.
+        Returns False on checksum mismatch (buffer discarded, caller
+        may retry); raises on transfer errors."""
         await self._admit_pull(size)
         try:
             try:
                 dest = self.store.create(id_bytes, size)
             except ObjectExistsError:
-                return  # raced another path that materialized it
+                return True  # raced another path that materialized it
             sealed = False
             nxt = None
+            chunk = self.cfg.object_transfer_chunk_bytes
             try:
                 # one-ahead prefetch: the next chunk's network round
                 # trip overlaps this chunk's shm memcpy
@@ -1795,6 +2066,9 @@ class NodeDaemon:
                         )
                     dest[off:off + len(data)] = data
                 del data
+                if (self.cfg.object_integrity
+                        and not _integrity.verify(dest, crc, algo)):
+                    return False  # finally-block discards the buffer
                 self.store.seal(id_bytes)
                 sealed = True
             finally:
@@ -1803,10 +2077,13 @@ class NodeDaemon:
                 del dest
                 if not sealed:
                     try:
-                        self.store.delete(id_bytes)
+                        # abort releases the creator pin a bare delete
+                        # refuses, so the partial allocation frees NOW
+                        self.store.abort(id_bytes)
                     except Exception as e:
                         logger.debug("dropping unsealed %s: %s",
                                      id_bytes.hex()[:12], e)
+            return True
         finally:
             self._release_pull(size)
 
@@ -1993,22 +2270,26 @@ class NodeDaemon:
                              id_bytes.hex()[:12], e)
                 return None
         try:
+            # the transfer checksum is computed fresh per fetch (never
+            # cached by id: a reconstructed object can reuse its id
+            # with byte-different content, and a stale cached crc
+            # would poison every later transfer as "corrupt")
+            crc = (_integrity.checksum(buf)
+                   if self.cfg.object_integrity else None)
+            algo = _integrity.ALGO if crc is not None else None
             max_bytes = payload.get("max_bytes")
             if max_bytes is not None and buf.nbytes > max_bytes:
-                # chunked-transfer handshake: size only, no payload
-                return ("too_large", buf.nbytes)
-            return bytes(buf)
+                # chunked-transfer handshake: size + checksum, no payload
+                return ("too_large", buf.nbytes, crc, algo)
+            return ("obj", bytes(buf), crc, algo)
         finally:
             self.store.release(id_bytes)
 
     async def handle_free_object(self, payload, conn):
         self.store.delete(payload["id"])
-        path = self._spilled.pop(payload["id"], None)
-        if path is not None:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+        ent = self._spilled.pop(payload["id"], None)
+        if ent is not None:
+            self._remove_spill_files(ent.path)
 
     async def handle_free_remote(self, payload, conn):
         node_id = payload["node_id"]
@@ -2160,6 +2441,18 @@ class NodeDaemon:
             "store_capacity": self.store.capacity if self.store else 0,
             "store_objects": self.store.count if self.store else 0,
             "metrics_port": self.metrics_http_port,
+            # per-worker lease/blocked detail (`rt status` debugging of
+            # a wedged node: WHO holds the CPUs and who is parked)
+            "workers": [
+                {
+                    "id": w.worker_id[:8], "kind": w.kind,
+                    "blocked": w.blocked, "lease": w.lease,
+                    "leased_to": w.leased_to,
+                    "in_flight": len(w.in_flight),
+                    "actor": w.actor_id is not None,
+                }
+                for w in self.workers.values()
+            ],
         }
 
     # ------------------------------------------------------------------

@@ -77,6 +77,12 @@ class NetworkChaos:
       to survive it where they own a timeout+retry (calls); one-way
       frames ride an ordered reliable stream by design and their loss
       model is CONNECTION death, not frame loss.
+    - `duplicate_prob`: re-deliver a received frame (the
+      retry-produced-a-second-copy model: an at-least-once sender whose
+      first attempt DID land).  Request/one-way handlers run twice —
+      exactly-once commit points (task completion, the elastic-ingest
+      seq/ack ledger) must dedup; a duplicated reply resolves an
+      already-resolved future and is inert by construction.
     - `partition(pattern, duration_s)`: drop every inbound frame from
       peers whose connection name contains `pattern` until `heal()` or
       the duration elapses — a one-sided network partition.
@@ -89,6 +95,7 @@ class NetworkChaos:
 
     def __init__(self, delay_s: float = 0.0, jitter_s: float = 0.0,
                  drop_prob: float = 0.0, reorder: bool = False,
+                 duplicate_prob: float = 0.0,
                  match: str = "", seed: int = 0):
         import random
 
@@ -96,6 +103,7 @@ class NetworkChaos:
         self.jitter_s = jitter_s
         self.drop_prob = drop_prob
         self.reorder = reorder
+        self.duplicate_prob = duplicate_prob
         self.match = match
         self._rng = random.Random(seed)
         self._partitions: Dict[str, Optional[float]] = {}
@@ -116,7 +124,7 @@ class NetworkChaos:
             self._partitions.pop(pattern, None)
 
     def plan(self, conn_name: str, method: str, kind: int):
-        """-> (drop, delay_s) for one inbound frame."""
+        """-> (drop, delay_s, duplicate) for one inbound frame."""
         import time as _time
 
         for pat, until in list(self._partitions.items()):
@@ -124,15 +132,19 @@ class NetworkChaos:
                 if until is not None and _time.monotonic() > until:
                     self._partitions.pop(pat, None)
                     continue
-                return True, 0.0
+                return True, 0.0, False
         if self.match and self.match not in conn_name:
-            return False, 0.0
+            return False, 0.0, False
         if self.drop_prob and self._rng.random() < self.drop_prob:
-            return True, 0.0
+            return True, 0.0, False
         delay = self.delay_s
         if self.jitter_s:
             delay += self._rng.random() * self.jitter_s
-        return False, delay
+        dup = bool(
+            self.duplicate_prob
+            and self._rng.random() < self.duplicate_prob
+        )
+        return False, delay, dup
 
 
 _chaos: Optional[NetworkChaos] = None
@@ -366,18 +378,38 @@ class RemoteError(RpcError):
 # an error on that one message, not a torn connection
 _ENV = struct.Struct("<QBHB")  # msg_id, kind, len(method), codec
 
+# monotonic Connection serials (see Connection.serial)
+_conn_serials = itertools.count(1)
+
 
 async def read_frame(reader: asyncio.StreamReader):
     """Returns (msg_id, kind, method, codec, payload_bytes) — the
     payload is NOT deserialized here; the recv loop does that
-    per-message so a bad payload cannot take down the framing."""
-    hdr = await reader.readexactly(8)
-    (length,) = _LEN.unpack(hdr)
-    if length > _MAX_FRAME:
-        raise RpcError(f"frame too large: {length}")
-    data = await reader.readexactly(length)
-    msg_id, kind, mlen, codec = _ENV.unpack_from(data)
-    method = data[_ENV.size:_ENV.size + mlen].decode()
+    per-message so a bad payload cannot take down the framing.
+
+    Failure contract (fuzz-gated in tests/test_wire_fuzz.py): every
+    malformed input raises a TYPED error — `ConnectionLost` when the
+    stream ends mid-frame, `RpcError` for an oversized length or an
+    envelope that doesn't parse — and partial data is never returned.
+    A corrupted length field cannot over-allocate: lengths above
+    `_MAX_FRAME` are refused before any read, and `readexactly`
+    accumulates incrementally (a short stream fails with what
+    actually arrived, not a giant preallocation)."""
+    try:
+        hdr = await reader.readexactly(8)
+        (length,) = _LEN.unpack(hdr)
+        if length > _MAX_FRAME:
+            raise RpcError(f"frame too large: {length}")
+        data = await reader.readexactly(length)
+    except asyncio.IncompleteReadError as e:
+        raise ConnectionLost("peer closed mid-frame") from e
+    try:
+        msg_id, kind, mlen, codec = _ENV.unpack_from(data)
+        method = data[_ENV.size:_ENV.size + mlen].decode()
+    except (struct.error, UnicodeDecodeError) as e:
+        raise RpcError(f"corrupt frame envelope: {e!r}") from e
+    if _ENV.size + mlen > len(data):
+        raise RpcError("corrupt frame envelope: method overruns frame")
     return msg_id, kind, method, codec, data[_ENV.size + mlen:]
 
 
@@ -449,6 +481,10 @@ class Connection:
                 require_schema = False
         self.require_schema = require_schema
         self._ids = itertools.count(1)
+        # process-unique serial: identity for duplicate-delivery
+        # fencing (id() can be recycled after a connection is GC'd,
+        # which would misread a reconnect retry as a replay)
+        self.serial = next(_conn_serials)
         self._pending: Dict[int, asyncio.Future] = {}
         self._outbox: list = []
         self._outbox_lock = threading.Lock()
@@ -590,9 +626,19 @@ class Connection:
                     continue
                 chaos = get_chaos()
                 if chaos is not None:
-                    drop, delay = chaos.plan(self.name, method, kind)
+                    drop, delay, dup = chaos.plan(self.name, method, kind)
                     if drop:
                         continue
+                    if dup:
+                        # second copy delivered detached (a duplicate
+                        # naturally arrives later than the original);
+                        # exactly-once commit points must tolerate it
+                        asyncio.create_task(
+                            self._deliver_later(
+                                max(delay, 0.001), msg_id, kind, method,
+                                payload,
+                            )
+                        )
                     if delay > 0:
                         if chaos.reorder:
                             # detached delivery: later frames can
@@ -607,8 +653,14 @@ class Connection:
                         # order-preserving congestion model
                         await asyncio.sleep(delay)
                 self._deliver(msg_id, kind, method, payload)
-        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+        except (ConnectionLost, asyncio.IncompleteReadError,
+                ConnectionResetError, BrokenPipeError):
             self._teardown(ConnectionLost(f"peer {self.name} disconnected"))
+        except RpcError as e:
+            # unparseable framing: there is no way to resync the
+            # stream, so the connection dies with a typed error
+            logger.warning("corrupt frame from %s: %s", self.name, e)
+            self._teardown(e)
         except asyncio.CancelledError:
             pass
         except Exception as e:  # pragma: no cover

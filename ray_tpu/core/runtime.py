@@ -132,6 +132,8 @@ def _wake_nudge():
 
 _INLINE = "inline"
 _SHM = "shm"
+# sentinel for "not resolved by the fast arg-pin pass" (_try_pin_args)
+_UNRESOLVED = object()
 # pipelining depth lives with the lease machinery now
 # (core/owner_shard.py); the alias keeps the exec-pool sizing below
 # reading naturally
@@ -148,6 +150,9 @@ class _ObjectState:
     node_id: Optional[str] = None  # location when in shm
     size: int = 0
     error: Optional[bytes] = None  # serialized error envelope
+    #: seal-time checksum for the opt-in local-get verifier
+    #: (object_integrity_verify_get); None = not recorded
+    checksum: Optional[int] = None
 
 
 @dataclass
@@ -322,11 +327,26 @@ class Runtime:
         self._actor_seq_expect: Dict[tuple, int] = {}
         self._actor_seq_buffer: Dict[tuple, Dict[int, TaskSpec]] = {}
         self._actor_drain_lock: Optional[asyncio.Lock] = None
+        # executor-side duplicate-delivery fence: task id -> the
+        # serial of the conn it was dispatched from, bounded FIFO (a
+        # SERIAL, not id(): a recycled object address must never make
+        # a reconnect retry look like a replay).  A stale-seq arrival
+        # whose task id is in here ON THE SAME CONNECTION is a
+        # transport REPLAY (dropped — its original reply rides the
+        # same live stream); the same task id on a NEW connection is a
+        # reconnect retry whose original result died with the old
+        # conn, and must re-execute — see _exec_actor_ordered.
+        self._actor_dispatched: Dict[bytes, int] = {}
+        self._actor_dispatched_order: deque = deque()
         # per-(caller, group) gap timers: advance past sequence numbers
         # that never arrive (consumed by a previous actor incarnation)
         self._actor_seq_timers: Dict[tuple, object] = {}
         self._put_counter = 0
         self._task_local = threading.local()
+        # parked-operation count behind the blocked-worker protocol
+        # (get()/arg-materialize stalls; see _notify_blocked)
+        self._blocked_ops = 0
+        self._blocked_ops_lock = threading.Lock()
         # shm objects this process has materialized via get: the pin is
         # held for the process lifetime because deserialized numpy/jax
         # values are zero-copy views into the segment (the reference
@@ -617,7 +637,7 @@ class Runtime:
     # ------------------------------------------------------------------
     # helpers bridging threads
     # ------------------------------------------------------------------
-    def _run(self, coro, timeout=None):
+    def _run(self, coro, timeout=None, block_grace=None):
         try:
             fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         except BaseException:
@@ -625,8 +645,27 @@ class Runtime:
             # must be closed or CPython warns 'never awaited' at GC
             coro.close()
             raise
+        notified = False
+        remaining = timeout
+        if block_grace is not None and (timeout is None
+                                        or timeout > block_grace):
+            # blocked-worker protocol (reference: raylet
+            # HandleTaskBlocked): an in-task get that outlives the
+            # grace window reports this worker as parked, releasing
+            # its lease CPUs so the tasks that PRODUCE the awaited
+            # objects (lineage re-derivation) can be scheduled — on a
+            # freshly spawned worker when the whole pool is blocked.
+            # Skipped entirely for timeouts at or under the grace: a
+            # short-timeout poll must expire on ITS schedule.
+            try:
+                return fut.result(block_grace)
+            except (TimeoutError, _FutureTimeoutError):
+                if not fut.done():
+                    notified = self._notify_blocked()
+            if remaining is not None:
+                remaining = max(0.0, remaining - block_grace)
         try:
-            return fut.result(timeout)
+            return fut.result(remaining)
         except (TimeoutError, _FutureTimeoutError) as e:
             # both spellings: before 3.11 concurrent.futures.TimeoutError
             # is NOT the builtin TimeoutError.  When the CORO itself
@@ -647,6 +686,53 @@ class Runtime:
             raise exc.GetTimeoutError(
                 f"timed out after {timeout}s", timeout_s=timeout
             )
+        finally:
+            if notified:
+                self._notify_unblocked()
+
+    async def _await_blocking_aware(self, coro, grace: float = 0.05):
+        """Await `coro` on the io loop; when it outlives `grace`,
+        report this worker blocked to the daemon (releasing its lease
+        CPUs) until it completes — the async-path twin of the
+        `block_grace` handling in `_run`."""
+        if self.mode != "worker" or self.noded is None:
+            return await coro
+        task = asyncio.ensure_future(coro)
+        done, _ = await asyncio.wait({task}, timeout=grace)
+        if done:
+            return task.result()
+        notified = self._notify_blocked()
+        try:
+            return await task
+        finally:
+            if notified:
+                self._notify_unblocked()
+
+    def _notify_blocked(self) -> bool:
+        """Count one parked operation; the daemon hears about the
+        0 -> 1 transition only.  Several tasks can be parked on one
+        worker concurrently (pipelined pushes, actor concurrency) —
+        a per-operation send would let the FIRST task to resume mark
+        the whole worker unblocked while its siblings still wait."""
+        with self._blocked_ops_lock:
+            self._blocked_ops += 1
+            first = self._blocked_ops == 1
+        if first:
+            try:
+                self.noded.send_threadsafe("worker_blocked", {})
+            except Exception as e:
+                logger.debug("worker_blocked notify failed: %s", e)
+        return True
+
+    def _notify_unblocked(self) -> None:
+        with self._blocked_ops_lock:
+            self._blocked_ops -= 1
+            last = self._blocked_ops == 0
+        if last:
+            try:
+                self.noded.send_threadsafe("worker_unblocked", {})
+            except Exception as e:
+                logger.debug("worker_unblocked notify failed: %s", e)
 
     # ------------------------------------------------------------------
     # cancellation (reference: CoreWorker::CancelTask + the executor's
@@ -852,6 +938,7 @@ class Runtime:
 
             deadline = time.time() + 30.0
             attempts = 0
+            disk_full_streak = 0
             while True:
                 try:
                     dest = self.store.create(
@@ -861,18 +948,31 @@ class Runtime:
                 except StoreFullError:
                     if time.time() > deadline:
                         raise
+                    reply = None
                     try:
                         # watermark spills first, full drain once the
                         # create stays blocked (fragmentation)
-                        self.noded_call(
+                        reply = self.noded_call(
                             "spill_now", {"drain": attempts >= 2},
                             timeout=10,
                         )
                     except Exception as e:
                         logger.debug("spill_now nudge failed: %s", e)
+                    disk_full_streak = _spill_clamp_streak(
+                        reply, disk_full_streak
+                    )
                     attempts += 1
                     time.sleep(0.05)
             ser.write_chunks(chunks, dest)
+            if self.cfg.object_integrity_verify_get:
+                # seal-time checksum for the opt-in local-get verifier,
+                # computed over the write buffer BEFORE sealing — a
+                # re-get after seal could race the spill pass (the
+                # freshly sealed, unpinned object is a spill candidate)
+                # and fail a put that actually succeeded
+                from ray_tpu.core import integrity as _integrity
+
+                st.checksum = _integrity.checksum(dest)
             del dest
             self.store.seal(oid.binary())
             st.where, st.node_id, st.size = _SHM, self.node_id, total
@@ -920,8 +1020,19 @@ class Runtime:
                 for b in primed:  # drop unconsumed entries (cancel/error)
                     self._primed_replies.pop(b, None)
 
+        # in-task gets report blocked-worker state past a short grace
+        # window, so a worker parked on a not-yet-derivable object
+        # frees its CPUs for the producing tasks (never for driver
+        # gets — the driver holds no lease)
+        block_grace = (
+            0.05 if (self.mode == "worker" and self.noded is not None
+                     and getattr(self._task_local, "task_id", None)
+                     is not None)
+            else None
+        )
         try:
-            vals.extend(self._run(_get_all(), timeout=timeout))
+            vals.extend(self._run(_get_all(), timeout=timeout,
+                                  block_grace=block_grace))
         except exc.GetTimeoutError as e:
             if e.object_id is None:
                 # attach the first still-pending ref: the one the
@@ -1826,21 +1937,74 @@ class Runtime:
             except Exception as e:
                 logger.debug("pin release failed: %s", e)
 
+    def _maybe_verify_local(self, ref: ObjectRef, buf):
+        """Opt-in local shm-get verification
+        (`object_integrity_verify_get`): compare the buffer against the
+        seal-time checksum when one was recorded (driver-put objects).
+        Returns the buffer, or None after dropping a corrupt copy so
+        the caller treats it as lost.  Off by default — a sealed shm
+        segment is not a storage fault domain, and this pays a full
+        CRC pass per get."""
+        if not self.cfg.object_integrity_verify_get:
+            return buf
+        st = self.objects.get(ref.binary())
+        expected = st.checksum if st is not None else None
+        if expected is None:
+            return buf
+        from ray_tpu.core import integrity as _integrity
+
+        if _integrity.checksum(buf) == expected:
+            return buf
+        _mdefs.metric("rt_object_integrity_errors_total").inc(
+            tags={"path": "get"}
+        )
+        logger.error(
+            "local shm copy of %s failed seal-time checksum; dropping "
+            "it and re-deriving", ref.hex()[:12],
+        )
+        del buf
+        self.store.release(ref.binary())
+        self.store.delete(ref.binary())
+        return None
+
     async def _read_shm(self, ref: ObjectRef, node_id: Optional[str]):
         try:
             buf = self.store.get(ref.binary(), timeout_ms=0)
+            buf = self._maybe_verify_local(ref, buf)
+            if buf is None:  # corrupt local copy: treat as lost
+                return await self._reconstruct_and_get(ref)
         except ObjectNotFoundError:
+            buf = None
             if node_id is not None and node_id != self.node_id:
-                await self.noded.call(
-                    "pull_object", {"id": ref.binary(), "node_id": node_id}
-                )
-                buf = self.store.get(ref.binary(), timeout_ms=30_000)
-            else:
+                try:
+                    await self.noded.call(
+                        "pull_object",
+                        {"id": ref.binary(), "node_id": node_id},
+                    )
+                    # non-blocking read — a 30s blocking shm wait here
+                    # would freeze this whole event loop; if the pulled
+                    # copy was re-spilled before we pinned it, the
+                    # restore loop below recovers it
+                    buf = self.store.get(ref.binary(), timeout_ms=0)
+                except (rpc.RemoteError, rpc.RpcError) as e:
+                    # a failed pull — source gone, or the copy failed
+                    # checksum twice (ObjectCorruptionError) — is
+                    # treat-as-lost: re-derive via lineage when this
+                    # owner retained it, else surface the failure
+                    if ref.binary() not in self.lineage:
+                        raise
+                    logger.warning(
+                        "pull of %s from %s failed (%s); re-deriving "
+                        "via lineage", ref.hex()[:12], node_id[:8], e,
+                    )
+                    return await self._reconstruct_and_get(ref)
+                except ObjectNotFoundError:
+                    pass  # re-spilled under us: restore loop below
+            if buf is None:
                 # spilled-to-disk primaries restore without recompute;
                 # a restored object can be re-evicted/re-spilled before
                 # we read it under sustained pressure, so retry a few
                 # times before falling back to lineage reconstruction
-                buf = None
                 for _attempt in range(3):
                     reply = await self.noded.call(
                         "restore_object", {"id": ref.binary()}
@@ -1906,37 +2070,85 @@ class Runtime:
         return primed
 
     async def _get_borrowed(self, ref: ObjectRef):
+        """Fetch a foreign-owned value.  Loops rather than trusting one
+        location answer: between the owner's reply and our read, the
+        primary can be re-spilled (and, under storage faults, its disk
+        copy quarantined) — each round tries the local store, then a
+        daemon restore, then RE-ASKS the owner, whose verify path
+        restores or re-derives via lineage before handing out a
+        location.  The old single-shot 30s blocking shm wait both froze
+        this event loop and hung on primaries nobody would restore."""
         if self.store.contains(ref.binary()):
             buf = self.store.get(ref.binary(), timeout_ms=0)
             return self._deser_pinned(ref.binary(), buf)
         if ref.owner is None:
             raise exc.ObjectLostError(object_id=ref.id)
         reply = self._primed_replies.pop(ref.binary(), None)
-        if reply is None:
-            reply = await self.noded.call(
-                "route",
-                {
-                    "target": tuple(ref.owner),
-                    "method": "get_object_value",
-                    "payload": {"id": ref.binary()},
-                    "want_reply": True,
-                },
-            )
-        kind = reply[0]
-        if kind == "inline":
-            tag, val = ser.deserialize(memoryview(reply[1]))
-            return _unwrap(tag, val)
-        if kind == "shm":
-            node_id = reply[1]
-            if node_id != self.node_id and not self.store.contains(ref.binary()):
-                await self.noded.call(
-                    "pull_object", {"id": ref.binary(), "node_id": node_id}
+        for attempt in range(8):
+            if reply is None:
+                reply = await self.noded.call(
+                    "route",
+                    {
+                        "target": tuple(ref.owner),
+                        "method": "get_object_value",
+                        "payload": {"id": ref.binary()},
+                        "want_reply": True,
+                    },
                 )
-            buf = self.store.get(ref.binary(), timeout_ms=30_000)
-            return self._deser_pinned(ref.binary(), buf)
-        if kind == "error":
-            raise _error_from_envelope(reply[1])
-        raise exc.ObjectLostError(object_id=ref.id)
+            kind = reply[0]
+            if kind == "inline":
+                tag, val = ser.deserialize(memoryview(reply[1]))
+                return _unwrap(tag, val)
+            if kind == "error":
+                raise _error_from_envelope(reply[1])
+            if kind != "shm":
+                raise exc.ObjectLostError(object_id=ref.id)
+            node_id = reply[1]
+            reply = None  # a failed round re-asks the owner
+            try:
+                if (node_id != self.node_id
+                        and not self.store.contains(ref.binary())):
+                    await self.noded.call(
+                        "pull_object",
+                        {"id": ref.binary(), "node_id": node_id},
+                    )
+                buf = self.store.get(ref.binary(), timeout_ms=0)
+                return self._deser_pinned(ref.binary(), buf)
+            except (ObjectNotFoundError, rpc.RemoteError, rpc.RpcError) as e:
+                if node_id == self.node_id:
+                    # spilled primary on this node: restore in place.
+                    # A restore RPC that itself fails (daemon handler
+                    # error, flapping conn — exactly the fault regime
+                    # this loop exists for) is a failed ROUND, not an
+                    # escape from the retry contract.
+                    try:
+                        r2 = await self.noded.call(
+                            "restore_object", {"id": ref.binary()}
+                        )
+                    except (rpc.RemoteError, rpc.RpcError) as re2:
+                        logger.debug("restore of borrowed %s failed: %s",
+                                     ref.hex()[:12], re2)
+                        r2 = None
+                    if r2 and r2.get("ok"):
+                        try:
+                            buf = self.store.get(ref.binary(), timeout_ms=0)
+                            return self._deser_pinned(ref.binary(), buf)
+                        except ObjectNotFoundError:
+                            pass  # re-spilled already: next round
+                logger.debug(
+                    "borrowed %s unavailable at %s (attempt %d): %s",
+                    ref.hex()[:12], str(node_id)[:8], attempt + 1, e,
+                )
+                await asyncio.sleep(
+                    backoff_delay_s(attempt, base_s=0.05, cap_s=1.0,
+                                    rng=self._retry_rng)
+                )
+        raise exc.ObjectLostError(
+            f"object {ref.hex()} unavailable after 8 fetch rounds "
+            "(primary kept vanishing: re-spilled/corrupt faster than "
+            "it could be restored or re-derived)",
+            object_id=ref.id,
+        )
 
     async def _reconstruct_object(self, ref: ObjectRef):
         """Lineage reconstruction (reference:
@@ -1950,42 +2162,60 @@ class Runtime:
             )
         with self._state_lock:
             st = self.objects[ref.binary()]
-            st.ready = asyncio.Event()
-            st.where = None
-            # the resubmit keeps the spec's retry budget: a worker
-            # killed DURING re-derivation (chaos mid-epoch) must retry
-            # like any other attempt, not permanently fail the object —
-            # the budget still bounds total attempts per resubmission
-            self.pending_tasks[spec.task_id.binary()] = _PendingTask(
-                spec, spec.max_retries
-            )
-            if spec.actor_id is None:
-                # lineage resubmits count as submissions so per-shard
-                # submitted/completed stay balanced (shard.lock nests
-                # inside _state_lock by the documented order)
-                shard = self._shard_for(spec.task_id.binary())
-                with shard.lock:
-                    shard.submitted += 1
-            # completion decrements submitted refs again, so re-pin args
-            for a in spec.args:
-                if isinstance(a, ArgRef):
-                    rc = self.refs.get(a.id_bytes)
-                    if rc:
-                        rc.submitted += 1
-        logger.info("reconstructing %s via lineage resubmit", ref.hex())
-        _mdefs.inc("rt_object_reconstructions_total")
-        if spec.actor_id is not None:
-            # actor-task returns re-execute ON the actor: route through
-            # the ordered actor queue with a fresh sequence number (the
-            # original seq was consumed; replaying it would wedge the
-            # executor's in-order delivery)
-            spec.seq_no = next_actor_seq(
-                spec.actor_id.binary(), spec.kwargs.get("__rt_group__")
-            )
-            self._push_actor_task(spec.actor_id.binary(), spec)
-        else:
-            self._push_or_queue(spec)
-        await st.ready.wait()
+            # Dedup on the creating task: concurrent reconstructions of
+            # this ref (two borrowers racing) or of SIBLING returns of
+            # the same task must not double-resubmit.  Worse than the
+            # wasted execution: a second resubmit would replace
+            # st.ready with a fresh event AFTER the first waiter parked
+            # on the old one — completion sets only the current event
+            # and the first waiter hangs forever (the bit-flip chaos
+            # storm found exactly this wedge).
+            already = spec.task_id.binary() in self.pending_tasks
+            if st.ready.is_set():
+                st.ready = asyncio.Event()
+                st.where = None
+            # capture under the lock: THIS is the event completion sets
+            wait_ev = st.ready
+            if not already:
+                # the resubmit keeps the spec's retry budget: a worker
+                # killed DURING re-derivation (chaos mid-epoch) must
+                # retry like any other attempt, not permanently fail
+                # the object — the budget still bounds total attempts
+                # per resubmission
+                self.pending_tasks[spec.task_id.binary()] = _PendingTask(
+                    spec, spec.max_retries
+                )
+                if spec.actor_id is None:
+                    # lineage resubmits count as submissions so
+                    # per-shard submitted/completed stay balanced
+                    # (shard.lock nests inside _state_lock by the
+                    # documented order)
+                    shard = self._shard_for(spec.task_id.binary())
+                    with shard.lock:
+                        shard.submitted += 1
+                # completion decrements submitted refs again, so
+                # re-pin args
+                for a in spec.args:
+                    if isinstance(a, ArgRef):
+                        rc = self.refs.get(a.id_bytes)
+                        if rc:
+                            rc.submitted += 1
+        if not already:
+            logger.info("reconstructing %s via lineage resubmit",
+                        ref.hex())
+            _mdefs.inc("rt_object_reconstructions_total")
+            if spec.actor_id is not None:
+                # actor-task returns re-execute ON the actor: route
+                # through the ordered actor queue with a fresh sequence
+                # number (the original seq was consumed; replaying it
+                # would wedge the executor's in-order delivery)
+                spec.seq_no = next_actor_seq(
+                    spec.actor_id.binary(), spec.kwargs.get("__rt_group__")
+                )
+                self._push_actor_task(spec.actor_id.binary(), spec)
+            else:
+                self._push_or_queue(spec)
+        await wait_ev.wait()
         if st.error is not None:
             raise _error_from_envelope(st.error)
         return st
@@ -2581,8 +2811,9 @@ class Runtime:
                 reply and reply.get("ok") and self.store.contains(id_bytes)
             ):
                 await self._reconstruct_object(ref)
-        except Exception:
-            logger.warning("could not restore %s for borrower", ref.hex())
+        except Exception as e:
+            logger.warning("could not restore %s for borrower: %r",
+                           ref.hex(), e, exc_info=True)
         return self.objects.get(id_bytes) or st
 
     async def _h_get_object_value(self, payload, conn):
@@ -2902,9 +3133,24 @@ class Runtime:
         # timer in _drain_actor_seq skips past them after a bounded wait.
         expect = self._actor_seq_expect.setdefault(key, 0)
         if spec.seq_no < expect:
+            if (self._actor_dispatched.get(spec.task_id.binary())
+                    == getattr(conn, "serial", None)):
+                # duplicate DELIVERY of a call already dispatched from
+                # THIS connection (an at-least-once transport replaying
+                # a frame): executing it again would repeat its side
+                # effects — e.g. pop a second block from a split
+                # coordinator that is then never acked.  Drop it; the
+                # original's reply rides this same live stream.  The
+                # same task id arriving on a NEW conn is a reconnect
+                # retry (the original result died with the old conn)
+                # and falls through to re-execution.
+                logger.debug("dropping duplicate actor call %s (seq %d)",
+                             spec.task_id.hex()[:12], spec.seq_no)
+                return
             # late retry of an already-superseded sequence number:
             # execute out-of-band (restart relaxes exactly-once ordering,
             # same as the reference with max_task_retries > 0)
+            self._record_dispatched(spec, conn)
             self._lane_dispatch(group, spec, conn)
             return
         buf = self._actor_seq_buffer.setdefault(key, {})
@@ -2929,6 +3175,7 @@ class Runtime:
             while self._actor_seq_expect[key] in buf:
                 s, c = buf.pop(self._actor_seq_expect[key])
                 self._actor_seq_expect[key] += 1
+                self._record_dispatched(s, c)
                 if aspec is not None and aspec.is_async:
                     self._lane_dispatch(group, s, c)
                 else:
@@ -2963,6 +3210,22 @@ class Runtime:
             self.loop.call_later(self._ACTOR_SEQ_GAP_S, _gap_fire),
             snapshot,
         )
+
+    _DISPATCHED_FENCE_CAP = 8192
+
+    def _record_dispatched(self, spec: TaskSpec, conn):
+        """Remember a dispatched actor task id and its origin conn
+        (bounded FIFO) so a replayed delivery of the same frame on the
+        same connection can be recognized and dropped instead of
+        re-executed (duplicate side effects)."""
+        tid = spec.task_id.binary()
+        if tid not in self._actor_dispatched:
+            self._actor_dispatched_order.append(tid)
+        self._actor_dispatched[tid] = getattr(conn, "serial", None)
+        while len(self._actor_dispatched_order) > self._DISPATCHED_FENCE_CAP:
+            self._actor_dispatched.pop(
+                self._actor_dispatched_order.popleft(), None
+            )
 
     def _lane_dispatch(self, group: Optional[str], spec: TaskSpec, conn):
         """Enqueue one actor task on its lane.  Each lane has a single
@@ -3012,6 +3275,143 @@ class Runtime:
         if not blob:
             return False
         return adopt_sys_path(_json.loads(blob))
+
+    def _try_pin_args(self, entries):
+        """Phase 2 fast pass: pin every store-resident ArgRef in one
+        atomic sweep.  Returns a value list (store-backed args
+        deserialized, everything else `_UNRESOLVED` for the caller to
+        resolve through `_materialize_arg`), or None when any needed
+        object is not immediately pinnable — in which case every pin
+        taken this round has been released and the caller re-runs
+        phase 1."""
+        pinned = []  # (index, id_bytes, buf)
+        out = [_UNRESOLVED] * len(entries)
+
+        def _release_all():
+            for _i, b, buf in pinned:
+                del buf
+                try:
+                    self.store.release(b)
+                except Exception as e:
+                    logger.debug("fast-pass pin release failed: %s", e)
+            del pinned[:]
+
+        try:
+            for i, a in enumerate(entries):
+                if not isinstance(a, ArgRef):
+                    continue
+                b = a.id_bytes
+                st = self.objects.get(b)
+                if st is not None:
+                    if not st.ready.is_set():
+                        _release_all()
+                        return None
+                    if st.error is not None:
+                        raise _error_from_envelope(st.error)
+                    if st.where == _INLINE:
+                        continue  # _materialize_arg: no store access
+                else:
+                    reply = self._primed_replies.get(b)
+                    if reply is not None and reply[0] == "error":
+                        raise _error_from_envelope(reply[1])
+                    if reply is not None and reply[0] == "inline":
+                        continue
+                try:
+                    buf = self.store.get(b, timeout_ms=0)
+                except ObjectNotFoundError:  # not resident right now
+                    _release_all()
+                    return None
+                if st is not None:
+                    ref = ObjectRef(ObjectID(b), a.owner)
+                    buf = self._maybe_verify_local(ref, buf)
+                    if buf is None:  # corrupt copy dropped: re-derive
+                        _release_all()
+                        return None
+                pinned.append((i, b, buf))
+        except BaseException:
+            _release_all()
+            raise
+        for i, b, buf in pinned:
+            out[i] = self._deser_pinned(b, buf)
+        return out
+
+    async def _prefetch_arg(self, a):
+        """Phase 1 of task-arg materialization: make the arg's bytes
+        LOCAL without taking a store pin (reference: the pull manager
+        stages dependencies into plasma unpinned; pinning happens at
+        execution).  A task parked here — waiting for a restore or a
+        lineage re-derivation of one arg — holds ZERO pins, so its
+        other args stay spillable and producers can always write their
+        returns.  The old single-phase materialize pinned args as it
+        went: under storage faults, a store full of parked consumers'
+        pins deadlocked the very re-derivations they waited on."""
+        if not isinstance(a, ArgRef):
+            return
+        ref = ObjectRef(ObjectID(a.id_bytes), a.owner)
+        b = ref.binary()
+        st = self.objects.get(b)
+        if st is not None:  # owned object
+            await st.ready.wait()
+            if st.error is not None or st.where == _INLINE:
+                return
+            if self.store.contains(b):
+                return
+            if st.node_id is not None and st.node_id != self.node_id:
+                try:
+                    await self.noded.call(
+                        "pull_object", {"id": b, "node_id": st.node_id}
+                    )
+                    return
+                except (rpc.RemoteError, rpc.RpcError) as e:
+                    logger.debug("prefetch pull of %s failed: %s",
+                                 ref.hex()[:12], e)
+            reply = await self.noded.call("restore_object", {"id": b})
+            if not (reply and reply.get("ok")):
+                # lost: re-derive now (no value read) so phase 2 finds
+                # it resident
+                await self._reconstruct_object(ref)
+            return
+        # borrowed: ask the owner (whose verify path restores or
+        # re-derives before handing out a location), then localize
+        if self.store.contains(b):
+            return
+        if ref.owner is None:
+            return  # phase 2 raises the typed error
+        for attempt in range(4):
+            reply = self._primed_replies.pop(b, None)
+            if reply is None:
+                reply = await self.noded.call("route", {
+                    "target": tuple(ref.owner),
+                    "method": "get_object_value",
+                    "payload": {"id": b},
+                    "want_reply": True,
+                })
+            kind = reply[0]
+            if kind in ("inline", "error"):
+                # stash for phase 2 (no bytes in the store to localize)
+                self._primed_replies[b] = reply
+                return
+            if kind != "shm":
+                return
+            node_id = reply[1]
+            if node_id != self.node_id:
+                try:
+                    await self.noded.call(
+                        "pull_object", {"id": b, "node_id": node_id}
+                    )
+                except (rpc.RemoteError, rpc.RpcError) as e:
+                    logger.debug("prefetch pull of borrowed %s: %s",
+                                 ref.hex()[:12], e)
+            if self.store.contains(b):
+                return
+            r2 = await self.noded.call("restore_object", {"id": b})
+            if r2 and r2.get("ok") and self.store.contains(b):
+                return
+            await asyncio.sleep(
+                backoff_delay_s(attempt, base_s=0.05, cap_s=0.5,
+                                rng=self._retry_rng)
+            )
+        return  # phase 2's own retry loop takes it from here
 
     async def _materialize_arg(self, a):
         if isinstance(a, tuple) and len(a) == 2 and a[0] == "__rt_inline__":
@@ -3084,12 +3484,63 @@ class Runtime:
                         "runtime_env (scheduling bug)"
                     )
             fn = await self._load_function(spec)
-            args = [await self._materialize_arg(a) for a in spec.args]
-            kwargs = {
-                k: await self._materialize_arg(v)
-                for k, v in spec.kwargs.items()
-                if not k.startswith("__rt_")
-            }
+
+            async def _materialize_all():
+                # Two-phase, all-or-nothing materialization.  Phase 1
+                # localizes every arg WITHOUT pinning; phase 2 pins the
+                # whole set atomically — a round that finds any arg
+                # missing releases every pin it took and goes back to
+                # phase 1.  A task waiting on a restore or a lineage
+                # re-derivation therefore holds ZERO pins: its sibling
+                # args stay spillable and producers can always write.
+                # (Pinning as-you-go deadlocked under storage faults:
+                # parked consumers' pins filled the store against the
+                # very re-derivations they waited on.)
+                kw_items = [(k, v) for k, v in spec.kwargs.items()
+                            if not k.startswith("__rt_")]
+                entries = list(spec.args) + [v for _, v in kw_items]
+                vals = None
+                for round_ in range(6):
+                    for a in entries:
+                        await self._prefetch_arg(a)
+                    vals = self._try_pin_args(entries)
+                    if vals is not None:
+                        break
+                    await asyncio.sleep(
+                        backoff_delay_s(round_, base_s=0.02, cap_s=0.2,
+                                        rng=self._retry_rng)
+                    )
+                if vals is None:
+                    # liveness fallback: the store is churning faster
+                    # than a fast pass can pin — take the original
+                    # blocking path (pins as it goes)
+                    vals = [await self._materialize_arg(a)
+                            for a in entries]
+                else:
+                    # non-pinned entries (inline blobs, plain values,
+                    # primed replies) resolve through the normal path —
+                    # none of these can stall on the store
+                    for i, v in enumerate(vals):
+                        if v is _UNRESOLVED:
+                            vals[i] = await self._materialize_arg(
+                                entries[i]
+                            )
+                args = vals[: len(spec.args)]
+                kwargs = {
+                    k: v for (k, _), v in zip(kw_items,
+                                              vals[len(spec.args):])
+                }
+                return args, kwargs
+
+            # blocked-aware: arg resolution stalled on an object that
+            # must be restored/re-derived first releases this worker's
+            # lease CPUs (same protocol as a parked in-task get) —
+            # otherwise every slot can fill with tasks waiting on
+            # objects only QUEUED tasks can produce, and lineage
+            # reconstruction deadlocks against its own consumers
+            args, kwargs = await self._await_blocking_aware(
+                _materialize_all()
+            )
             loop = asyncio.get_running_loop()
             self._task_local.task_id = spec.task_id
             # ambient deadline: nested .remote() calls made by the user
@@ -3215,6 +3666,16 @@ class Runtime:
                         raise
 
                 value = await loop.run_in_executor(self._exec_pool, _call)
+            # the function has returned: drop the executor's own
+            # references to the (possibly shm-pinned) args BEFORE
+            # packaging the returns.  Packaging may have to wait for
+            # store space, and an input pin held across that wait is
+            # space the spiller can never free — with several producers
+            # packaging at once, inputs-pinned-against-outputs
+            # deadlocked the store under storage-fault rework storms.
+            # (Args whose values the RESULT still references stay alive
+            # through the result, exactly as they should.)
+            del args, kwargs
             if spec.is_streaming:
                 try:
                     n_items = await self._stream_out(spec, value, conn)
@@ -3362,6 +3823,7 @@ class Runtime:
 
         deadline = time.time() + timeout_s
         attempts = 0
+        disk_full_streak = 0
         while True:
             try:
                 # no destructive eviction: pressure resolves by spilling
@@ -3380,16 +3842,20 @@ class Runtime:
             except StoreFullError:
                 if time.time() > deadline:
                     raise
+                reply = None
                 try:
                     # escalate: watermark-target spills first; if the
                     # create is still blocked after a few passes (free
                     # bytes too fragmented for a contiguous region),
                     # drain every unpinned object
-                    await self.noded.call(
+                    reply = await self.noded.call(
                         "spill_now", {"drain": attempts >= 2}, timeout=10
                     )
                 except Exception as e:
                     logger.debug("spill_now nudge failed: %s", e)
+                disk_full_streak = _spill_clamp_streak(
+                    reply, disk_full_streak
+                )
                 attempts += 1
                 await asyncio.sleep(0.05)
 
@@ -3659,6 +4125,27 @@ def _unwrap(tag: int, value):
     if tag == ser.TAG_ERROR:
         raise value
     return value
+
+
+def _spill_clamp_streak(reply, streak: int) -> int:
+    """Shared disk-full admission clamp for the blocked-create loops
+    (driver put and worker return packaging).  Counts CONSECUTIVE
+    spill_now replies that reported a full spill disk with nothing
+    spilled — one such reply can be a transient ENOSPC burst — and at
+    three in a row raises typed `BackPressureError` (the PR 10/11
+    admission-clamp convention): the store is full AND the disk keeps
+    refusing bytes, so no amount of waiting unblocks the create."""
+    if reply and reply.get("disk_full") and not reply.get("spilled"):
+        streak += 1
+    else:
+        streak = 0
+    if streak >= 3:
+        raise exc.BackPressureError(
+            "object store is full and the spill disk is out of "
+            "space; shed load or free disk",
+            retry_after_s=5.0,
+        )
+    return streak
 
 
 def _error_from_envelope(envelope: bytes) -> BaseException:

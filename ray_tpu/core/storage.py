@@ -69,16 +69,45 @@ class MemoryStoreClient(StoreClient):
 
 class FileStoreClient(StoreClient):
     """json+base64 with atomic rename (the original controller
-    persistence format — existing snapshot files keep loading)."""
+    persistence format — existing snapshot files keep loading).
+
+    I/O rides the `core/diskio.py` chokepoint, so DiskChaos covers
+    controller persistence too, and each save embeds a checksum over
+    the encoded body (`core/integrity.py`).  A snapshot that fails
+    verification on load is treated as ABSENT — the controller boots
+    fresh rather than adopting silently corrupted cluster state —
+    and the event is counted (`rt_object_integrity_errors_total`,
+    path="snapshot").  Pre-checksum snapshot files carry no "crc"
+    field and load unverified (back-compat)."""
 
     def __init__(self, path: str):
         self.path = path
 
     def load(self) -> Optional[Snapshot]:
+        from ray_tpu.core import diskio as _diskio
+        from ray_tpu.core import integrity as _integrity
+
         if not os.path.exists(self.path):
             return None
-        with open(self.path) as f:
-            raw = json.load(f)
+        raw = json.loads(_diskio.read_file(self.path).decode())
+        crc = raw.pop("crc", None)
+        algo = raw.pop("crc_algo", None)
+        if crc is not None:
+            body = json.dumps(raw, default=str, sort_keys=True).encode()
+            if not _integrity.verify(body, crc, algo):
+                try:
+                    from ray_tpu.metrics import metric_defs as _md
+
+                    _md.metric("rt_object_integrity_errors_total").inc(
+                        tags={"path": "snapshot"}
+                    )
+                except Exception as e:
+                    logger.debug("snapshot metric failed: %s", e)
+                logger.error(
+                    "controller snapshot %s failed checksum "
+                    "verification; ignoring it (boot fresh)", self.path,
+                )
+                return None
         return {
             "kv": {
                 k: base64.b64decode(v)
@@ -90,6 +119,9 @@ class FileStoreClient(StoreClient):
         }
 
     def save(self, snapshot: Snapshot) -> None:
+        from ray_tpu.core import diskio as _diskio
+        from ray_tpu.core import integrity as _integrity
+
         enc = {
             "kv": {
                 k: base64.b64encode(bytes(v)).decode()
@@ -99,10 +131,12 @@ class FileStoreClient(StoreClient):
             "pgs": snapshot.get("pgs", {}),
             "ts": snapshot.get("ts", time.time()),
         }
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(enc, f, default=str)
-        os.replace(tmp, self.path)
+        body = json.dumps(enc, default=str, sort_keys=True).encode()
+        enc["crc"] = _integrity.checksum(body)
+        enc["crc_algo"] = _integrity.ALGO
+        _diskio.write_file(
+            self.path, json.dumps(enc, default=str).encode()
+        )
 
 
 class SqliteStoreClient(StoreClient):

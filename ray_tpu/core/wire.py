@@ -261,7 +261,16 @@ def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
         if not known.issuperset(fields):
             # forward compat: drop fields a newer peer added
             fields = {k: v for k, v in fields.items() if k in known}
-        return construct(**fields), pos
+        try:
+            return construct(**fields), pos
+        except WireError:
+            raise
+        except Exception as e:
+            # a corrupt frame can hand a construct hook fields of the
+            # wrong shape/type; whatever it raises is a decode failure
+            raise WireError(
+                f"schema {name!r} construct failed: {e!r}"
+            ) from None
     if tag == _EXC:
         (module, qualname, args), pos = _decode(buf, pos)
         t = _exc_allowed(module, qualname)
@@ -286,11 +295,26 @@ def encode(v: Any) -> bytes:
 
 
 def decode(data) -> Any:
+    """Decode one wire value.  Every malformed input — truncation,
+    bit flips, corrupted length fields, absurd nesting — raises
+    `WireError` (fuzz-gated in tests/test_wire_fuzz.py): corrupt
+    bytes can surface garbage *values* of valid types, but never a
+    hang, an unbounded allocation, or an untyped exception.
+    TypeError/ValueError cover flips that survive tag parsing and
+    die inside a container or schema constructor (an unhashable set
+    element, a field of the wrong type); RecursionError covers a
+    flipped byte stamping out deeply nested container tags."""
     buf = bytes(data)
     try:
         v, pos = _decode(buf, 0)
     except (IndexError, struct.error):
         raise WireError("truncated frame") from None
+    except UnicodeDecodeError as e:
+        raise WireError(f"corrupt string field: {e}") from None
+    except RecursionError:
+        raise WireError("frame nests too deeply") from None
+    except (TypeError, ValueError, OverflowError) as e:
+        raise WireError(f"corrupt frame: {e!r}") from None
     if pos != len(buf):
         raise WireError("trailing bytes after value")
     return v

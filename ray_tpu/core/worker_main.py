@@ -21,6 +21,48 @@ import faulthandler
 faulthandler.register(signal.SIGUSR1, all_threads=True)
 
 
+def install_asyncio_dump(get_loop, sig=signal.SIGUSR2):
+    """`kill -USR2 <pid>` prints every pending asyncio task's coroutine
+    stack to stderr — the coroutine-level sibling of the USR1 thread
+    dump (thread stacks show an idle io loop even when a hundred
+    coroutines are parked on never-resolving futures; this shows WHERE
+    they are parked).  Safe in the handler: it only schedules the dump
+    onto the loop."""
+    import asyncio
+
+    def _chain(coro):
+        """Follow the await chain to its suspension point — get_stack
+        alone shows only the outermost frame, which for a deep await
+        chain says nothing about what is actually being waited on."""
+        out = []
+        hops = 0
+        while coro is not None and hops < 24:
+            hops += 1
+            fr = (getattr(coro, "cr_frame", None)
+                  or getattr(coro, "gi_frame", None))
+            if fr is not None:
+                out.append(f"{fr.f_code.co_name}:{fr.f_lineno}")
+            coro = (getattr(coro, "cr_await", None)
+                    or getattr(coro, "gi_yieldfrom", None))
+        return out
+
+    def _dump():
+        tasks = [t for t in asyncio.all_tasks() if not t.done()]
+        print(f"--- asyncio dump: {len(tasks)} pending tasks ---",
+              file=sys.stderr, flush=True)
+        for t in tasks:
+            print(f"task {t.get_name()} {' -> '.join(_chain(t.get_coro()))}",
+                  file=sys.stderr)
+        print("--- end asyncio dump ---", file=sys.stderr, flush=True)
+
+    def _handler(signum, frame):
+        loop = get_loop()
+        if loop is not None and loop.is_running():
+            loop.call_soon_threadsafe(_dump)
+
+    signal.signal(sig, _handler)
+
+
 def main():
     logging.basicConfig(
         level=os.environ.get("RT_LOG_LEVEL", "INFO").upper(),
@@ -56,6 +98,7 @@ def main():
     # be pushed the instant registration lands, and its user code may
     # call get_runtime() immediately
     set_runtime(rt)
+    install_asyncio_dump(lambda: getattr(rt, "loop", None))
     # tee BEFORE registering: a task can land the instant registration
     # does, and its first prints must not bypass the stream (reference:
     # log_monitor.py tailing worker files); the tee passes through to

@@ -223,6 +223,18 @@ DEFAULT_PANELS: List[Panel] = [
                           "{{node}} spill"),
                    Target("rate(rt_object_restore_bytes_total[5m])",
                           "{{node}} restore")]),
+    Panel("Object integrity + storage faults",
+          targets=[Target("rate(rt_object_integrity_errors_total[5m])",
+                          "checksum failures {{path}}"),
+                   Target("rate(rt_object_quarantined_total[5m])",
+                          "quarantined spill files"),
+                   Target("rate(rt_spill_disk_full_total[5m])",
+                          "spill disk full"),
+                   Target("rate(rt_spill_errors_total[5m])",
+                          "disk I/O errors {{op}}")],
+          description="any nonzero = a disk is corrupting or refusing "
+                      "data; jobs survive via quarantine + lineage, "
+                      "but the device needs attention"),
     Panel("Shuffle backpressure + reconstructions",
           targets=[Target("rate(rt_shuffle_backpressure_total[5m])",
                           "backpressure {{phase}}"),

@@ -61,6 +61,16 @@ class ObjectReconstructionFailedError(ObjectLostError):
     exceeded or lineage evicted)."""
 
 
+class ObjectCorruptionError(ObjectLostError):
+    """An object's bytes failed checksum verification — on restore
+    from a spilled file or on node-to-node receive — and could not be
+    re-fetched clean.  Subclasses `ObjectLostError` because the
+    recovery path is the same: the corrupt copy is quarantined/dropped
+    and the object re-derives via lineage where lineage is retained
+    (`core/integrity.py`; corruption is treat-as-lost, never
+    silently-wrong data)."""
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before completion (reference:
     TaskCancelledError; raised by `get` on a cancelled ref)."""

@@ -72,6 +72,21 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
     "rt_object_reconstructions_total": (
         "counter", "lost objects re-derived via lineage resubmit", (),
         None),
+    # ---- object integrity + storage faults (core/noded.py,
+    # core/diskio.py; these record rare FAILURE events, so their
+    # call sites bypass the metrics_enabled gate) --------------------
+    "rt_object_integrity_errors_total": (
+        "counter", "checksum verification failures by path "
+        "(restore | transfer | get | snapshot)", ("path",), None),
+    "rt_object_quarantined_total": (
+        "counter", "corrupt spilled files moved to quarantine", (),
+        None),
+    "rt_spill_disk_full_total": (
+        "counter", "spill passes refused by the low-disk watermark or "
+        "aborted by ENOSPC", (), None),
+    "rt_spill_errors_total": (
+        "counter", "disk I/O errors on the spill plane by op "
+        "(spill | restore)", ("op",), None),
     # ---- shuffle (data/shuffle.py) ----------------------------------
     "rt_shuffle_partition_seconds": (
         "histogram", "wall time of one shuffle map/reduce task "

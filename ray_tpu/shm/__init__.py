@@ -277,6 +277,20 @@ class ShmStore:
         rc = _load().rts_delete(self._h, _pad_id(object_id))
         return rc == OK
 
+    def abort(self, object_id: bytes) -> bool:
+        """Discard an UNSEALED create, releasing its allocation.
+
+        A created-but-unsealed object holds its creator pin, so a bare
+        `delete` refuses with BAD_STATE and the partial allocation
+        leaks until a creator-death reap that may never come (the
+        creator is alive, its transfer/restore just failed).  This
+        drops the creator pin first, then deletes — the abort half of
+        the create/seal pair."""
+        lib = _load()
+        oid = _pad_id(object_id)
+        lib.rts_release(self._h, oid)
+        return lib.rts_delete(self._h, oid) == OK
+
     def contains(self, object_id: bytes) -> bool:
         return bool(_load().rts_contains(self._h, _pad_id(object_id)))
 

@@ -1,0 +1,130 @@
+"""Unit tests for the disk I/O chokepoint + `DiskChaos`
+(`core/diskio.py`): the injected fault classes behave like the real
+ones (errno'd OSErrors, silent bit flips), schedules are deterministic
+from a seed, and the atomic write path never leaves partial files."""
+
+import errno
+import json
+import os
+
+import pytest
+
+from ray_tpu.core import diskio
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    diskio.set_disk_chaos(None)
+    yield
+    diskio.set_disk_chaos(None)
+
+
+def test_roundtrip_no_chaos(tmp_path):
+    p = str(tmp_path / "a.bin")
+    diskio.write_file(p, b"hello world")
+    assert diskio.read_file(p) == b"hello world"
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_enospc_raises_before_any_byte_lands(tmp_path):
+    diskio.set_disk_chaos(diskio.DiskChaos(enospc_prob=1.0, seed=1))
+    p = str(tmp_path / "full.bin")
+    with pytest.raises(OSError) as ei:
+        diskio.write_file(p, b"x" * 100)
+    assert ei.value.errno == errno.ENOSPC
+    assert not os.path.exists(p)
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_torn_write_atomic_leaves_no_final_file(tmp_path):
+    diskio.set_disk_chaos(diskio.DiskChaos(torn_write_prob=1.0, seed=2))
+    p = str(tmp_path / "torn.bin")
+    with pytest.raises(OSError) as ei:
+        diskio.write_file(p, b"y" * 1000)
+    assert ei.value.errno == errno.EIO
+    # atomic discipline: the tmp is unlinked, the final name never
+    # existed — a torn write cannot leave a short file a reader trusts
+    assert not os.path.exists(p)
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_torn_write_nonatomic_leaves_short_file(tmp_path):
+    diskio.set_disk_chaos(diskio.DiskChaos(torn_write_prob=1.0, seed=3))
+    p = str(tmp_path / "torn_raw.bin")
+    with pytest.raises(OSError):
+        diskio.write_file(p, b"z" * 1000, atomic=False)
+    # the crash-mid-write shape non-atomic callers must handle
+    assert os.path.exists(p)
+    assert os.path.getsize(p) < 1000
+
+
+def test_bit_flip_write_is_silent_and_one_bit(tmp_path):
+    diskio.set_disk_chaos(diskio.DiskChaos(bit_flip_prob=1.0, seed=4))
+    p = str(tmp_path / "flip.bin")
+    data = bytes(range(256))
+    diskio.write_file(p, data)  # no exception: the fault is SILENT
+    diskio.set_disk_chaos(None)
+    got = diskio.read_file(p)
+    assert got != data
+    diff = [(a ^ b) for a, b in zip(got, data) if a != b]
+    assert len(diff) == 1 and bin(diff[0]).count("1") == 1
+
+
+def test_eio_read_raises(tmp_path):
+    p = str(tmp_path / "r.bin")
+    diskio.write_file(p, b"data")
+    diskio.set_disk_chaos(diskio.DiskChaos(eio_prob=1.0, seed=5))
+    with pytest.raises(OSError) as ei:
+        diskio.read_file(p)
+    assert ei.value.errno == errno.EIO
+
+
+def test_max_faults_bounds_the_schedule(tmp_path):
+    """eio_prob=1.0 with max_faults=2 models a transient device: two
+    failures, then clean reads — the shape restore retries rely on."""
+    p = str(tmp_path / "t.bin")
+    diskio.write_file(p, b"payload")
+    diskio.set_disk_chaos(diskio.DiskChaos(eio_prob=1.0, max_faults=2,
+                                           seed=6))
+    for _ in range(2):
+        with pytest.raises(OSError):
+            diskio.read_file(p)
+    assert diskio.read_file(p) == b"payload"
+    assert diskio.get_disk_chaos().faults == {"eio_read": 2}
+
+
+def test_match_filters_paths(tmp_path):
+    diskio.set_disk_chaos(diskio.DiskChaos(enospc_prob=1.0,
+                                           match="spilled", seed=7))
+    ok = str(tmp_path / "elsewhere.bin")
+    diskio.write_file(ok, b"fine")  # unmatched path: no fault
+    bad = str(tmp_path / "spilled_x.bin")
+    with pytest.raises(OSError):
+        diskio.write_file(bad, b"nope")
+
+
+def test_deterministic_schedule_from_seed(tmp_path):
+    def schedule(seed):
+        c = diskio.DiskChaos(eio_prob=0.5, bit_flip_prob=0.3, seed=seed)
+        return [c.plan_read("/spill/f", 64) for _ in range(50)]
+
+    assert schedule(42) == schedule(42)
+    assert schedule(42) != schedule(43)
+
+
+def test_free_bytes_override_and_real(tmp_path):
+    real = diskio.free_bytes(str(tmp_path))
+    assert real > 0
+    diskio.set_disk_chaos(diskio.DiskChaos(free_bytes=123))
+    assert diskio.free_bytes(str(tmp_path)) == 123
+
+
+def test_env_construction(tmp_path, monkeypatch):
+    monkeypatch.setenv("RT_DISK_CHAOS", json.dumps(
+        {"eio_prob": 1.0, "match": "spilled", "seed": 9}
+    ))
+    diskio.set_disk_chaos(None)
+    diskio._chaos_env_checked = False  # re-read the env like a child
+    chaos = diskio.get_disk_chaos()
+    assert chaos is not None
+    assert chaos.eio_prob == 1.0 and chaos.match == "spilled"

@@ -1,0 +1,371 @@
+"""End-to-end object-plane integrity (ISSUE 13 tentpole): checksummed
+spill/restore/transfer, quarantine on corruption, EIO retry, ENOSPC
+un-election, and the typed-backpressure degradation path.
+
+Fault injection rides `core/diskio.DiskChaos` at the one chokepoint
+every spill/restore byte passes; clusters inherit it via
+`RT_DISK_CHAOS` exactly like `RT_CHAOS` (`tests/test_chaos_network.py`
+is the model).  All fault RNGs take fixed seeds."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import exceptions as exc
+from ray_tpu.core import diskio, integrity
+
+STORE_MB = 12
+
+
+def _boot(monkeypatch, chaos_kwargs=None, **init_kwargs):
+    if rt.is_initialized():
+        rt.shutdown()
+    if chaos_kwargs is not None:
+        monkeypatch.setenv("RT_DISK_CHAOS", json.dumps(chaos_kwargs))
+        diskio.set_disk_chaos(None)
+        diskio._chaos_env_checked = False
+    rt.init(num_workers=2, num_cpus=4,
+            object_store_memory=STORE_MB * 1024 * 1024,
+            ignore_reinit_error=True, **init_kwargs)
+
+
+@pytest.fixture()
+def clean_cluster():
+    yield
+    if rt.is_initialized():
+        rt.shutdown()
+    diskio.set_disk_chaos(None)
+
+
+def _session_dir() -> str:
+    import ray_tpu.api as api
+
+    return api._session.get("session_dir")
+
+
+@rt.remote
+def _make_blob(i):
+    import numpy as np
+
+    return np.full(1_500_000 // 8, i, dtype=np.int64)
+
+
+def _wait_for_spill(sd, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        files = glob.glob(f"{sd}/spilled/*.bin")
+        if files:
+            return files
+        time.sleep(0.25)
+    return []
+
+
+# ----------------------------------------------------------------------
+# restore verification: corruption -> quarantine -> lineage re-derive
+# ----------------------------------------------------------------------
+def test_spill_corruption_quarantines_and_rederives(monkeypatch,
+                                                    clean_cluster):
+    """Every spilled file gets a bit flipped on write (silent — only
+    the checksum can see it).  Every restore must fail verification,
+    quarantine the file, and fall through to lineage reconstruction;
+    the values read back are still exactly right."""
+    _boot(monkeypatch, chaos_kwargs={
+        "bit_flip_prob": 1.0, "match": "spilled", "seed": 11,
+    })
+    refs = [_make_blob.remote(i) for i in range(10)]  # ~15MB > store
+    rt.get(refs[-1], timeout=60)
+    sd = _session_dir()
+    assert _wait_for_spill(sd), "nothing spilled — test proved nothing"
+
+    for i, ref in enumerate(refs):
+        arr = rt.get(ref, timeout=120)
+        assert arr[0] == i and arr[-1] == i, (
+            "a corrupted restore leaked through verification"
+        )
+    qdir = os.path.join(sd, "spilled", "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir), (
+        "corrupt spill files were not quarantined"
+    )
+
+
+def test_restore_eio_retries_transient(monkeypatch, clean_cluster):
+    """A device that fails exactly two READS then recovers: the
+    restore path retries through the backoff schedule and succeeds —
+    no quarantine, no lineage rebuild."""
+    _boot(monkeypatch, chaos_kwargs={
+        "eio_read_prob": 1.0, "max_faults": 2, "match": "spilled",
+        "seed": 12,
+    })
+    refs = [_make_blob.remote(i) for i in range(10)]
+    rt.get(refs[-1], timeout=60)
+    sd = _session_dir()
+    assert _wait_for_spill(sd), "nothing spilled — test proved nothing"
+    for i, ref in enumerate(refs):
+        arr = rt.get(ref, timeout=120)
+        assert arr[0] == i and arr[-1] == i
+    qdir = os.path.join(sd, "spilled", "quarantine")
+    assert not (os.path.isdir(qdir) and os.listdir(qdir)), (
+        "transient EIO should be retried, not quarantined"
+    )
+
+
+# ----------------------------------------------------------------------
+# graceful degradation: ENOSPC / low-disk watermark -> typed clamp
+# ----------------------------------------------------------------------
+def _fill_until_backpressure(max_puts=40):
+    held = []
+    with pytest.raises(exc.BackPressureError):
+        for i in range(max_puts):
+            held.append(rt.put(
+                np.full(1_500_000 // 8, i, dtype=np.int64)
+            ))
+        pytest.fail("store absorbed the whole over-budget dataset "
+                    "with spilling disabled — test proved nothing")
+    return held
+
+
+def test_spill_enospc_surfaces_typed_backpressure(monkeypatch,
+                                                  clean_cluster):
+    """Every spill write hits ENOSPC: objects are un-elected (still
+    resident, readable), no partial/tmp files leak, and the producer
+    gets a typed BackPressureError instead of a crash or a 30s wedge."""
+    _boot(monkeypatch, chaos_kwargs={
+        "enospc_prob": 1.0, "match": "spilled", "seed": 13,
+    })
+    t0 = time.time()
+    held = _fill_until_backpressure()
+    assert time.time() - t0 < 25, (
+        "disk-full backpressure took the slow StoreFullError path"
+    )
+    sd = _session_dir()
+    assert not glob.glob(f"{sd}/spilled/*.bin"), "ENOSPC spill landed"
+    assert not glob.glob(f"{sd}/spilled/*.tmp"), "partial spill leaked"
+    # the store is not wedged: everything already admitted still reads
+    for i, ref in enumerate(held[:3]):
+        assert rt.get(ref, timeout=30)[0] == i
+
+
+def test_low_disk_watermark_stops_election(monkeypatch, clean_cluster):
+    """free_bytes below the watermark: the spill pass stops ELECTING
+    before any write is attempted — same typed clamp, zero I/O."""
+    _boot(monkeypatch, chaos_kwargs={"free_bytes": 0, "seed": 14})
+    _fill_until_backpressure()
+    sd = _session_dir()
+    assert not os.listdir(os.path.join(sd, "spilled")) if os.path.isdir(
+        os.path.join(sd, "spilled")) else True
+    assert not glob.glob(f"{sd}/spilled/*")
+
+
+def test_spill_eio_unelects_without_leak(monkeypatch, clean_cluster):
+    """Satellite audit: a spill whose WRITE fails must un-elect its
+    objects — bytes stay resident in shm, fully readable, and neither
+    tmp nor manifest files leak (leak accounting under injected EIO)."""
+    _boot(monkeypatch, chaos_kwargs={
+        "eio_write_prob": 1.0, "match": "spilled", "seed": 15,
+    })
+    # fill to ~85% (above the 80% spill-high watermark) WITHOUT
+    # exceeding capacity, so every put succeeds and the periodic spill
+    # pass has work it keeps failing at
+    refs = [rt.put(np.full(1_300_000 // 8, i, dtype=np.int64))
+            for i in range(8)]  # ~10.4MB of 12MB
+    time.sleep(3.0)  # a few 1 Hz spill passes
+    sd = _session_dir()
+    assert not glob.glob(f"{sd}/spilled/*.bin"), (
+        "an EIO-failed spill still produced a file"
+    )
+    assert not glob.glob(f"{sd}/spilled/*.tmp"), "partial spill leaked"
+    for i, ref in enumerate(refs):
+        assert rt.get(ref, timeout=30)[0] == i  # never left shm
+
+
+# ----------------------------------------------------------------------
+# opt-in local-get verification
+# ----------------------------------------------------------------------
+def test_local_get_verify_knob_detects_flip(monkeypatch, clean_cluster):
+    """With object_integrity_verify_get on, a bit flipped in the shm
+    copy of a driver-put object is detected at get: the corrupt copy
+    is dropped and — with no lineage for a put() — surfaces as
+    ObjectLostError, never as silently wrong data."""
+    _boot(monkeypatch,
+          _system_config={"object_integrity_verify_get": True})
+    from ray_tpu.core.runtime import get_runtime
+
+    arr = np.arange(1_000_000 // 8, dtype=np.int64)
+    ref = rt.put(arr)
+    runtime = get_runtime()
+    buf = runtime.store.get(ref.binary(), timeout_ms=0)
+    buf[100] ^= 0x01  # the mmap view is writable: flip one bit
+    del buf
+    runtime.store.release(ref.binary())
+    with pytest.raises(exc.ObjectLostError):
+        rt.get(ref, timeout=30)
+
+
+# ----------------------------------------------------------------------
+# transfer verification (unit: duck-typed daemon against a fake peer)
+# ----------------------------------------------------------------------
+class _FakeConn:
+    def __init__(self, obj_reply=None, chunks=None):
+        self.obj_reply = obj_reply
+        self.chunks = chunks
+        self.fetches = 0
+
+    async def call(self, method, payload, timeout=None):
+        if method == "fetch_object":
+            self.fetches += 1
+            return self.obj_reply() if callable(self.obj_reply) \
+                else self.obj_reply
+        if method == "fetch_chunk":
+            off, ln = payload["offset"], payload["len"]
+            return self.chunks[off:off + ln]
+        raise AssertionError(method)
+
+
+class _FakePullDaemon:
+    """The transfer-receive seam of NodeDaemon, duck-typed over a real
+    shm store: exercises _pull_into_store / _pull_chunked verification
+    without booting a cluster."""
+
+    from ray_tpu.core.noded import NodeDaemon as _ND
+
+    _pull_into_store = _ND._pull_into_store
+    _pull_chunked = _ND._pull_chunked
+    _admit_pull = _ND._admit_pull
+    _release_pull = _ND._release_pull
+
+    def __init__(self, store, cfg, conn):
+        self.store = store
+        self.cfg = cfg
+        self._conn = conn
+        self._inflight_pull_bytes = 0
+        self._pull_cv = None
+
+    async def _node_conn(self, node_id):
+        return self._conn
+
+
+@pytest.fixture()
+def pull_store():
+    from ray_tpu.shm import ShmStore
+
+    name = f"/rt_test_integrity.{os.getpid()}"
+    store = ShmStore(name, capacity=1 << 20, create=True)
+    yield store
+    store.close()
+    ShmStore.unlink(name)
+
+
+def _pull_cfg(chunk=1024):
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.object_transfer_chunk_bytes = chunk
+    return cfg
+
+
+def test_pull_small_corruption_refetches_then_lost(pull_store):
+    import asyncio
+
+    data = os.urandom(512)
+    crc = integrity.checksum(data)
+    corrupt = bytearray(data)
+    corrupt[7] ^= 0x10
+    conn = _FakeConn(obj_reply=("obj", bytes(corrupt), crc,
+                                integrity.ALGO))
+    d = _FakePullDaemon(pull_store, _pull_cfg(), conn)
+    oid = b"i" * 18
+    with pytest.raises(exc.ObjectCorruptionError):
+        asyncio.run(d._pull_into_store(oid, "peer"))
+    assert conn.fetches == 2, "mismatch must re-fetch once before lost"
+    assert not pull_store.contains(oid)
+
+
+def test_pull_small_verifies_clean(pull_store):
+    import asyncio
+
+    data = os.urandom(512)
+    conn = _FakeConn(obj_reply=("obj", data, integrity.checksum(data),
+                                integrity.ALGO))
+    d = _FakePullDaemon(pull_store, _pull_cfg(), conn)
+    oid = b"j" * 18
+    asyncio.run(d._pull_into_store(oid, "peer"))
+    assert conn.fetches == 1
+    assert bytes(pull_store.get(oid, timeout_ms=0)) == data
+    pull_store.release(oid)
+
+
+def test_pull_chunked_corruption_discards_unsealed(pull_store):
+    import asyncio
+
+    data = os.urandom(4096)
+    crc = integrity.checksum(data)
+    corrupt = bytearray(data)
+    corrupt[2000] ^= 0x01
+    conn = _FakeConn(obj_reply=("too_large", len(data), crc,
+                                integrity.ALGO),
+                     chunks=bytes(corrupt))
+    d = _FakePullDaemon(pull_store, _pull_cfg(chunk=1024), conn)
+    oid = b"k" * 18
+    with pytest.raises(exc.ObjectCorruptionError):
+        asyncio.run(d._pull_into_store(oid, "peer"))
+    assert conn.fetches == 2
+    assert not pull_store.contains(oid), (
+        "a failed chunked pull leaked its unsealed allocation"
+    )
+
+
+def test_pull_chunked_verifies_clean(pull_store):
+    import asyncio
+
+    data = os.urandom(4096)
+    conn = _FakeConn(obj_reply=("too_large", len(data),
+                                integrity.checksum(data),
+                                integrity.ALGO),
+                     chunks=data)
+    d = _FakePullDaemon(pull_store, _pull_cfg(chunk=1024), conn)
+    oid = b"m" * 18
+    asyncio.run(d._pull_into_store(oid, "peer"))
+    assert bytes(pull_store.get(oid, timeout_ms=0)) == data
+    pull_store.release(oid)
+
+
+# ----------------------------------------------------------------------
+# controller snapshot checksum (core/storage.py through the seam)
+# ----------------------------------------------------------------------
+def test_snapshot_checksum_roundtrip_and_corruption(tmp_path):
+    from ray_tpu.core.storage import FileStoreClient
+
+    path = str(tmp_path / "state.json")
+    client = FileStoreClient(path)
+    snap = {"kv": {"a": b"\x01\x02"}, "jobs": {"j": {"state": "ok"}},
+            "pgs": {}, "ts": 1.0}
+    client.save(snap)
+    loaded = client.load()
+    assert loaded["kv"]["a"] == b"\x01\x02"
+    assert loaded["jobs"] == {"j": {"state": "ok"}}
+
+    raw = json.loads(open(path).read())
+    raw["jobs"]["j"]["state"] = "tampered"
+    open(path, "w").write(json.dumps(raw))
+    assert client.load() is None, (
+        "a checksum-failing snapshot must be treated as absent"
+    )
+
+
+def test_snapshot_legacy_without_crc_loads(tmp_path):
+    from ray_tpu.core.storage import FileStoreClient
+
+    path = str(tmp_path / "legacy.json")
+    import base64
+
+    open(path, "w").write(json.dumps({
+        "kv": {"k": base64.b64encode(b"v").decode()},
+        "jobs": {}, "pgs": {}, "ts": 2.0,
+    }))
+    loaded = FileStoreClient(path).load()
+    assert loaded is not None and loaded["kv"]["k"] == b"v"

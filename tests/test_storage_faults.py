@@ -1,0 +1,133 @@
+"""Storage-fault acceptance proofs (ISSUE 13):
+
+1. with `DiskChaos` bit-flipping EVERY spilled file during a shuffle
+   of a dataset ~2x the store budget, the job completes via
+   quarantine + lineage reconstruction, bit-identical to a fault-free
+   run, and `rt_object_integrity_errors_total` > 0 on the daemon;
+2. with ENOSPC injected on the spill dir, the job surfaces a typed
+   `BackPressureError` (possibly TaskError-wrapped across the wire) —
+   never a crash, and never a wedged store (a follow-up job on the
+   same cluster completes).
+
+Fault schedules are seeded (RT008); clusters inherit the fault model
+via `RT_DISK_CHAOS` like `RT_CHAOS`."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import exceptions as exc
+from ray_tpu.core import diskio
+
+pytestmark = pytest.mark.chaos
+
+STORE_MB = 8
+ROWS = 2_000_000  # 16MB of int64 ids = 2x the store
+
+
+def _boot(monkeypatch, chaos_kwargs=None):
+    if rt.is_initialized():
+        rt.shutdown()
+    if chaos_kwargs is None:
+        monkeypatch.delenv("RT_DISK_CHAOS", raising=False)
+    else:
+        monkeypatch.setenv("RT_DISK_CHAOS", json.dumps(chaos_kwargs))
+    diskio.set_disk_chaos(None)
+    diskio._chaos_env_checked = False
+    rt.init(num_workers=2, num_cpus=4,
+            object_store_memory=STORE_MB * 1024 * 1024,
+            ignore_reinit_error=True,
+            _system_config={"metrics_http_port": -1})
+
+
+@pytest.fixture()
+def clean_cluster(monkeypatch):
+    yield
+    if rt.is_initialized():
+        rt.shutdown()
+    diskio.set_disk_chaos(None)
+
+
+def _run_epoch():
+    """One repartition+sort exchange; returns the concatenated id
+    stream (order included — determinism makes runs comparable)."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(ROWS, parallelism=10).repartition(6).sort(
+        "id", descending=True
+    )
+    out = []
+    for batch in ds.iter_batches(batch_size=250_000):
+        out.append(batch["id"])
+    import numpy as np
+
+    return np.concatenate(out)
+
+
+def _scrape_integrity_errors() -> float:
+    """Sum of rt_object_integrity_errors_total over every daemon's
+    /metrics listener (the counters live in the DAEMON, which owns
+    spill/restore; fault counters bypass the metrics_enabled gate)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    total = 0.0
+    nodes = get_runtime().controller_call("get_nodes")
+    for n in nodes:
+        port = n.get("metrics_port")
+        if not n.get("alive") or not port:
+            continue
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=15
+        ) as r:
+            for line in r.read().decode().splitlines():
+                if line.startswith("rt_object_integrity_errors_total"):
+                    total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def test_bitflip_every_spill_completes_bit_identical(monkeypatch,
+                                                     clean_cluster):
+    import numpy as np
+
+    _boot(monkeypatch)  # fault-free control
+    control = _run_epoch()
+    assert len(control) == ROWS
+    rt.shutdown()
+
+    _boot(monkeypatch, chaos_kwargs={
+        "bit_flip_prob": 1.0, "match": "spilled", "seed": 1301,
+    })
+    chaos_out = _run_epoch()
+    errors = _scrape_integrity_errors()
+    assert errors > 0, (
+        "no integrity errors counted — nothing spilled or the "
+        "checksum plane never ran; the test proved nothing"
+    )
+    assert len(chaos_out) == ROWS
+    assert np.array_equal(chaos_out, control), (
+        "recovery was not exact: a corrupted restore leaked into the "
+        "output instead of re-deriving via lineage"
+    )
+
+
+def test_enospc_on_spill_dir_surfaces_typed_backpressure(monkeypatch,
+                                                         clean_cluster):
+    _boot(monkeypatch, chaos_kwargs={
+        "enospc_prob": 1.0, "match": "spilled", "seed": 1302,
+    })
+    try:
+        out = _run_epoch()
+        # admission clamping alone squeezed the exchange through the
+        # store: acceptable, but it must then be exactly right
+        assert len(out) == ROWS
+    except Exception as e:  # rtlint: disable=RT005 - classified below; anything unexpected re-raises
+        retry_after = exc.backpressure_retry_after(e)
+        if retry_after is None:
+            raise  # an untyped failure IS the bug this test hunts
+        assert retry_after >= 0.0
+    # the store must not be wedged: a fresh small job completes
+    f = rt.remote(num_cpus=0)(lambda x: x + 1)
+    assert rt.get([f.remote(i) for i in range(20)], timeout=60) == \
+        list(range(1, 21))

@@ -1,0 +1,167 @@
+"""Wire-decoder fuzz suite (ISSUE 13 satellite): a seeded corpus of
+truncated / bit-flipped / length-corrupted frames driven into
+`wire.decode` and `rpc.read_frame`.  The contract under corruption:
+
+- a TYPED error (`WireError` from decode, `RpcError`/`ConnectionLost`
+  from read_frame) or a cleanly decoded (garbage) value of a valid
+  type — never an untyped exception, a hang, or partial data;
+- corrupted length fields never over-allocate: oversized lengths are
+  refused before any read, short streams fail with what arrived.
+
+Seeded per RT008: every mutation draws from `random.Random(<fixed>)`.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from ray_tpu.core import rpc, wire
+from ray_tpu.core.ids import TaskID
+from ray_tpu.core.task_spec import Resources, TaskResult
+
+wire.register_core_schemas()
+
+
+def _corpus():
+    """Representative wire payloads: plain data, nested containers,
+    schema'd control classes, exceptions."""
+    return [
+        None,
+        True,
+        12345,
+        -1,
+        3.14159,
+        "hello wire",
+        b"\x00\x01\x02" * 40,
+        [1, "two", b"three", None, [4, [5, {"six": 7}]]],
+        {"k": [1.5, (2, 3)], "nested": {"a": {1, 2, 3}}},
+        Resources(num_cpus=2.0, num_tpus=0.0, memory=0, custom={}),
+        TaskResult(task_id=TaskID.random(), status="ok", returns=[],
+                   error=None, execution_info={"t": 0.5}),
+        ValueError("boom", 42),
+    ]
+
+
+def _mutants(blob: bytes, rng):
+    """Truncations at every prefix (short frames), seeded bit flips,
+    and 4-byte length-field stomps at random offsets."""
+    out = []
+    for i in range(len(blob)):
+        out.append(blob[:i])
+    for _ in range(60):
+        b = bytearray(blob)
+        for _ in range(rng.randrange(1, 4)):
+            pos = rng.randrange(len(b))
+            b[pos] ^= 1 << rng.randrange(8)
+        out.append(bytes(b))
+    for _ in range(40):
+        b = bytearray(blob)
+        if len(b) < 5:
+            continue
+        pos = rng.randrange(len(b) - 4)
+        b[pos:pos + 4] = struct.pack(
+            "<I", rng.choice([0xFFFFFFFF, 0x7FFFFFFF, 2**31, 65536, 1])
+        )
+        out.append(bytes(b))
+    return out
+
+
+def test_decode_fuzz_typed_errors_only():
+    import random
+
+    rng = random.Random(1337)
+    decoded = 0
+    errored = 0
+    for payload in _corpus():
+        blob = wire.encode(payload)
+        # the pristine frame must round-trip (control)
+        wire.decode(blob)
+        for mutant in _mutants(blob, rng):
+            try:
+                wire.decode(mutant)
+                decoded += 1
+            except wire.WireError:
+                errored += 1
+            # anything else propagates and fails the test: the decode
+            # contract is WireError or a value, nothing in between
+    assert errored > 100, "corpus never hit the error paths"
+    assert decoded > 0, "every mutant errored — truncations at " \
+                        "value boundaries should still decode"
+
+
+def test_decode_deep_nesting_is_typed():
+    # 100k nested list tags: recursion must surface as WireError, not
+    # RecursionError (a flipped byte can stamp these out legitimately)
+    deep = (b"\x07" + struct.pack("<I", 1)) * 100_000 + b"\x00"
+    with pytest.raises(wire.WireError):
+        wire.decode(deep)
+
+
+def test_decode_giant_length_fields_do_not_allocate():
+    # a bytes tag claiming 4GB with 10 real bytes: must raise, fast
+    blob = b"\x06" + struct.pack("<I", 0xFFFFFFF0) + b"0123456789"
+    with pytest.raises(wire.WireError):
+        wire.decode(blob)
+
+
+async def _read_one(data: bytes):
+    reader = asyncio.StreamReader()
+    reader.feed_data(data)
+    reader.feed_eof()
+    return await asyncio.wait_for(rpc.read_frame(reader), timeout=5)
+
+
+def _frame_corpus():
+    frames = []
+    for kind in (rpc.REQUEST, rpc.REPLY, rpc.ONEWAY):
+        frames.append(rpc.frame_bytes(7, kind, "submit_task",
+                                      {"payload": b"x" * 64}))
+    frames.append(rpc.frame_bytes(0, rpc.ONEWAY, "__hello__",
+                                  {"protocol": wire.PROTOCOL_VERSION}))
+    return frames
+
+
+def test_read_frame_fuzz_typed_errors_only():
+    import random
+
+    rng = random.Random(4242)
+    ok = 0
+    errored = 0
+    for frame in _frame_corpus():
+        msg_id, kind, method, codec, payload = asyncio.run(
+            _read_one(frame)
+        )
+        assert isinstance(method, str)  # pristine control
+        for mutant in _mutants(frame, rng):
+            try:
+                _, _, m, _, p = asyncio.run(_read_one(mutant))
+                # a surviving frame must be internally consistent —
+                # never partial data
+                assert isinstance(m, str) and isinstance(p, bytes)
+                ok += 1
+            except rpc.RpcError:
+                errored += 1  # ConnectionLost subclasses RpcError
+            except asyncio.TimeoutError:
+                pytest.fail("read_frame hung on a corrupt frame")
+    assert errored > 100 and ok > 0
+
+
+def test_read_frame_oversized_length_refused_before_read():
+    hdr = struct.pack("<Q", 1 << 40)  # 1TB frame claim
+    with pytest.raises(rpc.RpcError, match="too large"):
+        asyncio.run(_read_one(hdr + b"tiny"))
+
+
+def test_read_frame_truncated_stream_is_connection_lost():
+    frame = rpc.frame_bytes(1, rpc.REQUEST, "m", {"a": 1})
+    with pytest.raises(rpc.ConnectionLost):
+        asyncio.run(_read_one(frame[: len(frame) // 2]))
+
+
+def test_read_frame_moderate_length_lie_fails_with_what_arrived():
+    # header claims 1MB, stream carries 20 bytes then EOF: typed loss,
+    # no 1MB preallocation needed to find out
+    hdr = struct.pack("<Q", 1 << 20)
+    with pytest.raises(rpc.ConnectionLost):
+        asyncio.run(_read_one(hdr + b"x" * 20))
