@@ -9,6 +9,11 @@ Configs (--config):
 - llama_lora: BASELINE config #4 — Llama LoRA fine-tune (frozen bf16
   base + rank-8 adapters), 4*N FLOPs/token (no weight-grad matmuls
   for frozen weights).
+- rllib_ppo: BASELINE config #3 — RLlib PPO on the new Learner API:
+  an EnvRunner fleet streaming object-plane sample refs into a pjit'd
+  learner gang with async sample/train overlap (env-steps/s +
+  learner updates/s; vs_baseline = overlap-on over the synchronous
+  sample→update loop at the identical fleet shape).
 
 `vs_baseline` is measured MFU divided by 0.30 — the
 model-flops-utilization a tuned torch run of this size typically
@@ -330,21 +335,75 @@ def bench_serve_llm(continuous: bool = False, replicas: int = 1) -> None:
         rt.shutdown()
 
 
+def bench_rllib_ppo(num_runners: int = 8) -> None:
+    """BASELINE config #3: RLlib PPO, new Learner API — the EnvRunner
+    fleet shape (>=8 CPU sampling actors, vectorized envs, sample
+    batches as object-plane references) feeding a >=2-device pjit
+    learner gang, with async sample/train overlap.
+
+    Env runners are numpy CPU actors by design (the reference samples
+    on CPU workers too), so the learner gang runs on the host-CPU
+    device mesh here — on a pod, `config.mesh` maps the same compiled
+    update onto TPU devices.  `vs_baseline` is the async-overlap
+    throughput over the reference's synchronous sample→update loop
+    measured at the IDENTICAL fleet shape: >1.0 means the overlap
+    hides sampling wall-time the sync loop pays serially.  The
+    per-mode rows (overlap ratio, exactly-once accounting) go to
+    stderr and PERF.md."""
+    import sys
+
+    from ray_tpu.rllib.bench import measure_rllib_ppo
+
+    rows = measure_rllib_ppo(
+        num_runners=num_runners, envs_per_runner=16, rollout_len=64,
+        minibatch=2048, epochs=2, gang_devices=4, iters=4,
+        compare_sync=True,
+    )
+    a, s = rows["rllib_ppo"], rows["rllib_ppo_sync"]
+    for name, row in (("overlap", a), ("sync", s)):
+        print(
+            f"# {name}: {row['env_steps_per_s']:.0f} env-steps/s, "
+            f"{row['updates_per_s']:.1f} updates/s, "
+            f"overlap_ratio {row.get('overlap_ratio', 0.0):.2f}, "
+            f"accounting_exact {row['accounting_exact']:.0f}, "
+            f"runners {row['runners']:.0f}, "
+            f"gang {row['gang_devices']:.0f}",
+            file=sys.stderr,
+        )
+    assert a["accounting_exact"] == 1.0 and s["accounting_exact"] == 1.0
+    print(json.dumps({
+        "metric": "rllib_ppo_env_steps_per_sec",
+        "value": round(a["env_steps_per_s"], 2),
+        "unit": "env_steps/s",
+        "vs_baseline": round(
+            a["env_steps_per_s"] / s["env_steps_per_s"], 4
+        ),
+        "learner_updates_per_sec": round(a["updates_per_s"], 2),
+        "overlap_ratio": round(a["overlap_ratio"], 4),
+        "num_env_runners": int(a["runners"]),
+        "gang_devices": int(a["gang_devices"]),
+    }))
+
+
 def main() -> None:
     import argparse
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config",
                    choices=["gpt2", "llama_lora", "serve_llm",
-                            "serve_llm_cb"],
+                            "serve_llm_cb", "rllib_ppo"],
                    default="gpt2")
     p.add_argument("--replicas", type=int, default=1,
                    help="serve_llm_cb only: deploy N engine replicas "
                         "behind the queue-depth-aware router and "
                         "saturate the fleet")
+    p.add_argument("--runners", type=int, default=8,
+                   help="rllib_ppo only: env-runner fleet size")
     args = p.parse_args()
     if args.replicas > 1 and args.config != "serve_llm_cb":
         p.error("--replicas applies only to --config serve_llm_cb")
+    if args.runners != 8 and args.config != "rllib_ppo":
+        p.error("--runners applies only to --config rllib_ppo")
     if args.config == "llama_lora":
         bench_llama_lora()
         return
@@ -353,6 +412,9 @@ def main() -> None:
         return
     if args.config == "serve_llm_cb":
         bench_serve_llm(continuous=True, replicas=args.replicas)
+        return
+    if args.config == "rllib_ppo":
+        bench_rllib_ppo(num_runners=args.runners)
         return
     bench_gpt2()
 

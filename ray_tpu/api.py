@@ -217,8 +217,12 @@ def is_started() -> bool:
 # ----------------------------------------------------------------------
 # object API
 # ----------------------------------------------------------------------
-def put(value: Any) -> ObjectRef:
-    return get_runtime().put(value)
+def put(value: Any, *, inline: Optional[bool] = None) -> ObjectRef:
+    """Store an object and return its ref.  `inline=False` forces the
+    shm path even for small objects — the broadcast shape: node-local
+    borrowers read zero-copy instead of issuing a per-borrower owner
+    RPC (see Runtime.put)."""
+    return get_runtime().put(value, inline=inline)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):

@@ -354,6 +354,10 @@ class Runtime:
         self._held_pins: set = set()
         # container object id -> borrows/pins it holds on inner refs
         self._contained_in: Dict[bytes, list] = {}
+        # object id -> threading.Events set when _maybe_free retires
+        # the entry (wait_freed: event-driven lifetime assertions for
+        # tests/tools instead of wall-clock contains() polling)
+        self._free_waiters: Dict[bytes, list] = {}
         # executor side: task id -> transit pins on foreign refs that
         # rode out in that task's returns (released by transit_release)
         self._return_transit: Dict[bytes, list] = {}
@@ -912,7 +916,15 @@ class Runtime:
     # ------------------------------------------------------------------
     # put / get / wait
     # ------------------------------------------------------------------
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, *, inline: Optional[bool] = None) -> ObjectRef:
+        """`inline=None` (default) picks by size: small objects stay
+        in the owner's memory and every borrower fetch is an owner RPC.
+        `inline=False` forces the shm path regardless of size — the
+        BROADCAST shape: one write, then every node-local borrower
+        reads zero-copy and remote nodes pull once per node instead of
+        once per borrower (an N-runner weight broadcast was N owner
+        round-trips per version through the daemon's route path;
+        measured in PERF.md's rllib section)."""
         self._put_counter += 1
         scope = getattr(self._task_local, "task_id", None) or TaskID.for_job(self.job_id)
         oid = ObjectID.for_put(scope, self._put_counter)
@@ -929,7 +941,8 @@ class Runtime:
                     if r.owner is not None
                 ])
         st = _ObjectState(ready=asyncio.Event())
-        if total <= self.cfg.max_direct_call_object_size:
+        if (total <= self.cfg.max_direct_call_object_size
+                and inline is not False):
             buf = bytearray(total)
             ser.write_chunks(chunks, memoryview(buf))
             st.where, st.value, st.size = _INLINE, bytes(buf), total
@@ -2422,6 +2435,7 @@ class Runtime:
                         self._maybe_free(a.id_bytes)
         self._release_contained(id_bytes)
         if st is None:
+            self._notify_freed(id_bytes)
             return
         if st.where == _SHM:
             if st.node_id == self.node_id:
@@ -2436,6 +2450,54 @@ class Runtime:
                     )
                 except Exception as e:
                     logger.debug("free_remote dropped: %s", e)
+        self._notify_freed(id_bytes)
+
+    def _notify_freed(self, id_bytes: bytes):
+        """Wake wait_freed() waiters — called at the single deletion
+        point (after the local store copy, if any, is gone)."""
+        for ev in self._free_waiters.pop(id_bytes, ()):
+            ev.set()
+
+    def wait_freed(self, id_bytes: bytes,
+                   timeout: Optional[float] = None) -> bool:
+        """Event-driven lifetime assertion: block until this process's
+        refcount entry for `id_bytes` is retired (and its local shm
+        copy deleted), or `timeout` elapses.  Returns True when freed.
+        Already-free ids return immediately — tests use this instead of
+        wall-clock contains() polling (suite-load deflake).
+
+        When this process holds NO refs entry but the node-shared
+        store still has a copy, the deletion will come from ANOTHER
+        process's _maybe_free (the owner's) — no local event will ever
+        fire, so that case polls the store at a short interval instead
+        of registering a dead waiter."""
+        import threading as _threading
+
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._state_lock:
+            if id_bytes not in self.refs:
+                if not self.store.contains(id_bytes):
+                    return True
+                ev = None  # foreign-owned copy: poll below
+            else:
+                ev = _threading.Event()
+                self._free_waiters.setdefault(id_bytes, []).append(ev)
+        if ev is None:
+            while self.store.contains(id_bytes):
+                if deadline is not None and time.monotonic() > deadline:
+                    return False
+                time.sleep(0.02)
+            return True
+        freed = ev.wait(timeout)
+        if not freed:
+            with self._state_lock:
+                waiters = self._free_waiters.get(id_bytes)
+                if waiters and ev in waiters:
+                    waiters.remove(ev)
+                    if not waiters:
+                        del self._free_waiters[id_bytes]
+        return freed
 
     # ------------------------------------------------------------------
     # kv / controller passthroughs

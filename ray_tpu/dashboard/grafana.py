@@ -250,6 +250,23 @@ DEFAULT_PANELS: List[Panel] = [
           targets=[Target(
               "histogram_quantile(0.5, sum by (le) "
               "(rate(rt_train_step_seconds_bucket[5m])))", "p50")]),
+    Panel("RLlib fleet throughput",
+          targets=[Target("rate(rt_rllib_env_steps_total[1m])",
+                          "env steps/s"),
+                   Target("rate(rt_rllib_sample_batch_bytes_total[1m])",
+                          "sample bytes/s"),
+                   Target("rt_rllib_env_runners", "env runners")],
+          description="EnvRunner fleet → learner gang: consumed env "
+                      "steps (exactly-once ledger), object-plane "
+                      "sample bytes, and fleet size (dips = runner "
+                      "replacements in progress)"),
+    Panel("RLlib learner update p50", unit="s",
+          targets=[Target(
+              "histogram_quantile(0.5, sum by (le) "
+              "(rate(rt_rllib_learner_update_seconds_bucket[5m])))",
+              "p50")],
+          description="full epochs pass over one train batch; compare "
+                      "against sample_busy_s for the overlap budget"),
     Panel("Dropped task events",
           targets=[Target("rate(rt_task_events_dropped_total[5m])",
                           "{{proc}}")],

@@ -115,6 +115,19 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
     "rt_serve_engine_shed_total": (
         "gauge", "deadline sheds before prefill (monotonic, bridged)",
         ("app", "deployment", "replica"), None),
+    # ---- rllib (rllib/env/env_runner_group.py, algorithms/ppo.py) ---
+    "rt_rllib_env_steps_total": (
+        "counter", "env steps consumed by the learner side (ledger-"
+        "recorded, exactly once per sample batch)", (), None),
+    "rt_rllib_sample_batch_bytes_total": (
+        "counter", "sample-batch payload bytes fetched from the object "
+        "plane", (), None),
+    "rt_rllib_learner_update_seconds": (
+        "histogram", "wall time of one full learner update pass "
+        "(all epochs over one train batch)", (), _WORK_S),
+    "rt_rllib_env_runners": (
+        "gauge", "env-runner fleet size (replacements keep it at "
+        "target; 0 after stop)", (), None),
     # ---- train (train/trainer.py) -----------------------------------
     "rt_train_step_seconds": (
         "histogram", "wall time between delivered training result "

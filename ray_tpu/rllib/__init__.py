@@ -2,9 +2,12 @@
 
 EnvRunner actors sample with pure-numpy policies on CPU; the Learner
 owns a jax parameter pytree and a jitted update — scaled SPMD over a
-device mesh (the TPU path) or via DDP learner actors with
-host-collective gradient allreduce (the CPU-fleet path).  PPO is the
-first algorithm (reference: `rllib/algorithms/ppo/`).
+device mesh (the TPU path; `num_learner_devices` builds the pjit gang)
+or via DDP learner actors with host-collective gradient allreduce (the
+CPU-fleet path).  Production shape (BASELINE config #3): the runner
+fleet ships sample batches as object-plane references into the gang
+with async sample/train overlap and exactly-once `SampleLedger`
+accounting — see docs/rllib.md "Production scale".
 """
 
 from ray_tpu.rllib.algorithms import APPO, BC, CQL, DQN, IMPALA, PPO, SAC, Algorithm, AlgorithmConfig, APPOConfig, BCConfig, CQLConfig, DQNConfig, Dreamer, DreamerConfig, IMPALAConfig, MARWIL, MARWILConfig, MultiAgentPPO, MultiAgentPPOConfig, PPOConfig, SACConfig
@@ -19,12 +22,17 @@ from ray_tpu.rllib.connectors import (
     wrap_atari_connectors,
 )
 from ray_tpu.rllib.core import Learner, LearnerGroup, MLPModule, RLModule
+from ray_tpu.rllib.core.learner import make_data_mesh
 from ray_tpu.rllib.core.rl_module import CNNModule, make_default_module
 from ray_tpu.rllib.env import (
     CartPoleVectorEnv,
     EnvRunner,
     EnvRunnerGroup,
     VectorEnv,
+)
+from ray_tpu.rllib.env.env_runner_group import (
+    DuplicateSampleError,
+    SampleLedger,
 )
 from ray_tpu.rllib.env.envs import (
     CatchPixelEnv,
@@ -71,9 +79,12 @@ __all__ = [
     "EnvRunnerGroup",
     "Learner",
     "LearnerGroup",
+    "DuplicateSampleError",
     "MLPModule",
     "PPO",
     "PPOConfig",
     "RLModule",
+    "SampleLedger",
     "VectorEnv",
+    "make_data_mesh",
 ]

@@ -27,10 +27,30 @@ class AlgorithmConfig:
         self.seed: int = 0
         self.model: Dict[str, Any] = {"hidden": (64, 64)}
         self.mesh: Any = None  # jax Mesh for SPMD learner sharding
+        #: pjit learner gang width: >=2 builds a 1-D "data" mesh over
+        #: that many local devices and compiles the update as ONE
+        #: sharded program (alternative to `mesh`; exclusive with
+        #: num_learners DDP actors)
+        self.num_learner_devices: int = 0
         # env<->module connector pipeline FACTORY (reference:
         # config.env_runners(env_to_module_connector=...)); a factory —
         # not an instance — so each runner actor builds its own state
         self.env_to_module_connector: Any = None
+        #: async sample/train overlap (PPO): runners keep sampling
+        #: epoch N+1 while the learner gang updates on epoch N; weights
+        #: broadcast non-blocking by reference.  Rollouts are then
+        #: boundedly stale (~inflight_rollouts_per_runner versions) —
+        #: PPO's ratio clip absorbs it (the reference's APPO/IMPALA
+        #: shape, applied to the PPO loss)
+        self.sample_train_overlap: bool = False
+        #: pipelined sample_ref() calls per runner on the async path
+        #: (reference: max_requests_in_flight_per_env_runner)
+        self.inflight_rollouts_per_runner: int = 2
+        #: replacement runners deterministically replay the dead
+        #: incarnation's weights history (sync fleets only) — a
+        #: kill-storm run consumes bit-identical batches to an
+        #: unkilled control run (chaos-test contract)
+        self.deterministic_replacement: bool = False
 
     # -- fluent sections (each returns self, reference-style) ----------
     def environment(self, env: Any = None, *, env_config: Optional[Dict] = None,
@@ -56,9 +76,12 @@ class AlgorithmConfig:
         return self
 
     def learners(self, *, num_learners: Optional[int] = None,
+                 num_learner_devices: Optional[int] = None,
                  **kwargs) -> "AlgorithmConfig":
         if num_learners is not None:
             self.num_learners = num_learners
+        if num_learner_devices is not None:
+            self.num_learner_devices = num_learner_devices
         self._apply(kwargs)
         return self
 

@@ -37,9 +37,7 @@ class IMPALAConfig(AlgorithmConfig):
         self.minibatch_size = 256
         self.vtrace_clip_rho_threshold: float = 1.0
         self.vtrace_clip_c_threshold: float = 1.0
-        #: pipelined sample() calls per runner (reference:
-        #: max_requests_in_flight_per_env_runner)
-        self.inflight_rollouts_per_runner: int = 2
+        # inflight_rollouts_per_runner comes from the base config
         #: max ready batches consumed per training_step
         self.max_batches_per_step: int = 4
 

@@ -11,11 +11,14 @@ experience.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu as rt
+
+logger = logging.getLogger(__name__)
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import make_ppo_loss
@@ -167,8 +170,8 @@ class MultiAgentPPO(Algorithm):
         for r in self._runners:
             try:
                 episodes.extend(rt.get(r.pop_metrics.remote(), timeout=30))
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("episode metrics fetch failed: %s", e)
         self._track_episode_metrics(episodes, result)
         return result
 
@@ -191,7 +194,7 @@ class MultiAgentPPO(Algorithm):
         for r in self._runners:
             try:
                 rt.kill(r)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("runner kill on stop failed: %s", e)
         for lg in self.learners.values():
             lg.stop()

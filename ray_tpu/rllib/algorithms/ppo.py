@@ -6,19 +6,36 @@ entropy bonus) — re-expressed as a pure-jax loss compiled once per
 minibatch shape.  GAE (`rllib/evaluation/postprocessing.py` in the old
 stack, connectors in the new) runs as vectorized numpy on the driver:
 it is O(T·B) pointer-chasing, not MXU work.
+
+Production scale (`config.sample_train_overlap=True`): the EnvRunner
+fleet streams rollouts as object-plane references while the pjit
+learner gang updates on the PREVIOUS train batch — sampling wall-time
+hides behind the update, weights broadcast back non-blocking by
+reference (one staleness version, absorbed by the ratio clip).  The
+per-iteration result reports the measured overlap
+(`sample_busy_s`/`sample_wait_s`/`overlap_ratio`) and the exactly-once
+ledger keeps env-step accounting exact through runner failures.
 """
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from ray_tpu.metrics import metric_defs as _mdefs
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
 from ray_tpu.rllib.core.rl_module import make_default_module
-from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.env.env_runner_group import (
+    DuplicateSampleError,
+    EnvRunnerGroup,
+)
+
+logger = logging.getLogger(__name__)
 
 
 class PPOConfig(AlgorithmConfig):
@@ -117,6 +134,7 @@ class PPO(Algorithm):
             cfg.rollout_fragment_length, seed=cfg.seed,
             env_kwargs=cfg.env_kwargs,
             connector=cfg.env_to_module_connector,
+            deterministic_replay=cfg.deterministic_replacement,
         )
         spec = self.env_runner_group.env_spec()
         # conv encoder for image obs, fcnet otherwise
@@ -130,16 +148,17 @@ class PPO(Algorithm):
         self.learner_group = LearnerGroup(
             self.module, loss, num_learners=cfg.num_learners,
             lr=cfg.lr, grad_clip=cfg.grad_clip, seed=cfg.seed, mesh=cfg.mesh,
+            gang_devices=cfg.num_learner_devices,
         )
         self.env_runner_group.sync_weights(
             self.learner_group.get_weights_numpy()
         )
+        self._stream_started = False
 
-    def training_step(self) -> Dict[str, Any]:
+    # -- shared postprocessing: GAE per rollout, flatten to [N, ...] ---
+    def _postprocess(self, samples: List[Dict[str, np.ndarray]]
+                     ) -> Dict[str, np.ndarray]:
         cfg = self.config
-        samples = self.env_runner_group.sample(self.module)
-
-        # postprocess: GAE per runner batch, then flatten to [N, ...]
         obs, actions, logp, adv_l, tgt_l = [], [], [], [], []
         for s in samples:
             a, tg = compute_gae(s, cfg.gamma, cfg.lambda_)
@@ -149,32 +168,52 @@ class PPO(Algorithm):
             logp.append(s["logp"].reshape(-1))
             adv_l.append(a.reshape(-1))
             tgt_l.append(tg.reshape(-1))
-        obs = np.concatenate(obs)
-        actions = np.concatenate(actions)
-        logp = np.concatenate(logp)
         advantages = np.concatenate(adv_l)
-        targets = np.concatenate(tgt_l)
         advantages = (advantages - advantages.mean()) / (
             advantages.std() + 1e-8
         )
+        return {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(actions),
+            "logp": np.concatenate(logp),
+            "advantages": advantages,
+            "value_targets": np.concatenate(tgt_l),
+        }
 
-        n = obs.shape[0]
+    def _update_epochs(self, batch: Dict[str, np.ndarray],
+                       device_metrics: bool = False
+                       ) -> Tuple[List[Dict[str, Any]], float]:
+        """Minibatch epochs over one flat train batch; returns (metric
+        dicts, update wall seconds).  `device_metrics` defers the host
+        sync to the end of the pass (the overlap path — the driver gets
+        back to collecting envelopes while XLA executes)."""
+        cfg = self.config
+        n = batch["obs"].shape[0]
         mb = min(cfg.minibatch_size, n)
         n_even = (n // mb) * mb  # static minibatch shape → one compile
         rng = np.random.default_rng(cfg.seed + self.iteration)
-        metrics_acc: List[Dict[str, float]] = []
+        update = (self.learner_group.update_minibatch_device
+                  if device_metrics else self.learner_group.update_minibatch)
+        acc: List[Dict[str, Any]] = []
+        t0 = time.perf_counter()
         for _epoch in range(cfg.num_epochs):
             perm = rng.permutation(n)[:n_even]
             for start in range(0, n_even, mb):
                 idx = perm[start:start + mb]
-                batch = {
-                    "obs": obs[idx],
-                    "actions": actions[idx],
-                    "logp": logp[idx],
-                    "advantages": advantages[idx],
-                    "value_targets": targets[idx],
-                }
-                metrics_acc.append(self.learner_group.update_minibatch(batch))
+                acc.append(update({k: v[idx] for k, v in batch.items()}))
+        if device_metrics:
+            acc = [{k: float(v) for k, v in m.items()} for m in acc]
+        update_s = time.perf_counter() - t0
+        _mdefs.observe("rt_rllib_learner_update_seconds", update_s)
+        return acc, update_s
+
+    def training_step(self) -> Dict[str, Any]:
+        if self.config.sample_train_overlap:
+            return self._training_step_overlap()
+        cfg = self.config
+        samples = self.env_runner_group.sample(self.module)
+        batch = self._postprocess(samples)
+        metrics_acc, _update_s = self._update_epochs(batch)
 
         self.env_runner_group.sync_weights(
             self.learner_group.get_weights_numpy()
@@ -183,10 +222,99 @@ class PPO(Algorithm):
             k: float(np.mean([m[k] for m in metrics_acc]))
             for k in metrics_acc[0]
         }
-        result["num_env_steps_sampled"] = n
+        result["num_env_steps_sampled"] = batch["obs"].shape[0]
+        result["num_learner_updates"] = len(metrics_acc)
         self._track_episode_metrics(
             self.env_runner_group.pop_metrics(), result
         )
+        return result
+
+    def _training_step_overlap(self) -> Dict[str, Any]:
+        """Async sample/train overlap: consume whatever the fleet
+        produced during the previous update, top up to train_batch_size
+        env steps, update, broadcast non-blocking.  The fleet keeps
+        sampling the NEXT epoch the whole time — `sample_wait_s` is the
+        only sampling wall-time the learner ever sees."""
+        cfg = self.config
+        group = self.env_runner_group
+        if not self._stream_started:
+            group.start_ref_stream(
+                self.module,
+                inflight_per_runner=cfg.inflight_rollouts_per_runner,
+            )
+            self._stream_started = True
+
+        need = cfg.train_batch_size
+        metas: List[Dict[str, Any]] = []
+        samples: List[Dict[str, np.ndarray]] = []
+        steps = 0
+        wait_s = 0.0
+        # bounded collection: collect() replaces runners whose refs
+        # ERROR, but a fleet that is alive-yet-wedged (hung env.step)
+        # returns nothing forever — surface that as a failure instead
+        # of hanging training_step silently
+        deadline = time.monotonic() + 600.0
+        # free sweep first: batches that landed while the learner ran
+        envelopes = group.collect(max_batches=4 * group.num_runners,
+                                  block=False)
+        while True:
+            for env in envelopes:
+                try:
+                    meta, b = group.fetch(env)
+                except DuplicateSampleError:
+                    raise  # accounting bug, not a runner death
+                except Exception:
+                    logger.debug(
+                        "overlap payload fetch failed; producer died — "
+                        "its replacement resamples", exc_info=True,
+                    )
+                    continue
+                metas.append(meta)
+                samples.append(b)
+                steps += int(meta["env_steps"])
+            if steps >= need:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"overlap sample collection stalled: {steps}/{need} "
+                    f"env steps after 600s — the runner fleet is alive "
+                    "but not producing (hung envs?)"
+                )
+            t_w = time.perf_counter()
+            envelopes = group.collect(
+                max_batches=4 * group.num_runners, timeout=120.0
+            )
+            wait_s += time.perf_counter() - t_w
+
+        batch = self._postprocess(samples)
+        metrics_acc, update_s = self._update_epochs(
+            batch, device_metrics=True
+        )
+        # non-blocking broadcast: in-flight rollouts stay one version
+        # stale; the ratio clip absorbs it
+        group.sync_weights_async(self.learner_group.get_weights_numpy())
+
+        result: Dict[str, Any] = {
+            k: float(np.mean([m[k] for m in metrics_acc]))
+            for k in metrics_acc[0]
+        }
+        sample_busy_s = float(sum(m["sample_s"] for m in metas))
+        hidden_s = max(0.0, sample_busy_s - wait_s)
+        version = group.weights_version
+        result.update({
+            "num_env_steps_sampled": steps,
+            "num_learner_updates": len(metrics_acc),
+            "num_async_batches": len(samples),
+            "update_s": update_s,
+            "sample_busy_s": sample_busy_s,
+            "sample_wait_s": wait_s,
+            "overlap_ratio": (hidden_s / sample_busy_s
+                              if sample_busy_s > 0 else 0.0),
+            "weights_staleness_mean": float(np.mean(
+                [version - m["weights_version"] for m in metas]
+            )),
+        })
+        self._track_episode_metrics(group.pop_metrics(), result)
         return result
 
     def get_state(self) -> Dict[str, Any]:

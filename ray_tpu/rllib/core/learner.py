@@ -9,16 +9,50 @@ compiled update — minibatches are sharded over a `jax.sharding.Mesh`
 data axis and XLA inserts the gradient psums on ICI.  A multi-actor
 mode (`num_learners > 1`) with host-collective gradient allreduce keeps
 the reference's process-parallel shape available for CPU fleets.
+
+The LEARNER GANG (`gang_devices >= 2`, or an explicit mesh): the PPO
+update is one pjit'd program over a data-sharded mesh — every gang
+member (mesh device) sees 1/N of each minibatch and XLA inserts the
+gradient psum, so adding devices widens the update without touching the
+training loop.  `update_minibatch_device` keeps metrics on device
+(no host sync per minibatch) — the driver thread returns to collecting
+sample envelopes while XLA executes, which is what hides sampling
+wall-time behind the update (the async overlap the bench measures).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.rllib.core.rl_module import RLModule, params_to_numpy
+
+logger = logging.getLogger(__name__)
+
+
+def make_data_mesh(num_devices: int):
+    """A 1-D `jax.sharding.Mesh` over the first `num_devices` local
+    devices with axis name "data" — the learner gang's substrate.  On
+    CPU boxes, virtual devices come from
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (set BEFORE
+    jax initializes; bench.py and tests/conftest.py both do)."""
+    import jax
+
+    devices = jax.devices()
+    if num_devices > len(devices):
+        raise ValueError(
+            f"gang of {num_devices} learner devices requested but only "
+            f"{len(devices)} visible — on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={num_devices} "
+            "before jax initializes"
+        )
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:num_devices]).reshape(num_devices),
+                ("data",))
 
 
 class Learner:
@@ -88,6 +122,16 @@ class Learner:
             self.params, self.opt_state, batch
         )
         return {k: float(v) for k, v in metrics.items()}
+
+    def update_minibatch_device(self, batch: Dict[str, np.ndarray]
+                                ) -> Dict[str, Any]:
+        """One update WITHOUT the host sync: metrics stay device arrays
+        (jax dispatch is async — the caller overlaps the XLA execution
+        with its own work and floats the metrics once per iteration)."""
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch
+        )
+        return metrics
 
     def get_weights_numpy(self):
         return params_to_numpy(self.params)
@@ -191,13 +235,32 @@ class _RemoteLearner:
 class LearnerGroup:
     """Reference: `learner_group.py:80`.  num_learners=0 → local learner
     in the driver process (the TPU path: one process, mesh-sharded
-    update); num_learners>=1 → remote DDP actors."""
+    update); num_learners>=1 → remote DDP actors.
+
+    `gang_devices >= 2` builds the pjit learner gang: a 1-D "data" mesh
+    over that many local devices, the update compiled once as a single
+    sharded program (the production learner shape for BASELINE config
+    #3 — see make_data_mesh)."""
 
     def __init__(self, module: RLModule, loss_fn: Callable, *,
                  num_learners: int = 0, lr: float = 3e-4,
                  grad_clip: Optional[float] = 0.5, seed: int = 0,
-                 mesh: Any = None):
+                 mesh: Any = None, gang_devices: int = 0):
         self._num = num_learners
+        if gang_devices >= 2:
+            if num_learners:
+                raise ValueError(
+                    "gang_devices (mesh-sharded pjit gang) and "
+                    "num_learners (DDP actors) are alternative scaling "
+                    "axes — set one"
+                )
+            if mesh is None:
+                mesh = make_data_mesh(gang_devices)
+        self._gang_devices = (
+            int(mesh.devices.size) if mesh is not None else (
+                0 if num_learners else 1
+            )
+        )
         if num_learners == 0:
             self._local = Learner(module, loss_fn, lr, grad_clip, seed, mesh)
             self._actors: List = []
@@ -212,6 +275,16 @@ class LearnerGroup:
                 for rank in range(num_learners)
             ]
             rt.get([a.ping.remote() for a in self._actors])
+
+    def update_minibatch_device(self, batch: Dict[str, np.ndarray]
+                                ) -> Dict[str, Any]:
+        """Sync-free update for the overlap pipeline (local/gang mode
+        only; DDP actors already return host floats).  Duration metrics
+        are the caller's job — dispatch is async, so wall time is only
+        meaningful once the metrics are read back."""
+        if self._local is not None:
+            return self._local.update_minibatch_device(batch)
+        return self.update_minibatch(batch)
 
     def update_minibatch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         if self._local is not None:
@@ -254,9 +327,15 @@ class LearnerGroup:
         else:
             rt.get([a.set_state.remote(state) for a in self._actors])
 
+    @property
+    def num_gang_devices(self) -> int:
+        """Mesh width of the pjit gang (1 = single local device,
+        0 = DDP actors carry the parallelism instead)."""
+        return self._gang_devices
+
     def stop(self):
         for a in self._actors:
             try:
                 rt.kill(a)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("learner actor kill on stop failed: %s", e)

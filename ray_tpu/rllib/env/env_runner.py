@@ -8,10 +8,20 @@ batch shapes static so the PPO update compiles once.
 
 Batch layout (time-major): obs[T,B,D], actions[T,B], logp[T,B],
 values[T,B], rewards[T,B], dones[T,B], final_obs[B] for bootstrap.
+
+Production shape (the reference's EnvRunnerGroup fleet): `sample_ref`
+ships the rollout through the OBJECT PLANE — the batch is `rt.put`
+inside the actor and only a small envelope (ref + accounting metadata)
+travels back on the actor-call completion path, so a fleet of hundreds
+of runners fans references, not megabytes, into the driver's owner
+shards.  Weights travel the other way by reference too
+(`set_weights_ref`): the learner puts one weights object per version
+and every runner pulls it from the store at most once per version.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -30,7 +40,8 @@ class EnvRunner:
 
     def __init__(self, env: Any, num_envs: int, rollout_length: int,
                  seed: int = 0, env_kwargs: Optional[Dict] = None,
-                 connector: Any = None):
+                 connector: Any = None, slot: int = 0,
+                 incarnation: int = 0):
         self._env = make_vector_env(env, num_envs, seed=seed,
                                     **(env_kwargs or {}))
         self._T = rollout_length
@@ -38,6 +49,14 @@ class EnvRunner:
         self._obs = self._env.reset(seed=seed)
         self._params: Any = None
         self._weights_version = -1
+        # fleet identity for exactly-once sample accounting: `slot` is
+        # the stable position in the group, `incarnation` bumps on every
+        # replacement, `seq` numbers this incarnation's rollouts — the
+        # ledger key (slot, incarnation, seq) can never collide between
+        # a dead runner's in-flight batches and its replacement's
+        self._slot = slot
+        self._incarnation = incarnation
+        self._seq = 0
         # env<->module transform pipeline (reference: rllib/connectors/
         # ConnectorV2); a factory callable lets the spec ship by value
         self._connector = connector() if callable(connector) else connector
@@ -197,6 +216,69 @@ class EnvRunner:
             "bootstrap_values": boot_buf,
             "final_value": final_value.astype(np.float32),
         }
+
+    # -- object-plane sampling (production path) ----------------------
+    def sample_ref(self, module_def, explore=None) -> Dict[str, Any]:
+        """One rollout shipped as an object-plane reference.
+
+        Returns a small ENVELOPE — `{"batch": ObjectRef, "meta": {...}}`
+        — instead of the multi-megabyte batch: the rollout is `rt.put`
+        into this worker's shm store and the learner side fetches it
+        zero-copy.  `meta` carries the exactly-once ledger key and the
+        sampling wall time (the overlap-ratio numerator)."""
+        import ray_tpu as rt
+
+        t0 = time.perf_counter()
+        batch = self.sample(module_def, explore)
+        sample_s = time.perf_counter() - t0
+        ref = rt.put(batch)
+        env_steps = int(self._T * self._env.num_envs)
+        nbytes = int(sum(
+            v.nbytes for v in batch.values() if hasattr(v, "nbytes")
+        ))
+        meta = {
+            "slot": self._slot,
+            "incarnation": self._incarnation,
+            "seq": self._seq,
+            "env_steps": env_steps,
+            "weights_version": self._weights_version,
+            "sample_s": sample_s,
+            "bytes": nbytes,
+            "done_t": time.time(),
+        }
+        self._seq += 1
+        return {"batch": ref, "meta": meta}
+
+    def set_weights_ref(self, boxed: Dict[str, Any], version: int) -> bool:
+        """Adopt a weights version published once to the object plane
+        (`boxed = {"ref": ObjectRef}` — boxed so the ref is NOT
+        materialized as a task arg).  Pull-once-per-version: a stale or
+        duplicate broadcast is a no-op."""
+        if version <= self._weights_version:
+            return False
+        import ray_tpu as rt
+
+        self._params = rt.get(boxed["ref"])
+        self._weights_version = version
+        return True
+
+    def replay(self, module_def, weight_refs: List[Dict[str, Any]],
+               explore=None) -> int:
+        """Deterministically rebuild this runner's state by replaying
+        the rollout history of a dead predecessor: step through the
+        SAME weights sequence the dead incarnation sampled with (env,
+        action-rng and connector state are pure functions of the seed
+        and that sequence).  Episode metrics generated during replay
+        are dropped — the predecessor already reported them.  Returns
+        the number of rollouts replayed."""
+        import ray_tpu as rt
+
+        for i, boxed in enumerate(weight_refs):
+            self.set_weights_ref(boxed, i + 1)
+            self.sample(module_def, explore)
+        self._completed = []
+        self._seq = len(weight_refs)
+        return len(weight_refs)
 
     def pop_metrics(self) -> List[Dict[str, float]]:
         out, self._completed = self._completed, []

@@ -3,23 +3,95 @@
 Reference: `rllib/env/env_runner_group.py:71` — owns N remote EnvRunner
 actors, broadcasts weights, gathers samples, and restores failed runners
 (reference: `algorithm.py:235` restore_workers).
+
+Production shape (this repo's BASELINE config #3 workload): sample
+batches move as OBJECT-PLANE REFERENCES — each runner `rt.put`s its
+rollout locally and returns a small envelope, so a fleet of
+tens-to-hundreds of CPU actors fans small envelopes (not megabytes)
+into the driver's owner shards, and the learner fetches batch payloads
+zero-copy from shm.  Weights broadcast the same way: ONE `rt.put` per
+version, every runner pulls at most once per version
+(`EnvRunner.set_weights_ref`).
+
+Exactly-once accounting: every consumed batch is recorded in a
+`SampleLedger` under its (slot, incarnation, seq) key.  Runner
+replacement bumps the incarnation, so a dead runner's in-flight batches
+can never collide with — or be double-counted against — its
+replacement's.  With `deterministic_replay=True` (sync fleets), a
+replacement rebuilds the dead runner's exact env/rng state by replaying
+its weights history, so a kill-storm run consumes bit-identical batches
+to an unkilled control run.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu.metrics import metric_defs as _mdefs
 from ray_tpu.rllib.env.env_runner import EnvRunner
+
+logger = logging.getLogger(__name__)
+
+
+class DuplicateSampleError(RuntimeError):
+    """A sample batch was consumed twice — the exactly-once fleet
+    accounting is broken.  NEVER swallowed by the fault-tolerant
+    consumption paths (which treat other fetch failures as a dead
+    producer): this is a correctness bug, not a runner death."""
+
+
+class SampleLedger:
+    """Exactly-once consumption ledger for the runner fleet.
+
+    Every batch the learner side consumes is recorded under its
+    (slot, incarnation, seq) identity; a duplicate delivery raises —
+    double-counting a rollout would silently skew both the bench
+    numbers and the training distribution."""
+
+    def __init__(self):
+        self._seen: set = set()
+        self.batches = 0
+        self.env_steps = 0
+        self.bytes = 0
+        self.sample_s = 0.0
+
+    def record(self, meta: Dict[str, Any]) -> None:
+        key = (meta["slot"], meta["incarnation"], meta["seq"])
+        if key in self._seen:
+            raise DuplicateSampleError(
+                f"duplicate sample batch consumed: {key} — the "
+                "exactly-once fleet accounting is broken"
+            )
+        self._seen.add(key)
+        self.batches += 1
+        self.env_steps += int(meta["env_steps"])
+        self.bytes += int(meta.get("bytes", 0))
+        self.sample_s += float(meta.get("sample_s", 0.0))
+        _mdefs.inc("rt_rllib_env_steps_total", float(meta["env_steps"]))
+        _mdefs.inc("rt_rllib_sample_batch_bytes_total",
+                   float(meta.get("bytes", 0)))
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "batches": float(self.batches),
+            "env_steps": float(self.env_steps),
+            "bytes": float(self.bytes),
+            "sample_s": self.sample_s,
+            "unique": float(len(self._seen)),
+        }
 
 
 class EnvRunnerGroup:
     def __init__(self, env: Any, num_runners: int, num_envs_per_runner: int,
                  rollout_length: int, seed: int = 0,
                  env_kwargs: Optional[Dict] = None,
-                 connector: Any = None):
+                 connector: Any = None,
+                 deterministic_replay: bool = False):
         self._env = env
         self._num_runners = num_runners
         self._num_envs = num_envs_per_runner
@@ -29,43 +101,147 @@ class EnvRunnerGroup:
         self._connector_factory = connector
         self._connector_base: Dict = {}  # merged fleet connector state
         self._runners: List = []
+        self._incarnations: List[int] = [0] * num_runners
         self._weights: Any = None
         self._weights_version = 0
+        #: one boxed `{"ref": ObjectRef}` per published version (1-based
+        #: version v lives at index v-1).  With deterministic_replay the
+        #: whole history is retained (replacements replay it); otherwise
+        #: only the latest ref is kept alive.
+        self._weights_refs: List[Dict[str, Any]] = []
+        self._deterministic_replay = deterministic_replay
+        if deterministic_replay and connector is not None:
+            raise ValueError(
+                "deterministic_replay rebuilds runner state from the "
+                "seed + weights history alone; stateful connector "
+                "pipelines receive out-of-band set_connector_state "
+                "pushes that replay cannot reproduce — use one or the "
+                "other"
+            )
+        self.ledger = SampleLedger()
+        self._replacements = 0
         for i in range(num_runners):
             self._runners.append(self._make_runner(i))
+        _mdefs.set_gauge("rt_rllib_env_runners", float(num_runners))
 
     def _make_runner(self, idx: int):
         return rt.remote(EnvRunner).options(num_cpus=1).remote(
             self._env, self._num_envs, self._T,
             seed=self._seed + idx * 10_000, env_kwargs=self._env_kwargs,
             connector=self._connector_factory,
+            slot=idx, incarnation=self._incarnations[idx],
         )
 
     def env_spec(self) -> Dict[str, int]:
         return rt.get(self._runners[0].env_spec.remote())
 
-    def sync_weights(self, params_np: Any):
+    # -- weights broadcast (by reference: one put per version) ---------
+    def _publish_weights(self, params_np: Any) -> Dict[str, Any]:
         self._weights = params_np
         self._weights_version += 1
+        # inline=False: small policies would otherwise live in the
+        # driver's memory and every runner pull would be an owner RPC
+        # through the daemon (N round-trips per version); through shm,
+        # node-local runners read the one published copy zero-copy
+        boxed = {"ref": rt.put(params_np, inline=False)}
+        if self._deterministic_replay:
+            self._weights_refs.append(boxed)
+        else:
+            self._weights_refs = [boxed]
+        return boxed
+
+    def sync_weights(self, params_np: Any):
+        boxed = self._publish_weights(params_np)
         refs = [
-            r.set_weights.remote(params_np, self._weights_version)
+            r.set_weights_ref.remote(boxed, self._weights_version)
             for r in self._runners
         ]
         rt.wait(refs, num_returns=len(refs), timeout=30)
 
+    def sync_weights_async(self, params_np: Any):
+        """Non-blocking weight broadcast: runners adopt the new weights
+        for their NEXT rollout; in-flight rollouts stay stale (V-trace
+        or PPO's ratio clip absorbs one version of staleness)."""
+        boxed = self._publish_weights(params_np)
+        for r in self._runners:
+            r.set_weights_ref.remote(boxed, self._weights_version)
+        # connector stats ride the same cadence on the async path
+        if (
+            self._connector_factory is not None
+            and self._weights_version % 8 == 0
+        ):
+            self.sync_connector_states()
+
+    def _bootstrap_replacement(self, idx: int) -> bool:
+        """Bring a fresh incarnation up to date: deterministic replay of
+        the dead runner's weights history when enabled, else just the
+        latest weights.  A bootstrap failure (the replacement itself
+        killed under a sustained storm) is survivable: the un-weighted
+        runner's next sample fails, which routes back through the
+        replacement path — the fleet self-heals once kills stop."""
+        try:
+            if (self._deterministic_replay
+                    and self._replay_module is not None):
+                history = self._weights_refs[:-1]
+                if history:
+                    rt.get(self._runners[idx].replay.remote(
+                        self._replay_module, history,
+                    ), timeout=300)
+            if self._weights_refs:
+                rt.get(self._runners[idx].set_weights_ref.remote(
+                    self._weights_refs[-1], self._weights_version,
+                ), timeout=60)
+            return True
+        except Exception as e:
+            logger.debug(
+                "replacement runner %d bootstrap failed (%s); its next "
+                "sample re-triggers replacement", idx, e,
+            )
+            return False
+
+    def _replace_runner_sync(self, idx: int):
+        self._incarnations[idx] += 1
+        self._replacements += 1
+        self._runners[idx] = self._make_runner(idx)
+        self._bootstrap_replacement(idx)
+        _mdefs.set_gauge("rt_rllib_env_runners", float(self._num_runners))
+
+    # module used for deterministic replay (set by sample()/streams)
+    _replay_module: Any = None
+
+    # -- synchronous fleet sampling ------------------------------------
     def sample(self, module_def, explore=None) -> List[Dict[str, np.ndarray]]:
-        """One rollout from every healthy runner; failed runners are
-        replaced and their sample skipped this round (reference:
-        EnvRunnerGroup fault tolerance)."""
-        refs = [r.sample.remote(module_def, explore) for r in self._runners]
+        """One rollout from every runner, shipped by reference.
+
+        Failed runners are replaced in place; with deterministic_replay
+        their round is RETRIED on the replacement (the replayed state
+        regenerates the identical rollout), otherwise it is skipped
+        this round (reference: EnvRunnerGroup fault tolerance)."""
+        self._replay_module = module_def
+        refs = [r.sample_ref.remote(module_def, explore)
+                for r in self._runners]
         out: List[Dict[str, np.ndarray]] = []
         for i, ref in enumerate(refs):
-            try:
-                out.append(rt.get(ref, timeout=120))
-            except Exception:
-                self._runners[i] = self._make_runner(i)
-                rt.get(self._runners[i].set_weights.remote(
-                    self._weights, self._weights_version))
+            attempts = 0
+            while True:
+                try:
+                    envelope = rt.get(ref, timeout=120)
+                    out.append(self._consume(envelope))
+                    break
+                except DuplicateSampleError:
+                    raise  # accounting bug, not a runner death
+                except Exception as e:
+                    attempts += 1
+                    logger.debug(
+                        "env runner %d failed mid-sample (%s); replacing",
+                        i, e,
+                    )
+                    self._replace_runner_sync(i)
+                    if not (self._deterministic_replay and attempts < 3):
+                        break
+                    ref = self._runners[i].sample_ref.remote(
+                        module_def, explore
+                    )
         if not out:
             raise RuntimeError("all env runners failed")
         # fleet-wide connector statistics converge once per sampling
@@ -75,12 +251,38 @@ class EnvRunnerGroup:
             self.sync_connector_states()
         return out
 
-    # -- async sampling (the IMPALA shape) -----------------------------
-    def start_async_sampling(self, module_def, *, inflight_per_runner: int = 2,
-                             explore=None):
+    def fetch(self, envelope: Dict[str, Any]
+              ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        """Fetch an envelope's batch payload from the object plane and
+        record it in the exactly-once ledger.  Returns (meta, batch).
+
+        The ledger records AFTER the payload fetch succeeds: a batch
+        whose producer died between envelope delivery and payload read
+        is never counted as consumed."""
+        batch = rt.get(envelope["batch"], timeout=120)
+        self.ledger.record(envelope["meta"])
+        return envelope["meta"], batch
+
+    def _consume(self, envelope: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return self.fetch(envelope)[1]
+
+    # -- async ref stream (the sample/train-overlap shape) -------------
+    def start_ref_stream(self, module_def, *, inflight_per_runner: int = 2,
+                         explore=None):
         """Keep every runner busy with up to `inflight_per_runner`
-        outstanding sample() calls (reference: IMPALA's async request
-        manager, `impala.py` AsyncRequestsManager)."""
+        outstanding sample_ref() calls (reference: IMPALA's async
+        request manager, `impala.py` AsyncRequestsManager).  Batches
+        land in the object plane; `collect()` hands back envelopes."""
+        if self._deterministic_replay:
+            raise ValueError(
+                "deterministic_replay assumes one rollout per weights "
+                "version (the sync fleet shape); the async ref stream "
+                "pipelines several rollouts per version, so a replayed "
+                "replacement would diverge from the dead incarnation — "
+                "use the sync sample() path for deterministic "
+                "replacement, or disable it for overlap"
+            )
+        self._replay_module = module_def
         self._async_module = module_def
         self._async_explore = explore
         self._async_inflight = inflight_per_runner
@@ -90,29 +292,41 @@ class EnvRunnerGroup:
             for _ in range(inflight_per_runner):
                 self._submit_async(i)
 
+    # back-compat alias (IMPALA's original entry point)
+    def start_async_sampling(self, module_def, *,
+                             inflight_per_runner: int = 2, explore=None):
+        self.start_ref_stream(module_def,
+                              inflight_per_runner=inflight_per_runner,
+                              explore=explore)
+
     def _submit_async(self, idx: int):
-        ref = self._runners[idx].sample.remote(
+        ref = self._runners[idx].sample_ref.remote(
             self._async_module, self._async_explore
         )
         self._pending[ref] = idx
         self._inflight_count[idx] += 1
 
-    def get_ready_samples(self, max_batches: int = 4,
-                          timeout: Optional[float] = 120.0
-                          ) -> List[Dict[str, np.ndarray]]:
-        """Collect completed rollouts (blocking for at least one) and
-        immediately re-dispatch their runners — the learner never waits
-        for the slowest runner (the async architecture IMPALA exists
-        for).  Dead runners are replaced in place."""
-        assert self._pending, "call start_async_sampling first"
-        out: List[Dict[str, np.ndarray]] = []
-        # block for ONE rollout, then sweep whatever else is already
-        # done — never a barrier on the slowest runner (that barrier is
-        # exactly what IMPALA's async architecture removes)
-        ready, rest = rt.wait(
-            list(self._pending), num_returns=1, timeout=timeout
-        )
-        if rest and max_batches > 1:
+    def collect(self, max_batches: int = 4,
+                timeout: Optional[float] = 120.0,
+                block: bool = True) -> List[Dict[str, Any]]:
+        """Collect completed envelopes (blocking for at least one when
+        `block`) and immediately re-dispatch their runners — the
+        learner never waits for the slowest runner.  Dead runners are
+        replaced in place (fresh incarnation; their other in-flight
+        refs are dropped, so the ledger stays exactly-once)."""
+        assert self._pending, "call start_ref_stream first"
+        out: List[Dict[str, Any]] = []
+        if block:
+            ready, rest = rt.wait(
+                list(self._pending), num_returns=1, timeout=timeout
+            )
+        else:
+            ready, rest = rt.wait(
+                list(self._pending),
+                num_returns=min(max_batches, len(self._pending)),
+                timeout=0,
+            )
+        if block and rest and max_batches > 1:
             more, _ = rt.wait(
                 rest,
                 num_returns=min(max_batches - 1, len(rest)),
@@ -128,9 +342,31 @@ class EnvRunnerGroup:
             self._inflight_count[idx] -= 1
             try:
                 out.append(rt.get(ref))
-            except Exception:
+            except Exception as e:
+                logger.debug(
+                    "env runner %d died with a rollout in flight (%s); "
+                    "replacing", idx, e,
+                )
                 self._replace_runner(idx)
             self._submit_async(idx)
+        return out
+
+    def get_ready_samples(self, max_batches: int = 4,
+                          timeout: Optional[float] = 120.0
+                          ) -> List[Dict[str, np.ndarray]]:
+        """Envelope stream + payload fetch in one call — the IMPALA
+        surface.  Every returned batch is ledger-recorded."""
+        out = []
+        for envelope in self.collect(max_batches=max_batches,
+                                     timeout=timeout):
+            try:
+                out.append(self._consume(envelope))
+            except DuplicateSampleError:
+                raise  # accounting bug, not a runner death
+            except Exception as e:
+                # the producing runner died between envelope delivery
+                # and payload fetch; its replacement resamples
+                logger.debug("sample payload fetch failed: %s", e)
         return out
 
     def _replace_runner(self, idx: int):
@@ -140,27 +376,16 @@ class EnvRunnerGroup:
             if i == idx:
                 del self._pending[ref]
         self._inflight_count[idx] = 0
+        self._incarnations[idx] += 1
+        self._replacements += 1
         self._runners[idx] = self._make_runner(idx)
-        rt.get(self._runners[idx].set_weights.remote(
-            self._weights, self._weights_version))
+        self._bootstrap_replacement(idx)
+        _mdefs.set_gauge("rt_rllib_env_runners", float(self._num_runners))
         while self._inflight_count[idx] < self._async_inflight - 1:
             self._submit_async(idx)
 
-    def sync_weights_async(self, params_np: Any):
-        """Non-blocking weight broadcast: runners adopt the new weights
-        for their NEXT rollout; in-flight rollouts stay stale (V-trace
-        corrects them)."""
-        self._weights = params_np
-        self._weights_version += 1
-        for r in self._runners:
-            r.set_weights.remote(params_np, self._weights_version)
-        # connector stats ride the same cadence on the async path
-        if (
-            self._connector_factory is not None
-            and self._weights_version % 8 == 0
-        ):
-            self.sync_connector_states()
-
+    # -- connector state (reference: connector aggregation across
+    # EnvRunners) ------------------------------------------------------
     def sync_connector_states(self):
         """Merge per-runner connector DELTAS over the tracked fleet
         base and push the result back (reference: connector state
@@ -174,7 +399,8 @@ class EnvRunnerGroup:
         for ref in refs:
             try:
                 states.append(rt.get(ref, timeout=30))
-            except Exception:
+            except Exception as e:
+                logger.debug("connector state fetch failed: %s", e)
                 states.append({})
         proto = self._connector_factory()
         merged = proto.merge_states(states)
@@ -207,17 +433,37 @@ class EnvRunnerGroup:
         for ref in refs:
             try:
                 metrics.extend(rt.get(ref, timeout=30))
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("episode metrics fetch failed: %s", e)
         return metrics
+
+    def ping_fleet(self, timeout: float = 30.0) -> int:
+        """Healthy-runner count (chaos tests assert full restoration)."""
+        alive = 0
+        for r in self._runners:
+            try:
+                if rt.get(r.ping.remote(), timeout=timeout):
+                    alive += 1
+            except Exception as e:
+                logger.debug("runner ping failed: %s", e)
+        return alive
 
     @property
     def num_runners(self) -> int:
         return self._num_runners
 
+    @property
+    def num_replacements(self) -> int:
+        return self._replacements
+
+    @property
+    def weights_version(self) -> int:
+        return self._weights_version
+
     def stop(self):
         for r in self._runners:
             try:
                 rt.kill(r)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("runner kill on stop failed: %s", e)
+        _mdefs.set_gauge("rt_rllib_env_runners", 0.0)

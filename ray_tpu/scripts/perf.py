@@ -1318,7 +1318,7 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p.add_argument("--elastic-steps", type=int, default=12)
     p.add_argument("--config", default=None,
                    choices=["data_shuffle", "obs_overhead",
-                            "storage_faults"],
+                            "storage_faults", "rllib_ppo"],
                    help="named measurement config (data_shuffle: "
                         "repartition+sort of a dataset ~2x the object "
                         "store, rows/s + spill bytes; obs_overhead: "
@@ -1326,7 +1326,15 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "off vs on, overhead pct; storage_faults: the "
                         "same exchange under a seeded bit-flip + "
                         "ENOSPC + EIO disk-fault schedule, exact row "
-                        "accounting + fault-counter evidence)")
+                        "accounting + fault-counter evidence; "
+                        "rllib_ppo: EnvRunner fleet -> pjit learner "
+                        "gang with async overlap, env-steps/s + "
+                        "updates/s + exactly-once ledger accounting)")
+    p.add_argument("--rllib-runners", type=int, default=4)
+    p.add_argument("--rllib-envs-per-runner", type=int, default=8)
+    p.add_argument("--rllib-rollout-len", type=int, default=32)
+    p.add_argument("--rllib-gang-devices", type=int, default=2)
+    p.add_argument("--rllib-iters", type=int, default=3)
     p.add_argument("--shuffle-rows", type=int, default=3_200_000)
     p.add_argument("--shuffle-store-mb", type=int, default=12)
     p.add_argument("--shuffle-integrity", default="on",
@@ -1395,6 +1403,27 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
             rows=args.storage_faults_rows,
             store_mb=args.storage_faults_store_mb,
             seed=args.storage_faults_seed,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
+
+    if args.config == "rllib_ppo":
+        from ray_tpu.rllib.bench import measure_rllib_ppo
+
+        results = measure_rllib_ppo(
+            num_runners=args.rllib_runners,
+            envs_per_runner=args.rllib_envs_per_runner,
+            rollout_len=args.rllib_rollout_len,
+            minibatch=max(
+                64,
+                args.rllib_envs_per_runner * args.rllib_rollout_len,
+            ),
+            gang_devices=args.rllib_gang_devices,
+            iters=args.rllib_iters,
+            compare_sync=False,
         )
         if args.json:
             with open(args.json, "w") as f:

@@ -33,14 +33,36 @@ def _store_contains(ref) -> bool:
     return get_runtime().store.contains(ref.binary())
 
 
-def _settle(pred, timeout=5.0):
+def _freed(id_bytes, timeout=60.0) -> bool:
+    """Event-driven free assertion (suite-load deflake): block on the
+    runtime's wait_freed() — which fires the instant _maybe_free
+    retires the entry — instead of polling contains() against a short
+    wall-clock budget.  gc.collect() between short waits still drives
+    reference cycles that hold ObjectRefs; the generous deadline is
+    only the FAILURE bound, success returns immediately."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rtm = get_runtime()
     deadline = time.time() + timeout
-    while time.time() < deadline:
+    while True:
         gc.collect()
-        if pred():
+        if rtm.wait_freed(id_bytes, timeout=2.0):
             return True
-        time.sleep(0.05)
-    return False
+        if time.time() > deadline:
+            return False
+
+
+def _owner_freed(owner, id_bytes, timeout=60.0) -> bool:
+    """Same, judged at the OWNER actor: its runtime frees the object
+    when the last borrower's remove_borrow lands.  Driver-side
+    gc.collect() between waits drives those borrow releases."""
+    deadline = time.time() + timeout
+    while True:
+        gc.collect()
+        if rt.get(owner.wait_freed.remote(id_bytes, 2.0), timeout=30):
+            return True
+        if time.time() > deadline:
+            return False
 
 
 def test_put_container_pins_inner_until_container_freed(rt_start):
@@ -61,9 +83,10 @@ def test_put_container_pins_inner_until_container_freed(rt_start):
     assert int(rt.get(extracted)[0]) == 1
     # drop everything -> inner must actually be freed (no job-exit leak)
     del extracted, container
-    assert _settle(lambda: not rtm.store.contains(inner_id)), (
+    assert _freed(inner_id), (
         "inner object leaked after its container was freed"
     )
+    assert not rtm.store.contains(inner_id)
 
 
 def test_unconsumed_put_container_releases_on_free(rt_start):
@@ -81,9 +104,10 @@ def test_unconsumed_put_container_releases_on_free(rt_start):
     time.sleep(0.2)
     assert rtm.store.contains(inner_id)
     del container  # never consumed
-    assert _settle(lambda: not rtm.store.contains(inner_id)), (
+    assert _freed(inner_id), (
         "unconsumed container leaked its contained pin"
     )
+    assert not rtm.store.contains(inner_id)
 
 
 def test_inner_in_two_containers_survives_first_free(rt_start):
@@ -106,7 +130,8 @@ def test_inner_in_two_containers_survives_first_free(rt_start):
         "freeing one container freed an inner held by another"
     )
     del c2
-    assert _settle(lambda: not rtm.store.contains(inner_id))
+    assert _freed(inner_id)
+    assert not rtm.store.contains(inner_id)
 
 
 def test_task_return_container_keeps_inner_alive(rt_start):
@@ -127,7 +152,8 @@ def test_task_return_container_keeps_inner_alive(rt_start):
     got = rt.get(container)[0]
     assert int(rt.get(got)[0]) == 1
     del got, container
-    assert _settle(lambda: not rtm.store.contains(inner_id))
+    assert _freed(inner_id)
+    assert not rtm.store.contains(inner_id)
 
 
 # ----------------------------------------------------------------------
@@ -145,6 +171,11 @@ class _Owner:
         from ray_tpu.core.runtime import get_runtime
 
         return get_runtime().store.contains(id_bytes)
+
+    def wait_freed(self, id_bytes, timeout: float) -> bool:
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().wait_freed(id_bytes, timeout=timeout)
 
     def refcount(self, id_bytes):
         from ray_tpu.core.runtime import get_runtime
@@ -196,9 +227,10 @@ def test_forwarded_ref_survives_immediate_caller_drop(rt_start):
     assert rt.get(fut) == 1
     del fut
     # every holder gone -> the owner actually frees it (no leak)
-    assert _settle(
-        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
-    ), "owner leaked the object after all borrowers dropped"
+    assert _owner_freed(o, inner_id), (
+        "owner leaked the object after all borrowers dropped"
+    )
+    assert not rt.get(o.contains.remote(inner_id))
 
 
 def test_borrower_forwards_to_third_process(rt_start):
@@ -211,9 +243,7 @@ def test_borrower_forwards_to_third_process(rt_start):
     gc.collect()
     assert rt.get(fut, timeout=60) == 1
     del fut
-    assert _settle(
-        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
-    )
+    assert _owner_freed(o, inner_id)
 
 
 def test_owner_keeps_object_while_any_borrower_lives(rt_start):
@@ -228,9 +258,7 @@ def test_owner_keeps_object_while_any_borrower_lives(rt_start):
     rc = rt.get(o.refcount.remote(inner_id))
     assert rc is not None and rc["borrowers"] >= 1
     del inner
-    assert _settle(
-        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
-    )
+    assert _owner_freed(o, inner_id)
 
 
 def test_forwarded_ref_in_actor_task_args(rt_start):
@@ -248,9 +276,7 @@ def test_forwarded_ref_in_actor_task_args(rt_start):
     gc.collect()
     assert rt.get(fut) == 1
     del fut
-    assert _settle(
-        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
-    )
+    assert _owner_freed(o, inner_id)
 
 
 def test_returned_borrowed_ref_transfers_to_result_owner(rt_start):
@@ -272,6 +298,4 @@ def test_returned_borrowed_ref_transfers_to_result_owner(rt_start):
     assert rt.get(o.contains.remote(inner_id))
     assert int(rt.get(out["again"])[0]) == 1
     del out
-    assert _settle(
-        lambda: not rt.get(o.contains.remote(inner_id)), timeout=10
-    )
+    assert _owner_freed(o, inner_id)

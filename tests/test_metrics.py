@@ -235,3 +235,27 @@ def test_task_event_buffer_concurrent_writers():
         seqs = [int(e["state"]) for e in events if e["name"] == f"w{t}"]
         assert seqs == sorted(seqs)
         assert len(set(seqs)) == len(seqs)  # no duplicates
+
+
+def test_rllib_ledger_records_cataloged_metrics():
+    """The rllib fleet instrumentation writes through the cataloged
+    rt_rllib_* names (gated like every core path)."""
+    from ray_tpu.rllib.env.env_runner_group import SampleLedger
+
+    was = mdefs.enabled()
+    mdefs.set_enabled(True)
+    try:
+        steps = mdefs.metric("rt_rllib_env_steps_total")
+        bytes_c = mdefs.metric("rt_rllib_sample_batch_bytes_total")
+        s0 = sum(steps._values.values())
+        b0 = sum(bytes_c._values.values())
+        led = SampleLedger()
+        led.record({"slot": 0, "incarnation": 0, "seq": 0,
+                    "env_steps": 128, "bytes": 4096, "sample_s": 0.01})
+        assert sum(steps._values.values()) == s0 + 128
+        assert sum(bytes_c._values.values()) == b0 + 4096
+        mdefs.set_gauge("rt_rllib_env_runners", 8.0)
+        g = mdefs.metric("rt_rllib_env_runners")
+        assert list(g._values.values()) == [8.0]
+    finally:
+        mdefs.set_enabled(was)

@@ -178,6 +178,37 @@ def test_obs_overhead_row():
     assert -50.0 < row["overhead_pct"] < 50.0
 
 
+def test_rllib_ppo_row():
+    """`--config rllib_ppo`: the BASELINE-config-#3 acceptance row,
+    structurally validated at a small fleet shape (throughput numbers
+    live in PERF.md, measured at the full 8-runner shape):
+    - both headline metrics present and positive (env-steps/s AND
+      learner updates/s — the bench must measure the whole pipeline,
+      not just sampling);
+    - exactly-once accounting: every env step the training loop
+      consumed is ledger-recorded exactly once (no lost or
+      double-counted sample batches);
+    - the async overlap actually ran (overlap mode on, ratio
+      well-formed)."""
+    from ray_tpu.scripts.perf import main
+
+    results = main([
+        "--config", "rllib_ppo",
+        "--rllib-runners", "2",
+        "--rllib-envs-per-runner", "4",
+        "--rllib-rollout-len", "16",
+        "--rllib-iters", "2",
+    ])
+    row = results["rllib_ppo"]
+    assert row["env_steps_per_s"] > 0
+    assert row["updates_per_s"] > 0
+    assert row["accounting_exact"] == 1.0
+    assert row["env_steps"] == row["ledger_env_steps"] > 0
+    assert row["overlap"] == 1.0
+    assert 0.0 <= row["overlap_ratio"] <= 1.0
+    assert row["gang_devices"] >= 2.0
+
+
 def test_pin_cores_rejects_oversubscription():
     import os
 

@@ -196,6 +196,167 @@ def test_mesh_sharded_learner_matches_local():
     np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
 
 
+def test_pjit_gang_learner_matches_local():
+    """config.learners(num_learner_devices=N) builds the pjit gang (a
+    1-D data mesh) internally; the sharded update is numerically the
+    unsharded update."""
+    from ray_tpu.rllib.core.learner import LearnerGroup
+
+    mod = MLPModule(4, 2, hidden=(16,))
+    local = LearnerGroup(mod, ppo_loss, lr=1e-2, seed=0)
+    gang = LearnerGroup(mod, ppo_loss, lr=1e-2, seed=0, gang_devices=4)
+    assert local.num_gang_devices == 1
+    assert gang.num_gang_devices == 4
+    batch = _synthetic_batch(n=128)
+    m1 = local.update_minibatch(batch)
+    m2 = gang.update_minibatch(batch)
+    assert np.isclose(m1["total_loss"], m2["total_loss"], rtol=1e-4)
+    w1 = local.get_weights_numpy()["pi"][0]["w"]
+    w2 = gang.get_weights_numpy()["pi"][0]["w"]
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-5)
+
+
+def test_pjit_gang_excludes_ddp_actors():
+    from ray_tpu.rllib.core.learner import LearnerGroup
+
+    mod = MLPModule(4, 2, hidden=(16,))
+    with pytest.raises(ValueError, match="alternative scaling"):
+        LearnerGroup(mod, ppo_loss, num_learners=2, gang_devices=2)
+
+
+def test_sample_batches_travel_as_object_plane_refs(cluster):
+    """The production path: sample_ref returns a small envelope whose
+    batch payload is an ObjectRef into the producing actor's object
+    plane — not an inline rollout — and the ledger records exactly
+    once on fetch."""
+    from ray_tpu.core.object_ref import ObjectRef
+    from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+    group = EnvRunnerGroup("CartPole-v1", 2, 4, 16, seed=0)
+    try:
+        spec = group.env_spec()
+        from ray_tpu.rllib.core.rl_module import make_default_module
+
+        module = make_default_module(spec, {"hidden": (16,)})
+        import jax
+
+        group.sync_weights(
+            jax.tree.map(np.asarray,
+                         module.init_params(jax.random.PRNGKey(0)))
+        )
+        ref = group._runners[0].sample_ref.remote(module)
+        envelope = rt.get(ref, timeout=60)
+        assert isinstance(envelope["batch"], ObjectRef)
+        meta, batch = group.fetch(envelope)
+        assert meta["env_steps"] == 16 * 4
+        assert batch["obs"].shape[:2] == (16, 4)
+        # exactly-once: consuming the same envelope again raises
+        with pytest.raises(RuntimeError, match="duplicate"):
+            group.fetch(envelope)
+        led = group.ledger.snapshot()
+        assert led["batches"] == led["unique"] == 1
+        assert led["env_steps"] == 64
+        assert led["bytes"] > 0
+    finally:
+        group.stop()
+
+
+def test_weights_broadcast_pulls_once_per_version(cluster):
+    """set_weights_ref is idempotent per version: a duplicate or stale
+    broadcast is a no-op (each runner pulls the published object at
+    most once per version)."""
+    from ray_tpu.rllib.env.env_runner import EnvRunner
+
+    runner = rt.remote(EnvRunner).remote("CartPole-v1", 2, 8, seed=0)
+    boxed_v1 = {"ref": rt.put({"w": np.ones(4, np.float32)}, inline=False)}
+    boxed_v2 = {"ref": rt.put({"w": np.zeros(4, np.float32)},
+                              inline=False)}
+    assert rt.get(runner.set_weights_ref.remote(boxed_v1, 1))
+    assert not rt.get(runner.set_weights_ref.remote(boxed_v1, 1))  # dup
+    assert rt.get(runner.set_weights_ref.remote(boxed_v2, 2))
+    assert not rt.get(runner.set_weights_ref.remote(boxed_v1, 1))  # stale
+    assert rt.get(runner.get_weights_version.remote()) == 2
+    rt.kill(runner)
+
+
+def test_overlap_runners_sample_while_update_in_flight(cluster):
+    """The async-overlap contract, proven directly: with the ref
+    stream running, batches produced DURING a driver-side busy period
+    (a learner update stand-in) are waiting in the object plane when
+    the driver returns — zero blocking wait."""
+    import time as _time
+
+    from ray_tpu.rllib.core.rl_module import make_default_module
+    from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+    group = EnvRunnerGroup("CartPole-v1", 2, 4, 16, seed=0)
+    try:
+        spec = group.env_spec()
+        module = make_default_module(spec, {"hidden": (16,)})
+        import jax
+
+        group.sync_weights(
+            jax.tree.map(np.asarray,
+                         module.init_params(jax.random.PRNGKey(0)))
+        )
+        group.start_ref_stream(module, inflight_per_runner=2)
+        # drain whatever the stream produced so far
+        drained = group.collect(max_batches=64, timeout=60.0)
+        t_mark = _time.time()
+        _time.sleep(1.0)  # "the update": driver does no collecting
+        # batches must be ALREADY waiting — a non-blocking sweep
+        ready = group.collect(max_batches=64, block=False)
+        assert ready, "no batches produced while the update ran"
+        produced_during_update = [
+            e for e in ready if e["meta"]["done_t"] > t_mark
+        ]
+        assert produced_during_update, (
+            "ready batches all predate the update window"
+        )
+        for e in drained + ready:
+            group.fetch(e)
+        led = group.ledger.snapshot()
+        assert led["unique"] == led["batches"] == len(drained) + len(ready)
+    finally:
+        group.stop()
+
+
+def test_ppo_overlap_learns_cartpole(cluster):
+    """End-to-end async overlap: PPO still learns, the result carries
+    the measured overlap evidence, and accounting is exact."""
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=4,
+                  sample_train_overlap=True)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        results = [algo.train() for _ in range(20)]
+        last = results[-1]
+        assert np.isfinite(last["total_loss"])
+        assert last["num_learner_updates"] > 0
+        assert 0.0 <= last["overlap_ratio"] <= 1.0
+        # steady state hides sampling behind the update: later
+        # iterations' blocked wait is a small fraction of sample time
+        waits = [r["sample_wait_s"] for r in results[5:]]
+        busys = [r["sample_busy_s"] for r in results[5:]]
+        assert sum(waits) < 0.5 * sum(busys), (sum(waits), sum(busys))
+        led = algo.env_runner_group.ledger.snapshot()
+        assert led["unique"] == led["batches"]
+        assert led["env_steps"] == sum(
+            r["num_env_steps_sampled"] for r in results
+        )
+        late = results[-1]["episode_return_mean"]
+        early = results[0]["episode_return_mean"]
+        assert late > max(40.0, early + 15.0), (early, late)
+    finally:
+        algo.stop()
+
+
 def test_multi_learner_ddp_runs(cluster):
     algo = (
         PPOConfig()
