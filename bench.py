@@ -357,10 +357,12 @@ def bench_rllib_ppo(num_runners: int = 8) -> None:
     rows = measure_rllib_ppo(
         num_runners=num_runners, envs_per_runner=16, rollout_len=64,
         minibatch=2048, epochs=2, gang_devices=4, iters=4,
-        compare_sync=True,
+        compare_sync=True, include_dag=True,
     )
     a, s = rows["rllib_ppo"], rows["rllib_ppo_sync"]
-    for name, row in (("overlap", a), ("sync", s)):
+    d = rows["rllib_ppo_dag"]
+    for name, row in (("overlap", a), ("sync", s),
+                      ("compiled-dag", d)):
         print(
             f"# {name}: {row['env_steps_per_s']:.0f} env-steps/s, "
             f"{row['updates_per_s']:.1f} updates/s, "
@@ -371,6 +373,7 @@ def bench_rllib_ppo(num_runners: int = 8) -> None:
             file=sys.stderr,
         )
     assert a["accounting_exact"] == 1.0 and s["accounting_exact"] == 1.0
+    assert d["accounting_exact"] == 1.0
     print(json.dumps({
         "metric": "rllib_ppo_env_steps_per_sec",
         "value": round(a["env_steps_per_s"], 2),
@@ -382,6 +385,15 @@ def bench_rllib_ppo(num_runners: int = 8) -> None:
         "overlap_ratio": round(a["overlap_ratio"], 4),
         "num_env_runners": int(a["runners"]),
         "gang_devices": int(a["gang_devices"]),
+        # compiled-DAG learner round (use_compiled_dag=True): sample
+        # hop + weights broadcast over shm tensor channels.  Reported
+        # as its own delta vs the RPC overlap row, win or not.
+        "dag_env_steps_per_sec": round(d["env_steps_per_s"], 2),
+        "dag_updates_per_sec": round(d["updates_per_s"], 2),
+        "dag_overlap_ratio": round(d["overlap_ratio"], 4),
+        "dag_vs_rpc_overlap": round(
+            d["env_steps_per_s"] / a["env_steps_per_s"], 4
+        ),
     }))
 
 

@@ -123,6 +123,18 @@ class Config:
     #: scheduler_spread_threshold)
     scheduler_spread_threshold: float = 0.5
 
+    # ---- compiled DAGs (ray_tpu/dag/) --------------------------------
+    #: slots per compiled-DAG channel ring (RT_DAG_RING_SLOTS): how many
+    #: in-flight messages a channel buffers before writers block.  Both
+    #: endpoints must see the same value (it propagates through the
+    #: environment like every knob); the CREATING process's geometry
+    #: wins for a ring that already exists.
+    dag_ring_slots: int = 8
+    #: inline payload budget per ring slot (RT_DAG_SLOT_BYTES); larger
+    #: payloads spill to one store object per message with only the key
+    #: in the slot
+    dag_slot_bytes: int = 128 * 1024
+
     # ---- memory monitor / OOM killer ---------------------------------
     #: period between node memory polls; 0 disables the monitor
     #: (reference memory_monitor_refresh_ms, `ray_config_def.h`)

@@ -2186,9 +2186,7 @@ class NodeDaemon:
         )
 
     def _chan_write_local(self, payload) -> Dict[str, Any]:
-        from ray_tpu.dag.channel import (
-            _RING, _SLOT_BYTES, KIND_ERROR, KIND_SPILL_DATA, KIND_SPILL_ERROR,
-        )
+        from ray_tpu.dag.channel import SPILL_KIND, ring_geometry
         from ray_tpu.shm import ChannelClosedError
 
         chan_h = payload["chan"]
@@ -2196,10 +2194,15 @@ class NodeDaemon:
         kind = payload["kind"]
         spill_key = payload.get("spill_key")
         timeout_ms = payload.get("timeout_ms", 120000)
+        # the writer ships its channel geometry so a relay that races
+        # the reader's open still creates the ring with the right shape
+        nslots, slot_size = ring_geometry(
+            payload.get("ring_slots"), payload.get("slot_bytes")
+        )
         try:
             # returns False when the ring already exists (idempotent)
-            self.store.chan_create(chan_h, nslots=_RING,
-                                   slot_size=_SLOT_BYTES)
+            self.store.chan_create(chan_h, nslots=nslots,
+                                   slot_size=slot_size)
             if spill_key is None:
                 self.store.chan_write(chan_h, data, kind=kind,
                                       timeout_ms=timeout_ms)
@@ -2207,11 +2210,9 @@ class NodeDaemon:
                 if self.store.contains(spill_key):
                     self.store.delete(spill_key)
                 self.store.put(spill_key, data)
-                spill_kind = (
-                    KIND_SPILL_ERROR if kind == KIND_ERROR else KIND_SPILL_DATA
-                )
                 try:
-                    self.store.chan_write(chan_h, spill_key, kind=spill_kind,
+                    self.store.chan_write(chan_h, spill_key,
+                                          kind=SPILL_KIND.get(kind, kind),
                                           timeout_ms=timeout_ms)
                 except Exception:
                     self.store.delete(spill_key)

@@ -5,18 +5,24 @@ Reference: `python/ray/dag/compiled_dag_node.py` (`do_exec_tasks:92`,
 one long-lived loop: read input channels, run the bound methods in local
 topological order, write output channels.  The per-call submit/lease/
 ownership machinery is bypassed entirely; only channel ops remain on the
-hot path.
+hot path.  Array-valued step outputs (and tuples/lists/dicts of arrays
+— a multi-output step) ride the tensor fast path: one slot publication
+per consumer, no pickle on the array bytes (channel.py KIND_TENSOR).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import logging
+from typing import Any, Dict
 
 from ray_tpu.dag.channel import (
     KIND_DATA,
     Channel,
     ChannelClosed,
 )
+from ray_tpu.metrics import metric_defs as _mdefs
+
+logger = logging.getLogger(__name__)
 
 # arg-source tags in the compiled plan
 SRC_CONST = "const"
@@ -86,6 +92,8 @@ def dag_exec_loop(instance: Any, plan: Dict) -> int:
                     raise
                 except BaseException as e:  # noqa: BLE001 — error propagates
                     # through the graph, poisoning downstream stages
+                    logger.debug("DAG step %s failed; poisoning "
+                                 "downstream: %s", step["method"], e)
                     locals_[step["node_id"]] = _Poison(e)
                     for name in step["out_channels"]:
                         chan(name).write_error(e)
@@ -94,6 +102,7 @@ def dag_exec_loop(instance: Any, plan: Dict) -> int:
                 for name in step["out_channels"]:
                     chan(name).write(out, kind=KIND_DATA)
             executions += 1
+            _mdefs.inc("rt_dag_execs_total")
         except ChannelClosed:
             # teardown: forward the sentinel so downstream loops exit too
             for step in plan["steps"]:

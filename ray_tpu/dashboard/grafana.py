@@ -267,6 +267,19 @@ DEFAULT_PANELS: List[Panel] = [
               "p50")],
           description="full epochs pass over one train batch; compare "
                       "against sample_busy_s for the overlap budget"),
+    Panel("Compiled-DAG fast plane",
+          targets=[Target("rate(rt_dag_execs_total[1m])",
+                          "exec-loop executions/s"),
+                   Target("rate(rt_dag_channel_ring_full_total[5m])",
+                          "ring-full writes"),
+                   Target(
+                       "histogram_quantile(0.99, sum by (le) "
+                       "(rate(rt_dag_channel_write_seconds_bucket[5m])))",
+                       "channel write p99")],
+          description="resident exec loops + shm tensor channels; "
+                      "sustained ring-full = a reader is the "
+                      "bottleneck (raise RT_DAG_RING_SLOTS or fix the "
+                      "slow stage)"),
     Panel("Dropped task events",
           targets=[Target("rate(rt_task_events_dropped_total[5m])",
                           "{{proc}}")],

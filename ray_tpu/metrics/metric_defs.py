@@ -128,6 +128,20 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
     "rt_rllib_env_runners": (
         "gauge", "env-runner fleet size (replacements keep it at "
         "target; 0 after stop)", (), None),
+    # ---- compiled DAGs (dag/execution.py, dag/channel.py) -----------
+    "rt_dag_execs_total": (
+        "counter", "completed executions per resident exec loop "
+        "(one inc per full pass over the actor's compiled steps)", (),
+        None),
+    "rt_dag_channel_write_seconds": (
+        "histogram", "wall time of one channel slot publication "
+        "(acquire + copy + seal; includes the spill put for oversized "
+        "payloads and the daemon relay for cross-node writes)", (),
+        _LATENCY_S),
+    "rt_dag_channel_ring_full_total": (
+        "counter", "channel writes that blocked on (or timed out "
+        "against) a full ring — the reader is lagging more than "
+        "dag_ring_slots messages behind", (), None),
     # ---- train (train/trainer.py) -----------------------------------
     "rt_train_step_seconds": (
         "histogram", "wall time between delivered training result "

@@ -11,6 +11,12 @@ from ray_tpu.parallel.mesh import (
 )
 from ray_tpu.parallel.moe import MoEConfig, init_moe, moe_forward
 from ray_tpu.parallel.pipeline import pipeline_apply, stage_sharding
+from ray_tpu.parallel.pipeline_dag import (
+    CompiledPipeline,
+    bubble_fraction,
+    compile_pipeline,
+    one_f1b_schedule,
+)
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     optimizer_shardings,
@@ -22,10 +28,14 @@ from ray_tpu.parallel.sharding import (
 
 __all__ = [
     "AXES",
+    "CompiledPipeline",
     "DEFAULT_RULES",
     "MeshSpec",
     "MoEConfig",
     "batch_axes",
+    "bubble_fraction",
+    "compile_pipeline",
+    "one_f1b_schedule",
     "data_sharding",
     "init_moe",
     "local_batch_size",

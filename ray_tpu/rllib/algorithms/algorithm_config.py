@@ -51,6 +51,13 @@ class AlgorithmConfig:
         #: kill-storm run consumes bit-identical batches to an
         #: unkilled control run (chaos-test contract)
         self.deterministic_replacement: bool = False
+        #: compiled-DAG fast plane for the learner round: each runner
+        #: hosts a resident sample loop, rollout batches ride shm
+        #: tensor channels runner->learner and weights broadcasts ride
+        #: reverse channels — the per-call actor RPC machinery leaves
+        #: the hot path entirely (requires sample_train_overlap; see
+        #: docs/compiled_dag.md)
+        self.use_compiled_dag: bool = False
 
     # -- fluent sections (each returns self, reference-style) ----------
     def environment(self, env: Any = None, *, env_config: Optional[Dict] = None,
@@ -134,6 +141,26 @@ class AlgorithmConfig:
             self.rollout_fragment_length = self.train_batch_size // per_step
         else:
             self.train_batch_size = per_step * self.rollout_fragment_length
+        if self.use_compiled_dag:
+            if not self.sample_train_overlap:
+                raise ValueError(
+                    "use_compiled_dag rides the overlap learner round "
+                    "(resident sample loops feed channels continuously) "
+                    "— set training(sample_train_overlap=True) with it"
+                )
+            if self.deterministic_replacement:
+                raise ValueError(
+                    "deterministic_replacement replays the weights-ref "
+                    "history over the actor-call path; the channel "
+                    "plane broadcasts by value — use one or the other"
+                )
+            if self.env_to_module_connector is not None:
+                raise ValueError(
+                    "use_compiled_dag runs a resident loop on every "
+                    "runner actor, and connector-state aggregation "
+                    "needs the actor-call path that loop occupies — "
+                    "use the ref stream with connector pipelines"
+                )
         return self.algo_class(self.copy())
 
     build_algo = build

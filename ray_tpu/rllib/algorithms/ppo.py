@@ -229,19 +229,52 @@ class PPO(Algorithm):
         )
         return result
 
+    def _collect_pairs(self, block: bool) -> List[Any]:
+        """One collection pass -> list of (meta, batch) pairs, on
+        whichever plane the config selected: compiled-DAG tensor
+        channels (`use_compiled_dag`) or the object-plane ref stream.
+        Both record every consumed batch in the exactly-once ledger."""
+        group = self.env_runner_group
+        cap = 4 * group.num_runners
+        if self.config.use_compiled_dag:
+            if block:
+                return group.collect_channel(max_batches=cap, timeout=120.0)
+            return group.collect_channel(max_batches=cap, block=False)
+        envelopes = (group.collect(max_batches=cap, timeout=120.0) if block
+                     else group.collect(max_batches=cap, block=False))
+        pairs = []
+        for env in envelopes:
+            try:
+                pairs.append(group.fetch(env))
+            except DuplicateSampleError:
+                raise  # accounting bug, not a runner death
+            except Exception:
+                logger.debug(
+                    "overlap payload fetch failed; producer died — "
+                    "its replacement resamples", exc_info=True,
+                )
+        return pairs
+
     def _training_step_overlap(self) -> Dict[str, Any]:
         """Async sample/train overlap: consume whatever the fleet
         produced during the previous update, top up to train_batch_size
         env steps, update, broadcast non-blocking.  The fleet keeps
         sampling the NEXT epoch the whole time — `sample_wait_s` is the
-        only sampling wall-time the learner ever sees."""
+        only sampling wall-time the learner ever sees.
+
+        With `use_compiled_dag=True` the sample hop and the weights
+        broadcast ride shm tensor channels into RESIDENT runner loops —
+        zero actor RPCs on the learner round's hot path."""
         cfg = self.config
         group = self.env_runner_group
         if not self._stream_started:
-            group.start_ref_stream(
-                self.module,
-                inflight_per_runner=cfg.inflight_rollouts_per_runner,
-            )
+            if cfg.use_compiled_dag:
+                group.start_channel_stream(self.module)
+            else:
+                group.start_ref_stream(
+                    self.module,
+                    inflight_per_runner=cfg.inflight_rollouts_per_runner,
+                )
             self._stream_started = True
 
         need = cfg.train_batch_size
@@ -249,26 +282,15 @@ class PPO(Algorithm):
         samples: List[Dict[str, np.ndarray]] = []
         steps = 0
         wait_s = 0.0
-        # bounded collection: collect() replaces runners whose refs
-        # ERROR, but a fleet that is alive-yet-wedged (hung env.step)
-        # returns nothing forever — surface that as a failure instead
-        # of hanging training_step silently
+        # bounded collection: dead producers are replaced in place, but
+        # a fleet that is alive-yet-wedged (hung env.step) returns
+        # nothing forever — surface that as a failure instead of
+        # hanging training_step silently
         deadline = time.monotonic() + 600.0
         # free sweep first: batches that landed while the learner ran
-        envelopes = group.collect(max_batches=4 * group.num_runners,
-                                  block=False)
+        pairs = self._collect_pairs(block=False)
         while True:
-            for env in envelopes:
-                try:
-                    meta, b = group.fetch(env)
-                except DuplicateSampleError:
-                    raise  # accounting bug, not a runner death
-                except Exception:
-                    logger.debug(
-                        "overlap payload fetch failed; producer died — "
-                        "its replacement resamples", exc_info=True,
-                    )
-                    continue
+            for meta, b in pairs:
                 metas.append(meta)
                 samples.append(b)
                 steps += int(meta["env_steps"])
@@ -281,9 +303,7 @@ class PPO(Algorithm):
                     "but not producing (hung envs?)"
                 )
             t_w = time.perf_counter()
-            envelopes = group.collect(
-                max_batches=4 * group.num_runners, timeout=120.0
-            )
+            pairs = self._collect_pairs(block=True)
             wait_s += time.perf_counter() - t_w
 
         batch = self._postprocess(samples)
@@ -292,7 +312,14 @@ class PPO(Algorithm):
         )
         # non-blocking broadcast: in-flight rollouts stay one version
         # stale; the ratio clip absorbs it
-        group.sync_weights_async(self.learner_group.get_weights_numpy())
+        if cfg.use_compiled_dag:
+            group.sync_weights_channel(
+                self.learner_group.get_weights_numpy()
+            )
+        else:
+            group.sync_weights_async(
+                self.learner_group.get_weights_numpy()
+            )
 
         result: Dict[str, Any] = {
             k: float(np.mean([m[k] for m in metrics_acc]))
@@ -314,7 +341,13 @@ class PPO(Algorithm):
                 [version - m["weights_version"] for m in metas]
             )),
         })
-        self._track_episode_metrics(group.pop_metrics(), result)
+        if cfg.use_compiled_dag:
+            # episode metrics rode the channel metas (the resident
+            # loops occupy the actors; pop_metrics RPCs would queue)
+            episodes = [e for m in metas for e in m.get("episodes", [])]
+            self._track_episode_metrics(episodes, result)
+        else:
+            self._track_episode_metrics(group.pop_metrics(), result)
         return result
 
     def get_state(self) -> Dict[str, Any]:

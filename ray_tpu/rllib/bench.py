@@ -45,11 +45,15 @@ def measure_rllib_ppo(*, num_runners: int = 8, envs_per_runner: int = 16,
                       epochs: int = 2, gang_devices: int = 2,
                       iters: int = 4, seed: int = 0,
                       compare_sync: bool = True,
+                      include_dag: bool = False,
                       num_workers: Optional[int] = None
                       ) -> Dict[str, Dict[str, float]]:
     """Run the fleet bench; returns {"rllib_ppo": async_row[,
-    "rllib_ppo_sync": sync_row]}.  Caller owns no cluster — this
-    inits/shuts down its own."""
+    "rllib_ppo_sync": sync_row][, "rllib_ppo_dag": compiled-DAG row]}.
+    The dag row is the same overlap shape with `use_compiled_dag=True`:
+    sample hop + weights broadcast over shm tensor channels into
+    resident runner loops instead of per-call actor RPCs.  Caller owns
+    no cluster — this inits/shuts down its own."""
     _ensure_cpu_gang_env(gang_devices)
     import ray_tpu as rt
     from ray_tpu.rllib import PPOConfig
@@ -67,6 +71,12 @@ def measure_rllib_ppo(*, num_runners: int = 8, envs_per_runner: int = 16,
                 PPOConfig, False, num_runners, envs_per_runner,
                 rollout_len, minibatch, epochs, gang_devices, iters, seed,
             )
+        if include_dag:
+            out["rllib_ppo_dag"] = _run_mode(
+                PPOConfig, True, num_runners, envs_per_runner,
+                rollout_len, minibatch, epochs, gang_devices, iters,
+                seed, use_dag=True,
+            )
         return out
     finally:
         rt.shutdown()
@@ -75,7 +85,7 @@ def measure_rllib_ppo(*, num_runners: int = 8, envs_per_runner: int = 16,
 def _run_mode(PPOConfig, overlap: bool, num_runners: int,
               envs_per_runner: int, rollout_len: int, minibatch: int,
               epochs: int, gang_devices: int, iters: int,
-              seed: int) -> Dict[str, float]:
+              seed: int, use_dag: bool = False) -> Dict[str, float]:
     algo = (
         PPOConfig()
         .environment("CartPole-v1")
@@ -84,7 +94,7 @@ def _run_mode(PPOConfig, overlap: bool, num_runners: int,
                      rollout_fragment_length=rollout_len)
         .learners(num_learner_devices=gang_devices)
         .training(lr=3e-4, minibatch_size=minibatch, num_epochs=epochs,
-                  sample_train_overlap=overlap)
+                  sample_train_overlap=overlap, use_compiled_dag=use_dag)
         .debugging(seed=seed)
         .build()
     )
@@ -129,6 +139,7 @@ def _run_mode(PPOConfig, overlap: bool, num_runners: int,
             ),
             "replacements": float(group.num_replacements),
             "final_loss": losses[-1],
+            "use_compiled_dag": float(use_dag),
         }
         if overlap:
             hidden_s = max(0.0, busy_s - wait_s)

@@ -35,6 +35,34 @@ def _softmax(x: np.ndarray) -> np.ndarray:
     return e / e.sum(axis=-1, keepdims=True)
 
 
+def flatten_tree(tree: Any):
+    """Flatten a dict/list/tuple pytree of arrays to (template, leaves)
+    — the template mirrors the structure with leaf INDICES at the
+    leaves.  Pure python: the channel weights broadcast uses it so
+    runner workers stay numpy-only (no jax import for unflattening)."""
+    leaves: List[Any] = []
+
+    def walk(t):
+        if isinstance(t, dict):
+            return {k: walk(v) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            out = [walk(v) for v in t]
+            return out if isinstance(t, list) else tuple(out)
+        leaves.append(t)
+        return len(leaves) - 1
+
+    return walk(tree), leaves
+
+
+def unflatten_tree(template: Any, leaves: List[Any]):
+    if isinstance(template, dict):
+        return {k: unflatten_tree(v, leaves) for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        out = [unflatten_tree(v, leaves) for v in template]
+        return out if isinstance(template, list) else tuple(out)
+    return leaves[template]
+
+
 class EnvRunner:
     """One sampling actor (hosts the vector env + numpy policy copy)."""
 
@@ -283,6 +311,76 @@ class EnvRunner:
     def pop_metrics(self) -> List[Dict[str, float]]:
         out, self._completed = self._completed, []
         return out
+
+    # -- compiled-DAG fast plane (use_compiled_dag=True) ---------------
+    def run_sample_channel_loop(self, plan: Dict[str, Any]) -> int:
+        """Resident sampling loop over shm tensor channels — the
+        compiled-DAG fast plane.  Rollout batches ride a tensor channel
+        straight to the learner (raw array bytes + a small meta blob,
+        ONE slot publication per rollout, no actor-RPC machinery);
+        weights versions arrive over a reverse channel, adopted
+        newest-wins between rollouts.  Exits (returning the rollout
+        count) when the driver closes the weights channel."""
+        from ray_tpu.dag.channel import (
+            Channel,
+            ChannelClosed,
+            ChannelPollTimeout,
+        )
+
+        sample_ch = Channel(*plan["sample_chan"],
+                            ring_slots=plan.get("sample_ring_slots"))
+        weights_ch = Channel(*plan["weights_chan"],
+                             ring_slots=plan.get("weights_ring_slots"))
+        module_def = plan["module"]
+        explore = plan.get("explore")
+        template = plan["weights_template"]
+        rollouts = 0
+        try:
+            while True:
+                # adopt the newest published weights; block only while
+                # this incarnation has none at all
+                while True:
+                    try:
+                        leaves, extra = weights_ch.read_tensors(
+                            timeout_s=None if self._params is None else 0.001
+                        )
+                    except ChannelPollTimeout:
+                        break
+                    version = int(extra["version"])
+                    if version > self._weights_version:
+                        self._params = unflatten_tree(template,
+                                                      list(leaves))
+                        self._weights_version = version
+                t0 = time.perf_counter()
+                batch = self.sample(module_def, explore)
+                sample_s = time.perf_counter() - t0
+                meta = {
+                    "slot": self._slot,
+                    "incarnation": self._incarnation,
+                    "seq": self._seq,
+                    "env_steps": int(self._T * self._env.num_envs),
+                    "weights_version": self._weights_version,
+                    "sample_s": sample_s,
+                    "bytes": int(sum(
+                        v.nbytes for v in batch.values()
+                        if hasattr(v, "nbytes")
+                    )),
+                    "done_t": time.time(),
+                    # the resident loop occupies this actor, so episode
+                    # metrics ride the channel instead of pop_metrics()
+                    # RPCs that would queue behind the loop forever
+                    "episodes": self.pop_metrics(),
+                }
+                sample_ch.write_tensors(batch, extra=meta)
+                self._seq += 1
+                rollouts += 1
+        except ChannelClosed:
+            # teardown: tell the learner side this producer is done
+            try:
+                sample_ch.close()
+            except Exception:  # rtlint: disable=RT005 — teardown race:
+                pass  # the driver may have destroyed the ring already
+            return rollouts
 
     def ping(self) -> bool:
         return True

@@ -33,7 +33,10 @@ import numpy as np
 
 import ray_tpu as rt
 from ray_tpu.metrics import metric_defs as _mdefs
-from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.rllib.env.env_runner import (
+    EnvRunner,
+    flatten_tree,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -120,6 +123,18 @@ class EnvRunnerGroup:
             )
         self.ledger = SampleLedger()
         self._replacements = 0
+        # compiled-DAG channel plane (use_compiled_dag) state
+        self._chan_mode = False
+        self._chan_id = ""
+        self._sample_chans: Dict[int, Any] = {}
+        self._weights_chans: Dict[int, Any] = {}
+        self._chan_loop_refs: Dict[int, Any] = {}
+        self._chan_loops_reaped: set = set()
+        self._chan_rr = 0
+        self._chan_last_health = 0.0
+        self._chan_attempt = 0  # makes every bootstrap's ring names
+        # unique, so a slow-exiting failed loop can never close the
+        # rings of the retry that replaced it
         for i in range(num_runners):
             self._runners.append(self._make_runner(i))
         _mdefs.set_gauge("rt_rllib_env_runners", float(num_runners))
@@ -151,6 +166,11 @@ class EnvRunnerGroup:
         return boxed
 
     def sync_weights(self, params_np: Any):
+        if self._chan_mode:
+            # resident loops occupy the actors: the RPC broadcast
+            # would queue behind them forever — ride the channels
+            self.sync_weights_channel(params_np)
+            return
         boxed = self._publish_weights(params_np)
         refs = [
             r.set_weights_ref.remote(boxed, self._weights_version)
@@ -384,6 +404,296 @@ class EnvRunnerGroup:
         while self._inflight_count[idx] < self._async_inflight - 1:
             self._submit_async(idx)
 
+    # -- compiled-DAG channel plane (use_compiled_dag=True) ------------
+    def start_channel_stream(self, module_def, *, explore=None):
+        """The fast-plane analog of start_ref_stream: every runner
+        hosts a RESIDENT sample loop (`run_sample_channel_loop`) and
+        the runner->learner sample hop + the weights broadcast ride shm
+        tensor channels instead of per-call actor RPCs.  Exactly-once
+        accounting is unchanged: every batch still carries its (slot,
+        incarnation, seq) meta and is ledger-recorded on consumption —
+        channel delivery consumes each published message exactly once
+        by construction."""
+        if self._deterministic_replay:
+            raise ValueError(
+                "deterministic_replay replays the weights-ref history "
+                "over the actor-call path; the channel plane broadcasts "
+                "by value — use one or the other"
+            )
+        if self._weights is None:
+            raise RuntimeError("sync_weights before start_channel_stream")
+        import uuid
+
+        self._replay_module = module_def
+        self._chan_mode = True
+        self._chan_id = uuid.uuid4().hex[:8]
+        self._chan_module = module_def
+        self._chan_explore = explore
+        try:
+            for i in range(self._num_runners):
+                self._start_runner_channels(i)
+        except BaseException:
+            # mid-fleet bootstrap failure: roll the whole plane back
+            # (already-started loops + rings) — a half-started stream
+            # would leak pinned rings and queue a second resident loop
+            # behind the first on any retry
+            try:
+                self.stop_channel_stream()
+            except Exception as e:
+                logger.debug("channel stream rollback failed: %s", e)
+            raise
+
+    def _start_runner_channels(self, idx: int):
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.dag.channel import Channel
+        from ray_tpu.dag.compiled_dag import resolve_actor_node
+
+        # force placement: a fresh (replacement) runner has no address
+        # until it is scheduled, and its ring must land on its node
+        rt.get(self._runners[idx].ping.remote(), timeout=60)
+        self._chan_attempt += 1
+        base = (f"rl{self._chan_id}_r{idx}i{self._incarnations[idx]}"
+                f"a{self._chan_attempt}")
+        s_ref = (base + "s", get_runtime().node_id)  # ring at the learner
+        w_ref = (base + "w", resolve_actor_node(self._runners[idx]))
+        template, leaves = flatten_tree(self._weights)
+        plan = {
+            "sample_chan": s_ref,
+            "weights_chan": w_ref,
+            "weights_ring_slots": 4,
+            "module": self._chan_module,
+            "explore": self._chan_explore,
+            "weights_template": template,
+        }
+        s_ch = Channel(*s_ref)
+        w_ch = Channel(*w_ref, ring_slots=4)
+        try:
+            loop_ref = self._runners[idx].run_sample_channel_loop.remote(
+                plan
+            )
+            # seed the incarnation with the current version (its loop
+            # blocks on the weights channel until one arrives)
+            w_ch.write_tensors(
+                leaves, extra={"version": self._weights_version}
+            )
+        except BaseException:
+            # register NOTHING on a partial bootstrap: a half-wired
+            # runner would look healthy (rings present, forever idle)
+            # and the self-healing would never retry it
+            for ch in (s_ch, w_ch):
+                try:
+                    ch.destroy()
+                except Exception as e:
+                    logger.debug("bootstrap ring cleanup failed: %s", e)
+            raise
+        self._sample_chans[idx] = s_ch
+        self._weights_chans[idx] = w_ch
+        self._chan_loop_refs[idx] = loop_ref
+
+    def _try_read_channel(self, idx: int, timeout_s: float):
+        """One bounded read from runner `idx`'s sample channel.
+        Returns (meta, batch), or None when nothing is ready.  A read
+        failure other than timeout means the producer died — replace
+        it in place (fresh incarnation, fresh rings)."""
+        from ray_tpu.dag.channel import ChannelPollTimeout
+
+        ch = self._sample_chans.get(idx)
+        if ch is None:
+            return None
+        try:
+            batch, meta = ch.read_tensors(timeout_s=timeout_s)
+        except ChannelPollTimeout:
+            return None
+        except Exception as e:  # ChannelClosed or any reader failure:
+            # either way the producer is gone (or its stream is
+            # corrupt) — replace it in place
+            logger.debug(
+                "sample channel of runner %d failed (%s); replacing",
+                idx, e,
+            )
+            self._replace_runner_channel(idx)
+            return None
+        self.ledger.record(meta)
+        return meta, batch
+
+    def _check_channel_loops(self):
+        """Reap failed resident loops (SIGKILLed runner: its channel
+        goes silent but its loop TASK fails) and replace their
+        runners."""
+        from ray_tpu.dag.compiled_dag import reap_failed_loop_tasks
+
+        by_ref = {ref: idx for idx, ref in self._chan_loop_refs.items()}
+        for ref, e in reap_failed_loop_tasks(list(by_ref),
+                                             self._chan_loops_reaped):
+            idx = by_ref[ref]
+            if self._chan_loop_refs.get(idx) is not ref:
+                continue  # already replaced
+            logger.debug(
+                "runner %d sample loop died (%s); replacing", idx, e,
+            )
+            self._replace_runner_channel(idx)
+
+    def _replace_runner_channel(self, idx: int):
+        for chans in (self._sample_chans, self._weights_chans):
+            ch = chans.pop(idx, None)
+            if ch is not None:
+                try:
+                    ch.destroy()
+                except Exception as e:
+                    logger.debug("stale ring destroy failed: %s", e)
+        self._chan_loop_refs.pop(idx, None)
+        # the replaced actor may still be ALIVE (transient read/ping
+        # failure): kill it, or every replacement leaks a resident
+        # runner process + its vector envs until cluster shutdown
+        try:
+            rt.kill(self._runners[idx])
+        except Exception as e:
+            logger.debug("old runner %d kill failed: %s", idx, e)
+        self._incarnations[idx] += 1
+        self._replacements += 1
+        self._runners[idx] = self._make_runner(idx)
+        try:
+            self._start_runner_channels(idx)
+        except Exception as e:
+            # replacement itself died (sustained storm): the next empty
+            # collect pass re-detects the missing channels and retries
+            logger.debug(
+                "replacement runner %d channel bootstrap failed (%s); "
+                "will retry on next stall", idx, e,
+            )
+        _mdefs.set_gauge("rt_rllib_env_runners", float(self._num_runners))
+
+    def collect_channel(self, max_batches: int = 4,
+                        timeout: Optional[float] = 120.0,
+                        block: bool = True) -> List[Tuple[Dict, Dict]]:
+        """Collect ready (meta, batch) pairs off the sample channels
+        (blocking for at least one when `block`).  Every returned batch
+        is ledger-recorded; DuplicateSampleError propagates (accounting
+        bug, never a runner death)."""
+        assert self._chan_mode, "call start_channel_stream first"
+        out: List[Tuple[Dict, Dict]] = []
+        deadline = (None if timeout is None
+                    else time.monotonic() + max(0.0, timeout))
+        # a dead runner's channel goes silent while survivors keep the
+        # stream busy, so liveness CANNOT wait for a fully-empty pass —
+        # sweep the loop refs on a cheap time throttle as well
+        if time.monotonic() - self._chan_last_health > 2.0:
+            self._heal_channel_fleet()
+        while True:
+            # sweep everything that is already published
+            for idx in sorted(self._sample_chans):
+                while len(out) < max_batches:
+                    got = self._try_read_channel(idx, timeout_s=0.001)
+                    if got is None:
+                        break
+                    out.append(got)
+                if len(out) >= max_batches:
+                    return out
+            if out or not block:
+                return out
+            if deadline is not None and time.monotonic() >= deadline:
+                return out
+            # nothing ready: look for dead producers, then park briefly
+            # on one channel round-robin (readers cost nothing while
+            # parked — the ring condvar wakes them).  The heal is
+            # throttled (~2s) and an empty fleet pays the park as a
+            # plain sleep — a persistently failing bootstrap must not
+            # spawn replacement actors in a tight loop
+            if time.monotonic() - self._chan_last_health > 2.0:
+                self._heal_channel_fleet()
+            if not self._sample_chans:
+                time.sleep(0.25)  # rtlint: disable=RT006 — not a
+                # retry loop: paced wait for the throttled heal above
+                continue
+            idxs = sorted(self._sample_chans)
+            self._chan_rr = (self._chan_rr + 1) % len(idxs)
+            got = self._try_read_channel(idxs[self._chan_rr], timeout_s=0.25)
+            if got is not None:
+                out.append(got)
+
+    def _heal_channel_fleet(self):
+        """Reap failed resident loops and re-bootstrap any runner index
+        with no rings (a storm can kill a replacement mid-bootstrap)."""
+        self._chan_last_health = time.monotonic()
+        self._check_channel_loops()
+        for idx in range(self._num_runners):
+            if self._chan_mode and idx not in self._sample_chans:
+                try:
+                    self._start_runner_channels(idx)
+                except Exception as e:
+                    logger.debug(
+                        "runner %d channel re-bootstrap failed (%s); "
+                        "replacing the actor", idx, e,
+                    )
+                    self._replace_runner_channel(idx)
+
+    def sync_weights_channel(self, params_np: Any):
+        """Non-blocking weights broadcast over the reverse channels:
+        one tensor publication per runner ring.  A full ring (runner
+        deep in a rollout, several unread versions queued) SKIPS that
+        runner for this version — it drains to the newest on its next
+        boundary, the same bounded staleness the ref path allows."""
+        assert self._chan_mode, "call start_channel_stream first"
+        self._weights = params_np
+        self._weights_version += 1
+        _template, leaves = flatten_tree(params_np)
+        for idx, ch in list(self._weights_chans.items()):
+            try:
+                ch.write_tensors(
+                    leaves, extra={"version": self._weights_version},
+                    timeout_s=0.05,
+                )
+            except TimeoutError:
+                logger.debug(
+                    "weights ring of runner %d full at v%d; it adopts "
+                    "the newest on drain", idx, self._weights_version,
+                )
+            except Exception as e:
+                logger.debug(
+                    "weights publish to runner %d failed (%s); stall "
+                    "detection will replace it", idx, e,
+                )
+
+    def stop_channel_stream(self):
+        """Tear the channel plane down: close the weights rings (the
+        resident loops exit at their next rollout boundary), drain
+        sample rings so a writer blocked on a full ring unwedges, then
+        free every ring."""
+        if not self._chan_mode:
+            return
+        from ray_tpu.dag.channel import ChannelPollTimeout
+
+        for ch in self._weights_chans.values():
+            ch.close()
+        deadline = time.monotonic() + 20.0
+        pending = dict(self._sample_chans)
+        while pending and time.monotonic() < deadline:
+            for idx, ch in list(pending.items()):
+                try:
+                    ch.read_tensors(timeout_s=0.05)
+                except ChannelPollTimeout:
+                    continue
+                except Exception as e:  # ChannelClosed (producer
+                    # exited) or a dead producer's broken stream
+                    logger.debug("sample ring %d drained (%s)", idx, e)
+                    del pending[idx]
+        refs = list(self._chan_loop_refs.values())
+        if refs:
+            try:
+                rt.wait(refs, num_returns=len(refs), timeout=15)
+            except Exception as e:
+                logger.debug("channel loop drain wait failed: %s", e)
+        for chans in (self._sample_chans, self._weights_chans):
+            for ch in chans.values():
+                try:
+                    ch.destroy()
+                except Exception as e:
+                    logger.debug("ring destroy failed: %s", e)
+            chans.clear()
+        self._chan_loop_refs.clear()
+        self._chan_loops_reaped.clear()
+        self._chan_mode = False
+
     # -- connector state (reference: connector aggregation across
     # EnvRunners) ------------------------------------------------------
     def sync_connector_states(self):
@@ -461,6 +771,10 @@ class EnvRunnerGroup:
         return self._weights_version
 
     def stop(self):
+        try:
+            self.stop_channel_stream()
+        except Exception as e:
+            logger.debug("channel stream stop failed: %s", e)
         for r in self._runners:
             try:
                 rt.kill(r)

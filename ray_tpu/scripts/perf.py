@@ -970,6 +970,98 @@ def measure_elastic_recovery(*, num_workers: int = 2, num_steps: int = 12,
     return {"elastic_recovery": row}
 
 
+class _DagPerfWorker:
+    """Module-level so the actor class pickles by reference."""
+
+    def double(self, x):
+        return 2 * x
+
+
+def measure_dag_calls(*, n: int = 2000, tensor_mb: float = 4.0,
+                      num_workers: int = 2
+                      ) -> Dict[str, Dict[str, float]]:
+    """`--config dag_calls`: the compiled-DAG fast plane vs the 1:1
+    actor-call plane, measured head-to-head in one cluster:
+
+    - actor_us_per_call: rt.get(actor.method.remote(x)) round trip —
+      the full submit/lease/complete machinery per call;
+    - dag_us_per_call: compiled execute(x).get() round trip — channel
+      ops only (the resident exec loop bypasses the RPC plane);
+    - tensor_inline_mb_s / tensor_spill_mb_s: one-way tensor-channel
+      bandwidth for a slot-sized array and a spill-path array (raw
+      buffer bytes, no pickle).
+
+    Structural shape tier-1-gated in tests/test_perf_harness.py;
+    measured numbers live in PERF.md."""
+    import numpy as np
+
+    import ray_tpu as rt
+
+    if rt.is_initialized():
+        raise RuntimeError(
+            "--config dag_calls boots its own cluster: run with no "
+            "runtime initialized"
+        )
+    rt.init(num_workers=num_workers, num_cpus=8)
+    try:
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag.channel import Channel
+
+        w = rt.remote(_DagPerfWorker).remote()
+        rt.get(w.double.remote(0))  # warm the lease + worker
+        t0 = time.perf_counter()
+        for i in range(n):
+            rt.get(w.double.remote(i))
+        actor_s = time.perf_counter() - t0
+
+        with InputNode() as inp:
+            dag = w.double.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get() == 2  # warm the channels
+            t0 = time.perf_counter()
+            for i in range(n):
+                compiled.execute(i).get()
+            dag_s = time.perf_counter() - t0
+        finally:
+            compiled.teardown()
+
+        def chan_bw(name: str, arr) -> float:
+            ch = Channel(name)
+            reps = max(4, int(64 * 1024 * 1024 / max(1, arr.nbytes)))
+            ch.write(arr)
+            assert ch.read(timeout_s=30).shape == arr.shape  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                ch.write(arr)
+                ch.read(timeout_s=30)
+            wall = time.perf_counter() - t0
+            ch.destroy()
+            return (reps * arr.nbytes) / wall / 1e6
+
+        inline = np.zeros(64 * 1024 // 4, np.float32)  # fits one slot
+        spill = np.zeros(int(tensor_mb * 1024 * 1024 / 4), np.float32)
+        row = {
+            "calls": float(n),
+            "actor_us_per_call": 1e6 * actor_s / n,
+            "dag_us_per_call": 1e6 * dag_s / n,
+            "speedup": actor_s / dag_s,
+            "tensor_inline_mb_s": chan_bw("perf_dag_inline", inline),
+            "tensor_spill_mb_s": chan_bw("perf_dag_spill", spill),
+        }
+        print(
+            f"dag_calls: actor {row['actor_us_per_call']:.1f} us/call, "
+            f"compiled {row['dag_us_per_call']:.1f} us/call "
+            f"({row['speedup']:.1f}x), tensor chan "
+            f"{row['tensor_inline_mb_s']:.0f} MB/s inline / "
+            f"{row['tensor_spill_mb_s']:.0f} MB/s spill",
+            flush=True,
+        )
+        return {"dag_calls": row}
+    finally:
+        rt.shutdown()
+
+
 def measure_data_shuffle(*, rows: int = 3_200_000,
                          store_mb: int = 12,
                          integrity: str = "on"
@@ -1318,7 +1410,7 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p.add_argument("--elastic-steps", type=int, default=12)
     p.add_argument("--config", default=None,
                    choices=["data_shuffle", "obs_overhead",
-                            "storage_faults", "rllib_ppo"],
+                            "storage_faults", "rllib_ppo", "dag_calls"],
                    help="named measurement config (data_shuffle: "
                         "repartition+sort of a dataset ~2x the object "
                         "store, rows/s + spill bytes; obs_overhead: "
@@ -1329,7 +1421,13 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "accounting + fault-counter evidence; "
                         "rllib_ppo: EnvRunner fleet -> pjit learner "
                         "gang with async overlap, env-steps/s + "
-                        "updates/s + exactly-once ledger accounting)")
+                        "updates/s + exactly-once ledger accounting; "
+                        "dag_calls: compiled-DAG round trip vs the 1:1 "
+                        "actor-call plane + tensor-channel MB/s)")
+    p.add_argument("--dag-calls-n", type=int, default=2000,
+                   help="dag_calls: round trips per plane")
+    p.add_argument("--dag-tensor-mb", type=float, default=4.0,
+                   help="dag_calls: spill-path tensor size (MB)")
     p.add_argument("--rllib-runners", type=int, default=4)
     p.add_argument("--rllib-envs-per-runner", type=int, default=8)
     p.add_argument("--rllib-rollout-len", type=int, default=32)
@@ -1403,6 +1501,17 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
             rows=args.storage_faults_rows,
             store_mb=args.storage_faults_store_mb,
             seed=args.storage_faults_seed,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+        print(json.dumps(results))
+        return results
+
+    if args.config == "dag_calls":
+        results = measure_dag_calls(
+            n=args.dag_calls_n, tensor_mb=args.dag_tensor_mb,
+            num_workers=args.num_workers,
         )
         if args.json:
             with open(args.json, "w") as f:

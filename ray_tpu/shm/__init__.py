@@ -30,6 +30,13 @@ OOM = -3
 TIMEOUT = -4
 BAD_STATE = -5
 
+# kind sealed on a slot whose payload overflowed the slot capacity
+# after acquire (endpoints disagreeing on ring geometry): the slot is
+# published zero-length under this marker so the ring is never left
+# acquired-but-unsealed, and the READER surfaces a typed error instead
+# of decoding garbage (ray_tpu/dag/channel.py handles it)
+KIND_OVERFLOW_MARKER = 0x7FFFFFFF
+
 _build_lock = threading.Lock()
 
 
@@ -358,12 +365,58 @@ class ShmStore:
             else bytes(payload)
         n = len(data)
         if n > cap.value:
+            # same invariant as chan_write_chunks: never leave the slot
+            # acquired-but-unsealed (that wedges the ring for every
+            # later writer) — publish the typed overflow marker instead
+            lib.rts_chan_write_seal(self._h, cid, 0, KIND_OVERFLOW_MARKER)
             raise ValueError(
                 f"payload {n}B exceeds channel slot size {cap.value}B"
             )
         self._view[off.value:off.value + n] = bytes(data)
         _check(
             lib.rts_chan_write_seal(self._h, cid, n, kind),
+            f"chan_write_seal {chan_id.hex()}",
+        )
+
+    def chan_write_chunks(self, chan_id: bytes, chunks, kind: int = 0,
+                          timeout_ms: int = -1):
+        """Acquire a slot and write a scatter list of buffers at their
+        running offsets — the tensor fast path publishes a header plus
+        several raw array buffers in ONE slot publication without
+        assembling an intermediate contiguous copy.
+
+        Overflow invariant: the slot capacity is only known after the
+        acquire, so an oversized payload (endpoints disagreeing on ring
+        geometry) is sealed as a zero-length KIND_OVERFLOW_MARKER —
+        never left acquired-but-unsealed, which would wedge the ring
+        for every later writer."""
+        lib = _load()
+        cid = _pad_id(chan_id)
+        views = [memoryview(c).cast("B") for c in chunks]
+        total = sum(v.nbytes for v in views)
+        off = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        rc = lib.rts_chan_write_acquire(
+            self._h, cid, timeout_ms, ctypes.byref(off), ctypes.byref(cap)
+        )
+        if rc == BAD_STATE:
+            raise ChannelClosedError(chan_id.hex())
+        _check(rc, f"chan_write_acquire {chan_id.hex()}")
+        if total > cap.value:
+            # reachable only when endpoints disagree on ring geometry
+            # (the creator's slot size won): seal a zero-length marker
+            # rather than leave the slot acquired (which would wedge
+            # the ring); the reader raises typed on the marker
+            lib.rts_chan_write_seal(self._h, cid, 0, KIND_OVERFLOW_MARKER)
+            raise ValueError(
+                f"payload {total}B exceeds channel slot size {cap.value}B"
+            )
+        pos = off.value
+        for v in views:
+            self._view[pos:pos + v.nbytes] = v
+            pos += v.nbytes
+        _check(
+            lib.rts_chan_write_seal(self._h, cid, total, kind),
             f"chan_write_seal {chan_id.hex()}",
         )
 

@@ -50,6 +50,10 @@ def test_single_actor_chain(cluster):
     try:
         assert c.execute(3).get() == 12
         assert c.execute(5).get() == 20
+        # get(timeout=0) is a poll: an already-published result wins
+        ref = c.execute(7)
+        time.sleep(0.5)
+        assert ref.get(timeout=0) == 28
     finally:
         c.teardown()
 
@@ -147,6 +151,227 @@ def test_unbounded_source_rejected(cluster):
     dag = w.double.bind(1)  # no InputNode anywhere
     with pytest.raises(ValueError, match="InputNode"):
         dag.experimental_compile()
+
+
+def test_tensor_channel_round_trip(cluster):
+    """KIND_TENSOR: raw buffer bytes + struct header, no pickle — numpy
+    and jax payloads, every container shape, and the spill path for
+    oversized arrays."""
+    import numpy as np
+
+    from ray_tpu.dag.channel import Channel
+
+    ch = Channel("t_roundtrip")
+    try:
+        batch = {
+            "obs": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "done": np.array([True, False]),
+        }
+        ch.write_tensors(batch, extra={"seq": 7})
+        val, extra = ch.read_tensors(timeout_s=10)
+        assert extra == {"seq": 7}
+        np.testing.assert_array_equal(val["obs"], batch["obs"])
+        np.testing.assert_array_equal(val["done"], batch["done"])
+
+        # generic write auto-detects tensor payloads (incl. tuples)
+        a = np.random.default_rng(0).standard_normal((5, 5))
+        ch.write((a, a[0]))
+        out = ch.read(timeout_s=10)
+        assert isinstance(out, tuple) and len(out) == 2
+        np.testing.assert_array_equal(out[0], a)
+
+        # oversized batch -> one store object, header still in the slot
+        big = np.ones(300_000, np.float64)  # 2.4 MB > slot budget
+        ch.write(big)
+        np.testing.assert_array_equal(ch.read(timeout_s=30), big)
+
+        # jax arrays adopt back as jax.Array, extended dtypes included
+        import jax
+        import jax.numpy as jnp
+
+        ja = jnp.linspace(0, 1, 37, dtype=jnp.bfloat16)
+        ch.write((ja, jnp.zeros((2, 2))))
+        tup = ch.read(timeout_s=10)
+        assert isinstance(tup[0], jax.Array) and tup[0].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(np.asarray(tup[0]), np.asarray(ja))
+
+        # structured dtypes can't ride the raw codec: they fall back
+        # to the pickle path transparently (same read-only-view
+        # contract — pickle-5 oob buffers also adopt the message bytes)
+        rec = np.zeros(3, dtype=[("a", "<i4"), ("b", "<f8")])
+        rec["a"] = [1, 2, 3]
+        ch.write(rec)
+        out = ch.read(timeout_s=10)
+        np.testing.assert_array_equal(out, rec)
+
+        # container SUBCLASSES stay on pickle too: a NamedTuple of
+        # arrays must come back typed, not degraded to a plain tuple
+        import collections
+
+        P = collections.namedtuple("P", "x y")
+        ch.write(P(np.ones(2), np.zeros(2)))
+        out = ch.read(timeout_s=10)
+        assert type(out).__name__ == "P"
+        np.testing.assert_array_equal(out.x, np.ones(2))
+    finally:
+        ch.destroy()
+
+
+def test_tensor_header_carries_metadata(cluster):
+    """The wire header is introspectable: dtype/shape/keys round-trip,
+    and the handle-kind byte + sharding blob leave room for the ICI
+    device channel (SURVEY §7) without a format change."""
+    import numpy as np
+
+    from ray_tpu.dag.channel import (
+        HANDLE_INLINE,
+        encode_tensors,
+        parse_tensor_header,
+    )
+
+    batch = {"w": np.zeros((4, 2), np.float32), "b": np.ones(3, np.int64)}
+    chunks, total = encode_tensors(batch, extra={"v": 3})
+    payload = b"".join(bytes(c) for c in chunks)
+    assert len(payload) == total
+    container, extra, entries, _ = parse_tensor_header(memoryview(payload))
+    assert extra == {"v": 3}
+    assert [e["key"] for e in entries] == ["w", "b"]
+    assert entries[0]["dtype"] == "float32"
+    assert entries[0]["shape"] == (4, 2)
+    assert all(e["sharding"] == "" for e in entries)  # host arrays
+    assert HANDLE_INLINE == 0  # wire constant, never renumber
+
+
+def test_channel_geometry_knobs_validated(cluster):
+    """RT_DAG_RING_SLOTS / RT_DAG_SLOT_BYTES are validated at channel
+    creation, and per-channel overrides take effect."""
+    import pytest as _pytest
+
+    from ray_tpu.dag.channel import Channel, ring_geometry
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    assert ring_geometry() == (cfg.dag_ring_slots, cfg.dag_slot_bytes)
+    with _pytest.raises(ValueError, match="RT_DAG_RING_SLOTS"):
+        Channel("bad_ring", ring_slots=1)
+    with _pytest.raises(ValueError, match="RT_DAG_SLOT_BYTES"):
+        Channel("bad_slot", slot_bytes=16)
+    ch = Channel("small_geom", ring_slots=2, slot_bytes=4096)
+    try:
+        assert (ch.ring_slots, ch.slot_bytes) == (2, 4096)
+        ch.write(123)
+        assert ch.read(timeout_s=10) == 123
+    finally:
+        ch.destroy()
+
+
+def test_ref_get_honors_ambient_deadline(cluster):
+    """CompiledDAGRef.get integrates with the end-to-end deadline
+    plumbing: a narrower ambient budget clamps the wait and expiry
+    raises the typed DeadlineExceededError, not a bare timeout."""
+    import ray_tpu.exceptions as exc
+    from ray_tpu.core.runtime import _ambient_deadline
+
+    @rt.remote
+    class Sleeper:
+        def slow(self, x):
+            time.sleep(30)
+            return x
+
+    w = Sleeper.remote()
+    with InputNode() as inp:
+        dag = w.slow.bind(inp)
+    c = dag.experimental_compile()
+    token = _ambient_deadline.set(time.monotonic() + 0.8)
+    try:
+        ref = c.execute(1)
+        t0 = time.perf_counter()
+        with pytest.raises(exc.DeadlineExceededError):
+            ref.get(timeout=30)  # ambient 0.8s is narrower: it wins
+        assert time.perf_counter() - t0 < 10
+    finally:
+        _ambient_deadline.reset(token)
+        c.teardown()
+
+
+def test_dag_metrics_instrumented(cluster):
+    """rt_dag_execs_total / rt_dag_channel_write_seconds record on the
+    fast path when the gate is on (and stay silent when off)."""
+    from ray_tpu.metrics import metric_defs as mdefs
+
+    w = Worker.remote()
+    with InputNode() as inp:
+        dag = w.double.bind(inp)
+    c = dag.experimental_compile()
+    was = mdefs.enabled()
+    mdefs.set_enabled(True)
+    try:
+        hist = mdefs.metric("rt_dag_channel_write_seconds")
+        writes0 = _hist_count(hist)
+        assert c.execute(2).get() == 4
+        assert c.execute(3).get() == 6
+        # the driver's own channel writes (execute() input publications)
+        # observed the histogram; exec-loop counters live in the worker
+        assert _hist_count(hist) >= writes0 + 2
+        # catalogued companions instantiate with their declared types
+        assert mdefs.metric("rt_dag_channel_ring_full_total")._type() == \
+            "counter"
+        assert mdefs.metric("rt_dag_execs_total")._type() == "counter"
+    finally:
+        mdefs.set_enabled(was)
+        c.teardown()
+
+
+def _hist_count(hist) -> float:
+    return sum(
+        v for labels, v in hist._samples() if "__count__" in labels
+    )
+
+
+def test_stage_actor_sigkill_propagates_typed_error(cluster):
+    """Chaos gate: SIGKILL a MID-pipeline stage actor — the typed
+    error must reach the driver ref THROUGH the surviving downstream
+    stage (not a hang), teardown must still release every ring, and
+    the shm sweeper must find nothing afterwards."""
+    import os
+    import signal
+
+    import ray_tpu.exceptions as exc
+    from ray_tpu import shm as shm_mod
+    from ray_tpu.core.runtime import get_runtime
+
+    @rt.remote
+    class Stage:
+        def double(self, x):
+            return 2 * x
+
+        def pid(self):
+            return os.getpid()
+
+    a, b, c_ = Stage.remote(), Stage.remote(), Stage.remote()
+    # grab the victim's pid BEFORE the resident loop occupies it
+    pid_b = rt.get(b.pid.remote(), timeout=30)
+    store = get_runtime().store
+    used_before = store.used
+    with InputNode() as inp:
+        dag = c_.double.bind(b.double.bind(a.double.bind(inp)))
+    cd = dag.experimental_compile()
+    try:
+        assert cd.execute(1).get(timeout=60) == 8  # pipe is live
+        os.kill(pid_b, signal.SIGKILL)
+        ref = cd.execute(2)
+        with pytest.raises(exc.ActorDiedError):
+            # the error is injected into B's out-channel, consumed and
+            # FORWARDED by the surviving stage C, and read here — typed
+            # propagation through every downstream stage
+            ref.get(timeout=90)
+    finally:
+        cd.teardown()
+    # every ring freed despite the dead stage
+    assert store.used <= used_before + 256 * 1024, (used_before, store.used)
+    # and nothing stale for the sweeper: the store segment belongs to
+    # the live daemon, and no orphan segments were left behind
+    assert shm_mod.sweep_stale_segments() == []
 
 
 def test_dag_teardown_frees_channel_arena(cluster):

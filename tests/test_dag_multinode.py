@@ -49,6 +49,65 @@ def test_cross_node_pipeline():
         c.shutdown()
 
 
+def test_cross_node_ring_full_backpressure():
+    """Relay-path backpressure: a remote writer filling a ring whose
+    reader is stalled blocks INSIDE the daemon relay, then surfaces a
+    typed TimeoutError naming the lag — and resumes cleanly once the
+    reader drains.  (The satellite contract for the cross-node relay:
+    ring-full is backpressure, never silent loss.)"""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dag.channel import Channel
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2, "num_workers": 2})
+    c.connect()
+    try:
+        c.add_node(num_cpus=2, resources={"other": 1}, num_workers=2)
+        c.wait_for_nodes()
+        from ray_tpu.core.runtime import get_runtime
+
+        head = get_runtime().node_id
+        slots = get_config().dag_ring_slots
+
+        @rt.remote(resources={"other": 1})
+        def fill(name, loc, n, timeout_s):
+            from ray_tpu.dag.channel import Channel as Ch
+
+            ch = Ch(name, loc)
+            sent = 0
+            try:
+                for i in range(n):
+                    ch.write(i, timeout_s=timeout_s)
+                    sent += 1
+            except TimeoutError as e:
+                return {"sent": sent, "timeout": True, "msg": str(e)}
+            return {"sent": sent, "timeout": False, "msg": ""}
+
+        # nobody reads: exactly `slots` writes land, the next one
+        # blocks against the full ring and times out TYPED
+        out = rt.get(fill.remote("bp_ring", head, slots + 2, 2.0),
+                     timeout=120)
+        assert out["timeout"] is True
+        assert out["sent"] == slots, out
+        assert "lagging" in out["msg"]
+
+        # reader drains -> the same writer proceeds (no lost messages,
+        # no poisoned ring)
+        ch = Channel("bp_ring", head)
+        try:
+            for i in range(slots):
+                assert ch.read(timeout_s=30) == i
+            out2 = rt.get(fill.remote("bp_ring", head, 2, 30.0),
+                          timeout=120)
+            assert out2["timeout"] is False and out2["sent"] == 2
+            assert [ch.read(timeout_s=30) for _ in range(2)] == [0, 1]
+        finally:
+            ch.destroy()
+    finally:
+        c.shutdown()
+
+
 def test_cross_node_fan_in_large_payload():
     """Spill-slot path over the relay: payloads past the 128KB slot
     budget travel via a store object on the reader's node."""

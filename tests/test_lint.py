@@ -617,7 +617,9 @@ def test_baseline_has_no_core_or_serve_rt001_rt002_rt005():
     never re-grandfather RT001/RT002/RT005 debt in core/ or serve/,
     nor RT005 debt in data/ (burned to zero with the fault-tolerant
     data plane) or rllib/ (burned to zero with the EnvRunner-fleet
-    production stack — best-effort paths there log their context)."""
+    production stack — best-effort paths there log their context),
+    nor ANY debt in dag/ (burned to zero with the compiled-DAG fast
+    plane — new hot-path code starts clean and stays clean)."""
     baseline = load_baseline(default_baseline_path())
     offenders = [
         k
@@ -632,6 +634,9 @@ def test_baseline_has_no_core_or_serve_rt001_rt002_rt005():
         for k in baseline
         if k.split("::")[1] == "RT005"
         and k.startswith(("ray_tpu/data/", "ray_tpu/rllib/"))
+    ]
+    offenders += [
+        k for k in baseline if k.startswith("ray_tpu/dag/")
     ]
     assert not offenders, offenders
 

@@ -237,6 +237,37 @@ def test_task_event_buffer_concurrent_writers():
         assert len(set(seqs)) == len(seqs)  # no duplicates
 
 
+def test_dag_plane_metrics_cataloged_and_gated():
+    """The compiled-DAG fast plane writes through cataloged rt_dag_*
+    names — exec-loop counter, channel-write histogram, ring-full
+    counter — gated like every core path (a disabled record is a
+    no-op, not a missing catalog entry)."""
+    for name, typ in [
+        ("rt_dag_execs_total", "counter"),
+        ("rt_dag_channel_ring_full_total", "counter"),
+        ("rt_dag_channel_write_seconds", "histogram"),
+    ]:
+        assert mdefs.metric(name)._type() == typ, name
+    h = mdefs.metric("rt_dag_channel_write_seconds")
+    assert h.boundaries  # latency buckets declared in the catalog
+    was = mdefs.enabled()
+    execs = mdefs.metric("rt_dag_execs_total")
+    try:
+        mdefs.set_enabled(False)
+        before = sum(execs._values.values())
+        mdefs.inc("rt_dag_execs_total")  # gated: must not record
+        assert sum(execs._values.values()) == before
+        mdefs.set_enabled(True)
+        mdefs.inc("rt_dag_execs_total")
+        mdefs.inc("rt_dag_channel_ring_full_total")
+        mdefs.observe("rt_dag_channel_write_seconds", 0.002)
+        assert sum(execs._values.values()) == before + 1
+        full = mdefs.metric("rt_dag_channel_ring_full_total")
+        assert sum(full._values.values()) >= 1
+    finally:
+        mdefs.set_enabled(was)
+
+
 def test_rllib_ledger_records_cataloged_metrics():
     """The rllib fleet instrumentation writes through the cataloged
     rt_rllib_* names (gated like every core path)."""

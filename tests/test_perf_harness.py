@@ -4,6 +4,8 @@ tiny durations — validates the harness end-to-end, not the numbers."""
 
 import json
 
+import pytest
+
 
 def test_perf_harness_subset(tmp_path):
     from ray_tpu.scripts.perf import main
@@ -207,6 +209,34 @@ def test_rllib_ppo_row():
     assert row["overlap"] == 1.0
     assert 0.0 <= row["overlap_ratio"] <= 1.0
     assert row["gang_devices"] >= 2.0
+
+
+def test_dag_calls_row():
+    """`--config dag_calls`: the compiled-DAG fast-plane acceptance
+    row, structurally validated at a small call count (the >=5x
+    headline lives in PERF.md, measured at the full 2000-call shape):
+    - both planes measured head-to-head in one cluster;
+    - the compiled plane actually beats the per-call actor plane (the
+      entire point of compiling);
+    - tensor-channel bandwidth rows present for BOTH paths (inline
+      slot and store-object spill)."""
+    from ray_tpu.scripts.perf import main
+
+    results = main([
+        "--config", "dag_calls",
+        "--dag-calls-n", "300",
+        "--dag-tensor-mb", "1.0",
+        "--num-workers", "2",
+    ])
+    row = results["dag_calls"]
+    assert row["actor_us_per_call"] > 0
+    assert row["dag_us_per_call"] > 0
+    assert row["dag_us_per_call"] < row["actor_us_per_call"]
+    assert row["speedup"] == pytest.approx(
+        row["actor_us_per_call"] / row["dag_us_per_call"], rel=1e-6
+    )
+    assert row["tensor_inline_mb_s"] > 0
+    assert row["tensor_spill_mb_s"] > 0
 
 
 def test_pin_cores_rejects_oversubscription():

@@ -1,0 +1,197 @@
+"""First-class 1F1B pipeline parallelism (`parallel/pipeline_dag.py`):
+multi-actor stage pipeline over compiled-DAG tensor channels must match
+the in-program GPipe schedule (`parallel/pipeline.py`) and serial stage
+application — values AND gradients — and its bubble accounting must
+match the same (S-1)/(M+S-1) model `test_pipeline.py` gates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import ray_tpu as rt
+from ray_tpu.parallel.pipeline import pipeline_apply, stage_sharding
+from ray_tpu.parallel.pipeline_dag import (
+    bubble_fraction,
+    compile_pipeline,
+    one_f1b_schedule,
+    schedule_makespan_units,
+    schedule_phases,
+)
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _loss_fn(y):
+    return jnp.mean(y**2)
+
+
+def _make(S=4, D=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+    return {
+        "w": np.asarray(
+            jax.random.normal(ks[0], (S, D, D), jnp.float32) * 0.3
+        ),
+        "b": np.asarray(
+            jax.random.normal(ks[1], (S, D), jnp.float32) * 0.1
+        ),
+    }
+
+
+def _per_stage(full, S):
+    return [{"w": full["w"][s], "b": full["b"][s]} for s in range(S)]
+
+
+def _serial_loss(stage_params, x):
+    h = x
+    for p in stage_params:
+        h = _stage_fn(p, h)
+    return jnp.mean(h**2)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt.init(num_workers=4, num_cpus=64, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------
+# schedule model (no cluster)
+# ---------------------------------------------------------------------
+def test_1f1b_schedule_shape():
+    S, M = 4, 8
+    for s in range(S):
+        ops = one_f1b_schedule(s, S, M)
+        assert len(ops) == 2 * M  # every stage runs M forwards + M backwards
+        assert [m for k, m in ops if k == "F"] == list(range(M))
+        assert [m for k, m in ops if k == "B"] == list(range(M))
+        ph = schedule_phases(s, S, M)
+        assert ph["warmup"] == min(S - 1 - s, M)
+        steady = ops[ph["warmup"]:ph["warmup"] + ph["steady"]]
+        # steady phase strictly alternates 1F, 1B
+        assert all(
+            k == ("F" if i % 2 == 0 else "B")
+            for i, (k, _) in enumerate(steady)
+        )
+    # last stage has no warmup: it alternates from the first microbatch
+    assert one_f1b_schedule(S - 1, S, M)[:2] == [("F", 0), ("B", 0)]
+
+
+def test_1f1b_bubble_accounting_matches_pipeline_model():
+    """Unit-cost makespan is 2*(M+S-1) slots -> bubble (S-1)/(M+S-1),
+    the exact model the in-program schedule documents and
+    test_pipeline.py exercises."""
+    for S, M in [(2, 1), (2, 4), (4, 2), (4, 8), (8, 16), (3, 3)]:
+        assert schedule_makespan_units(S, M) == 2 * (M + S - 1), (S, M)
+        assert bubble_fraction(S, M) == (S - 1) / (M + S - 1)
+
+
+# ---------------------------------------------------------------------
+# numeric parity (the tier-1 acceptance gates)
+# ---------------------------------------------------------------------
+def test_1f1b_matches_serial_loss_and_grads(cluster):
+    S, D, B, M = 4, 16, 8, 4
+    full = _make(S, D)
+    stage_params = _per_stage(full, S)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (B, D), jnp.float32)
+    )
+    ref_loss = float(_serial_loss(stage_params, x))
+    ref_grads = jax.grad(lambda ps: _serial_loss(ps, x))(stage_params)
+
+    pipe = compile_pipeline(_stage_fn, stage_params, _loss_fn, M)
+    try:
+        out = pipe.execute(x).get(timeout=180)
+        np.testing.assert_allclose(out["loss"], ref_loss, rtol=1e-5)
+        for s in range(S):
+            for k in ("w", "b"):
+                np.testing.assert_allclose(
+                    np.asarray(out["grads"][s][k]),
+                    np.asarray(ref_grads[s][k]),
+                    rtol=1e-5, atol=1e-6,
+                )
+        # the resident loops survive across executions
+        out2 = pipe.execute(x).get(timeout=60)
+        np.testing.assert_allclose(out2["loss"], ref_loss, rtol=1e-5)
+    finally:
+        pipe.teardown()
+
+
+def test_1f1b_matches_in_program_pipeline(cluster):
+    """Actor-level 1F1B vs the in-program shard_map GPipe schedule:
+    same loss, same grads (rtol 1e-5) — PP is now first-class in BOTH
+    forms, and they agree."""
+    S, D, B, M = 4, 16, 8, 4
+    full = _make(S, D, seed=3)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(2), (B, D), jnp.float32)
+    )
+    mesh = Mesh(np.array(jax.devices("cpu")[:S]).reshape(S), ("pp",))
+    sharded = jax.device_put(full, stage_sharding(mesh))
+
+    def loss_pp(p, x):
+        return jnp.mean(pipeline_apply(_stage_fn, p, x, mesh, M) ** 2)
+
+    with mesh:
+        ref_loss, ref_grads = jax.value_and_grad(loss_pp)(sharded, x)
+
+    pipe = compile_pipeline(_stage_fn, _per_stage(full, S), _loss_fn, M)
+    try:
+        out = pipe.execute(x).get(timeout=180)
+    finally:
+        pipe.teardown()
+    np.testing.assert_allclose(out["loss"], float(ref_loss), rtol=1e-5)
+    for s in range(S):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(out["grads"][s][k]),
+                np.asarray(ref_grads[k])[s],
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+def test_1f1b_microbatch_count_invariance(cluster):
+    """Different M give the same answer (bubble handling is schedule
+    bookkeeping, not math) — mirrors test_pipeline.py's gate."""
+    S, D, B = 2, 8, 8
+    full = _make(S, D, seed=5)
+    stage_params = _per_stage(full, S)
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(4), (B, D), jnp.float32)
+    )
+    results = {}
+    for M in (2, 8):
+        pipe = compile_pipeline(_stage_fn, stage_params, _loss_fn, M)
+        try:
+            results[M] = pipe.execute(x).get(timeout=180)
+        finally:
+            pipe.teardown()
+    np.testing.assert_allclose(results[2]["loss"], results[8]["loss"],
+                               rtol=1e-5)
+    for g2, g8 in zip(jax.tree.leaves(results[2]["grads"]),
+                      jax.tree.leaves(results[8]["grads"])):
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g8),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_teardown_frees_channel_arena(cluster):
+    """Activation/grad rings are pinned + non-evictable: teardown must
+    return them to the arena or repeated compile/teardown leaks it."""
+    from ray_tpu.core.runtime import get_runtime
+
+    S, D, B, M = 2, 8, 4, 2
+    stage_params = _per_stage(_make(S, D, seed=7), S)
+    x = np.ones((B, D), np.float32)
+    store = get_runtime().store
+    used_before = store.used
+    for _ in range(2):
+        pipe = compile_pipeline(_stage_fn, stage_params, _loss_fn, M)
+        try:
+            pipe.execute(x).get(timeout=120)
+        finally:
+            pipe.teardown()
+    assert store.used <= used_before + 256 * 1024, (used_before, store.used)

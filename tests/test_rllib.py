@@ -357,6 +357,64 @@ def test_ppo_overlap_learns_cartpole(cluster):
         algo.stop()
 
 
+def test_ppo_compiled_dag_learner_round(cluster):
+    """use_compiled_dag=True: the learner round rides shm tensor
+    channels into resident runner loops — no per-call actor RPCs on the
+    sample hop or the weights broadcast — while PPO still learns and
+    the exactly-once SampleLedger stays exact."""
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=4,
+                  sample_train_overlap=True, use_compiled_dag=True)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        results = [algo.train() for _ in range(20)]
+        last = results[-1]
+        assert np.isfinite(last["total_loss"])
+        assert last["num_learner_updates"] > 0
+        assert 0.0 <= last["overlap_ratio"] <= 1.0
+        # bounded staleness: runners drain to the newest weights at
+        # every rollout boundary
+        assert last["weights_staleness_mean"] < 8.0
+        group = algo.env_runner_group
+        assert group._chan_mode  # the channel plane actually engaged
+        led = group.ledger.snapshot()
+        assert led["unique"] == led["batches"]
+        assert led["env_steps"] == sum(
+            r["num_env_steps_sampled"] for r in results
+        )
+        # episode metrics rode the channel metas, not pop_metrics RPCs
+        late = results[-1]["episode_return_mean"]
+        early = results[0]["episode_return_mean"]
+        assert late > max(40.0, early + 15.0), (early, late)
+    finally:
+        algo.stop()
+    # teardown released every ring: the sweeper finds nothing stale
+    from ray_tpu import shm as shm_mod
+
+    assert shm_mod.sweep_stale_segments() == []
+    assert not group._chan_mode
+
+
+def test_compiled_dag_config_validation():
+    """use_compiled_dag composes only with the overlap round, and not
+    with replay-based determinism or connector pipelines."""
+    with pytest.raises(ValueError, match="sample_train_overlap"):
+        PPOConfig().environment("CartPole-v1").training(
+            use_compiled_dag=True
+        ).build()
+    with pytest.raises(ValueError, match="deterministic_replacement"):
+        PPOConfig().environment("CartPole-v1").training(
+            use_compiled_dag=True, sample_train_overlap=True,
+            deterministic_replacement=True,
+        ).build()
+
+
 def test_multi_learner_ddp_runs(cluster):
     algo = (
         PPOConfig()
