@@ -132,7 +132,9 @@ def bench_llama_lora() -> None:
     )
 
 
-def bench_serve_llm(continuous: bool = False, replicas: int = 1) -> None:
+def bench_serve_llm(continuous: bool = False, replicas: int = 1,
+                    decode_kernel: str = "auto", kv_dtype: str = "model",
+                    weight_dtype: str = "model") -> None:
     """BASELINE config #5 analog: a Llama replica behind serve, driven
     through the FULL data plane (HTTP proxy -> pow-2 router -> replica
     -> @serve.batch -> KV-cached generate), closed-loop clients at
@@ -214,6 +216,20 @@ def bench_serve_llm(continuous: bool = False, replicas: int = 1) -> None:
         # lower rungs for the latency picture
         levels = tuple(c * replicas for c in levels)
         metric += f"_x{replicas}"
+    engine_knobs = (decode_kernel, kv_dtype, weight_dtype)
+    if engine_knobs != ("auto", "model", "model"):
+        if not continuous:
+            raise ValueError("--decode-kernel/--kv-dtype/--weight-dtype "
+                             "apply to the continuous (serve_llm_cb) "
+                             "config")
+        # distinct metric names per decode/quantization variant, so
+        # PERF.md rows never silently overwrite each other
+        if decode_kernel != "auto":
+            metric += f"_{decode_kernel}"
+        if kv_dtype == "int8":
+            metric += "_kv8"
+        if weight_dtype == "int8":
+            metric += "_w8"
 
     import ray_tpu as rt
     from ray_tpu import serve
@@ -238,6 +254,8 @@ def bench_serve_llm(continuous: bool = False, replicas: int = 1) -> None:
                    # the default pool budget (HBM)
                    max_len=prompt_len + n_new + (8 if on_tpu else 2) + 8,
                    block_size=(16 if on_tpu else 8),
+                   decode_kernel=decode_kernel, kv_dtype=kv_dtype,
+                   weight_dtype=weight_dtype,
                    jax_platform=(None if on_tpu else "cpu"))
         else:
             app = LlamaService.options(
@@ -411,11 +429,28 @@ def main() -> None:
                         "saturate the fleet")
     p.add_argument("--runners", type=int, default=8,
                    help="rllib_ppo only: env-runner fleet size")
+    p.add_argument("--decode-kernel", default="auto",
+                   choices=["auto", "pallas", "gather"],
+                   help="serve_llm_cb only: engine decode route "
+                        "(auto = fused Pallas kernel on TPU, gather "
+                        "elsewhere)")
+    p.add_argument("--kv-dtype", default="model",
+                   choices=["model", "int8"],
+                   help="serve_llm_cb only: KV block-pool storage "
+                        "dtype (int8 = half payload + f32 scales)")
+    p.add_argument("--weight-dtype", default="model",
+                   choices=["model", "int8"],
+                   help="serve_llm_cb only: serve int8-quantized "
+                        "weights (per-output-channel scales)")
     args = p.parse_args()
     if args.replicas > 1 and args.config != "serve_llm_cb":
         p.error("--replicas applies only to --config serve_llm_cb")
     if args.runners != 8 and args.config != "rllib_ppo":
         p.error("--runners applies only to --config rllib_ppo")
+    knobs = (args.decode_kernel, args.kv_dtype, args.weight_dtype)
+    if knobs != ("auto", "model", "model") and args.config != "serve_llm_cb":
+        p.error("--decode-kernel/--kv-dtype/--weight-dtype apply only "
+                "to --config serve_llm_cb")
     if args.config == "llama_lora":
         bench_llama_lora()
         return
@@ -423,7 +458,10 @@ def main() -> None:
         bench_serve_llm()
         return
     if args.config == "serve_llm_cb":
-        bench_serve_llm(continuous=True, replicas=args.replicas)
+        bench_serve_llm(continuous=True, replicas=args.replicas,
+                        decode_kernel=args.decode_kernel,
+                        kv_dtype=args.kv_dtype,
+                        weight_dtype=args.weight_dtype)
         return
     if args.config == "rllib_ppo":
         bench_rllib_ppo(num_runners=args.runners)

@@ -96,6 +96,8 @@ async def update_builtin_metrics(ctl):
         "rt_serve_engine_ttft_ema_seconds": "ttft_ema_s",
         "rt_serve_engine_rejected_total": "rejected_total",
         "rt_serve_engine_shed_total": "shed_total",
+        "rt_serve_kv_pool_bytes": "kv_pool_bytes",
+        "rt_serve_decode_kernel_total": "decode_kernel_dispatch_total",
     }
     eng_gauges = {name: _mdefs.metric(name) for name in _ENGINE_BRIDGE}
     for eg in eng_gauges.values():
@@ -246,6 +248,15 @@ DEFAULT_PANELS: List[Panel] = [
           targets=[Target("rt_serve_engine_queue_depth",
                           "{{app}}/{{deployment}}/{{replica}}")],
           description="bridged from the replicas' stats() piggyback"),
+    Panel("Engine KV pool + decode kernel", unit="bytes",
+          targets=[Target("rt_serve_kv_pool_bytes",
+                          "pool {{app}}/{{deployment}}/{{replica}}"),
+                   Target("rate(rt_serve_decode_kernel_total[5m])",
+                          "kernel ticks/s "
+                          "{{app}}/{{deployment}}/{{replica}}")],
+          description="int8 pools sit at half the fp16 payload bytes; "
+                      "a zero kernel rate on TPU means the engine fell "
+                      "back to the gather decode route"),
     Panel("Train step time p50", unit="s",
           targets=[Target(
               "histogram_quantile(0.5, sum by (le) "

@@ -262,24 +262,43 @@ class ContinuousLlamaService:
                  max_len: Optional[int] = None, block_size: int = 16,
                  kv_blocks: Optional[int] = None, prefix_cache: bool = True,
                  max_queued: Optional[int] = None,
+                 decode_kernel: str = "auto", kv_dtype: str = "model",
+                 weight_dtype: str = "model",
+                 engine_config: Optional[dict] = None,
                  jax_platform: Optional[str] = None):
         import jax
 
         if jax_platform:
             jax.config.update("jax_platforms", jax_platform)
 
+        from ray_tpu.serve.config import LLMEngineConfig
         from ray_tpu.serve.llm_engine import LlamaEngine
 
+        if engine_config is not None:
+            # declarative form (deploy documents / user_config): one
+            # validated dict replaces the flat kwargs wholesale
+            from ray_tpu.serve.schema import LLMEngineSchema
+
+            ecfg = LLMEngineSchema.model_validate(engine_config).to_config()
+        else:
+            ecfg = LLMEngineConfig(
+                slots=slots, chunk=chunk, max_len=max_len,
+                block_size=block_size, kv_blocks=kv_blocks,
+                prefix_cache=prefix_cache, max_queued=max_queued,
+                decode_kernel=decode_kernel, kv_dtype=kv_dtype,
+                weight_dtype=weight_dtype,
+            ).validate()
+
         cfg, params = _build_model(model_size, seed)
+        if ecfg.weight_dtype == "int8":
+            from ray_tpu.models import llama as _llama
+
+            params = _llama.quantize_weights_int8(params)
         # max_queued mirrors the deployment's max_queued_requests at
         # the ENGINE queue (the replica callable can't see its
         # DeploymentConfig): overflow submissions fail immediately
         # with BackPressureError -> HTTP 503 + Retry-After
-        self.engine = LlamaEngine(
-            cfg, params, slots=slots, chunk=chunk, max_len=max_len,
-            block_size=block_size, kv_blocks=kv_blocks,
-            prefix_cache=prefix_cache, max_queued=max_queued,
-        )
+        self.engine = LlamaEngine(cfg, params, **ecfg.engine_kwargs())
         self.max_new_tokens = max_new_tokens
         self.max_new_tokens_limit = max_new_tokens
 

@@ -115,6 +115,15 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
     "rt_serve_engine_shed_total": (
         "gauge", "deadline sheds before prefill (monotonic, bridged)",
         ("app", "deployment", "replica"), None),
+    "rt_serve_kv_pool_bytes": (
+        "gauge", "resident KV block-pool payload bytes (K+V, "
+        "excluding the int8 f32 scale sidecar)",
+        ("app", "deployment", "replica"), None),
+    "rt_serve_decode_kernel_total": (
+        "gauge", "decode ticks dispatched through the fused paged-"
+        "attention kernel (monotonic, bridged; gather-fallback ticks "
+        "are the engine's decode_fallback_dispatch_total)",
+        ("app", "deployment", "replica"), None),
     # ---- rllib (rllib/env/env_runner_group.py, algorithms/ppo.py) ---
     "rt_rllib_env_steps_total": (
         "counter", "env steps consumed by the learner side (ledger-"

@@ -167,13 +167,64 @@ def lora_logical_axes(cfg: LlamaConfig, lora: Dict) -> Dict:
     return {"blocks": blocks, "scale": ()}
 
 
-def _apply(x, w, dtype, lora_layer=None, name: str = ""):
-    """x @ w with an optional low-rank delta."""
+def _apply(x, w, dtype, lora_layer=None, name: str = "", scale=None):
+    """x @ w with an optional low-rank delta.  `scale` (per-OUTPUT-
+    channel, from `quantize_weights_int8`) dequantizes int8 weights on
+    the fly: (x @ q) * scale == x @ (q * scale) exactly, because the
+    scale is constant along the contraction axis — the matmul runs on
+    the int8 payload (upcast to the compute dtype) and HBM only ever
+    streams 1 byte/weight."""
     out = x @ w.astype(dtype)
+    if scale is not None:
+        out = out * scale.astype(dtype)
     if lora_layer is not None and f"{name}_a" in lora_layer:
         a = lora_layer[f"{name}_a"].astype(dtype)
         b = lora_layer[f"{name}_b"].astype(dtype)
         out = out + ((x @ a) @ b) * lora_layer["__scale__"].astype(dtype)
+    return out
+
+
+def _lm_head(x, params, dtype):
+    """Final projection to vocab logits in f32, int8-aware (sibling
+    `lm_head_scale` leaf => per-vocab-column dequant after the matmul)."""
+    logits = x @ params["lm_head"].astype(dtype)
+    scale = params.get("lm_head_scale")
+    if scale is not None:
+        logits = logits * scale.astype(dtype)
+    return logits.astype(jnp.float32)
+
+
+# weights the serve path quantizes; norms and the embedding lookup stay
+# in their original dtype (tiny, and tok_emb is a gather, not a matmul)
+QUANT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weights_int8(params: Dict) -> Dict:
+    """Symmetric per-output-channel int8 weights for serving.
+
+    Every matmul weight (block projections + lm_head) becomes an int8
+    payload with a sibling `<name>_scale` f32 leaf holding one scale
+    per output channel (`[L, out]` for blocks, `[vocab]` for the
+    head).  The scale axis rides the blocks' layer-scan like any other
+    leaf, so `forward` / `decode_step*` pick it up via
+    `layer.get("<name>_scale")` with zero structural change; `_apply`
+    multiplies it back in after the matmul, which is exact w.r.t.
+    scaling because the scale is constant along the contraction.
+    Quantization error is the int8 rounding of each weight (<= scale/2
+    per element); `tests/test_paged_attention.py` gates greedy argmax
+    agreement + bounded logit error on the tiny model."""
+    from ray_tpu.ops.paged_attention import quantize_int8
+
+    out = {k: v for k, v in params.items()}
+    blocks = dict(out["blocks"])
+    for name in QUANT_TARGETS:
+        q, s = quantize_int8(blocks[name], axis=1)  # [L,in,out] -> [L,out]
+        blocks[name] = q
+        blocks[name + "_scale"] = s
+    out["blocks"] = blocks
+    q, s = quantize_int8(out["lm_head"], axis=0)  # [E,vocab] -> [vocab]
+    out["lm_head"] = q
+    out["lm_head_scale"] = s
     return out
 
 
@@ -229,9 +280,12 @@ def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
 
         def one(xin):
             h = _rms_norm(xin, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
-            q = _apply(h, layer["wq"], cfg.dtype, layer_lora, "wq")
-            k = _apply(h, layer["wk"], cfg.dtype, layer_lora, "wk")
-            v = _apply(h, layer["wv"], cfg.dtype, layer_lora, "wv")
+            q = _apply(h, layer["wq"], cfg.dtype, layer_lora, "wq",
+                       layer.get("wq_scale"))
+            k = _apply(h, layer["wk"], cfg.dtype, layer_lora, "wk",
+                       layer.get("wk_scale"))
+            v = _apply(h, layer["wv"], cfg.dtype, layer_lora, "wv",
+                       layer.get("wv_scale"))
             q = _rope(q.reshape(B, T, H, hd), cfg.rope_theta)
             k_kv = _rope(k.reshape(B, T, KV, hd), cfg.rope_theta)
             v_kv = v.reshape(B, T, KV, hd)
@@ -241,14 +295,17 @@ def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
                 v = jnp.repeat(v, group, axis=2)
             o = select_attention(cfg.attention, q, k, v, mesh, causal=True)
             o = o.reshape(B, T, H * hd)
-            x1 = xin + _apply(o, layer["wo"], cfg.dtype, layer_lora, "wo")
+            x1 = xin + _apply(o, layer["wo"], cfg.dtype, layer_lora, "wo",
+                              layer.get("wo_scale"))
 
             h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
-            gate = _apply(h2, layer["w_gate"], cfg.dtype, layer_lora, "w_gate")
-            up = _apply(h2, layer["w_up"], cfg.dtype, layer_lora, "w_up")
+            gate = _apply(h2, layer["w_gate"], cfg.dtype, layer_lora,
+                          "w_gate", layer.get("w_gate_scale"))
+            up = _apply(h2, layer["w_up"], cfg.dtype, layer_lora, "w_up",
+                        layer.get("w_up_scale"))
             down = _apply(
                 jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
-                layer_lora, "w_down",
+                layer_lora, "w_down", layer.get("w_down_scale"),
             )
             return x1 + down, k_kv, v_kv
 
@@ -262,7 +319,7 @@ def forward(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
     x = x.astype(cfg.dtype)
     x, kv = lax.scan(body, x, scan_tree)
     x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = _lm_head(x, params, cfg.dtype)
     if return_kv:
         return logits, kv
     return logits
@@ -379,9 +436,9 @@ def forward_with_prefix(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
     def body(x, inputs):
         layer, pk_l, pv_l = inputs  # pk_l/pv_l [B, Pmax, KV, hd]
         h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
-        q = _apply(h, layer["wq"], cfg.dtype)
-        k = _apply(h, layer["wk"], cfg.dtype)
-        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _apply(h, layer["wq"], cfg.dtype, scale=layer.get("wq_scale"))
+        k = _apply(h, layer["wk"], cfg.dtype, scale=layer.get("wk_scale"))
+        v = _apply(h, layer["wv"], cfg.dtype, scale=layer.get("wv_scale"))
         q = _rope(q.reshape(B, S, H, hd), cfg.rope_theta, t0=prefix_len)
         k_suf = _rope(k.reshape(B, S, KV, hd), cfg.rope_theta, t0=prefix_len)
         v_suf = v.reshape(B, S, KV, hd)
@@ -395,18 +452,22 @@ def forward_with_prefix(cfg: LlamaConfig, params: Dict, tokens: jax.Array,
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
         o = o.reshape(B, S, H * hd)
-        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype,
+                        scale=layer.get("wo_scale"))
 
         h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
-        gate = _apply(h2, layer["w_gate"], cfg.dtype)
-        up = _apply(h2, layer["w_up"], cfg.dtype)
-        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype,
+                      scale=layer.get("w_gate_scale"))
+        up = _apply(h2, layer["w_up"], cfg.dtype,
+                    scale=layer.get("w_up_scale"))
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
+                      scale=layer.get("w_down_scale"))
         return x1 + down, (k_suf, v_suf)
 
     x = x.astype(cfg.dtype)
     x, kv = lax.scan(body, x, (dict(params["blocks"]), pk, pv))
     x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = _lm_head(x, params, cfg.dtype)
     return logits, kv
 
 
@@ -458,9 +519,9 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
     def body(x, inputs):
         layer, kc, vc = inputs  # kc/vc [B, M, KV, hd]
         h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
-        q = _apply(h, layer["wq"], cfg.dtype)
-        k = _apply(h, layer["wk"], cfg.dtype)
-        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _apply(h, layer["wq"], cfg.dtype, scale=layer.get("wq_scale"))
+        k = _apply(h, layer["wk"], cfg.dtype, scale=layer.get("wk_scale"))
+        v = _apply(h, layer["wv"], cfg.dtype, scale=layer.get("wv_scale"))
         q = _rope(q.reshape(B, 1, H, hd), cfg.rope_theta, t0=pos)
         k_new = _rope(k.reshape(B, 1, KV, hd), cfg.rope_theta, t0=pos)
         v_new = v.reshape(B, 1, KV, hd)
@@ -488,12 +549,16 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
             preferred_element_type=jnp.float32,
         )
         o = o.astype(cfg.dtype).reshape(B, 1, H * hd)
-        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype,
+                        scale=layer.get("wo_scale"))
 
         h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
-        gate = _apply(h2, layer["w_gate"], cfg.dtype)
-        up = _apply(h2, layer["w_up"], cfg.dtype)
-        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype,
+                      scale=layer.get("w_gate_scale"))
+        up = _apply(h2, layer["w_up"], cfg.dtype,
+                    scale=layer.get("w_up_scale"))
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
+                      scale=layer.get("w_down_scale"))
         return x1 + down, (kc, vc)
 
     x = x.astype(cfg.dtype)
@@ -501,8 +566,8 @@ def decode_step(cfg: LlamaConfig, params: Dict, token: jax.Array,
         body, x, (dict(params["blocks"]), k_cache, v_cache)
     )
     x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
-    logits = (x[:, 0, :] @ params["lm_head"].astype(cfg.dtype))
-    return logits.astype(jnp.float32), (k_cache, v_cache)
+    logits = _lm_head(x[:, 0, :], params, cfg.dtype)
+    return logits, (k_cache, v_cache)
 
 
 def _rope_at(x, theta: float, pos_b):
@@ -554,9 +619,9 @@ def decode_step_vec(cfg: LlamaConfig, params: Dict, token: jax.Array,
     def body(x, inputs):
         layer, kc, vc = inputs  # kc/vc [B, M, KV, hd]
         h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
-        q = _apply(h, layer["wq"], cfg.dtype)
-        k = _apply(h, layer["wk"], cfg.dtype)
-        v = _apply(h, layer["wv"], cfg.dtype)
+        q = _apply(h, layer["wq"], cfg.dtype, scale=layer.get("wq_scale"))
+        k = _apply(h, layer["wk"], cfg.dtype, scale=layer.get("wk_scale"))
+        v = _apply(h, layer["wv"], cfg.dtype, scale=layer.get("wv_scale"))
         q = _rope_at(q.reshape(B, 1, H, hd), cfg.rope_theta, pos)
         k_new = _rope_at(k.reshape(B, 1, KV, hd), cfg.rope_theta, pos)
         v_new = v.reshape(B, 1, KV, hd)
@@ -577,12 +642,16 @@ def decode_step_vec(cfg: LlamaConfig, params: Dict, token: jax.Array,
             preferred_element_type=jnp.float32,
         )
         o = o.astype(cfg.dtype).reshape(B, 1, H * hd)
-        x1 = x + _apply(o, layer["wo"], cfg.dtype)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype,
+                        scale=layer.get("wo_scale"))
 
         h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
-        gate = _apply(h2, layer["w_gate"], cfg.dtype)
-        up = _apply(h2, layer["w_up"], cfg.dtype)
-        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype,
+                      scale=layer.get("w_gate_scale"))
+        up = _apply(h2, layer["w_up"], cfg.dtype,
+                    scale=layer.get("w_up_scale"))
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
+                      scale=layer.get("w_down_scale"))
         return x1 + down, (kc, vc)
 
     x = x.astype(cfg.dtype)
@@ -590,8 +659,101 @@ def decode_step_vec(cfg: LlamaConfig, params: Dict, token: jax.Array,
         body, x, (dict(params["blocks"]), k_cache, v_cache)
     )
     x = _rms_norm(x, params["final_norm"].astype(cfg.dtype), cfg.norm_eps)
-    logits = (x[:, 0, :] @ params["lm_head"].astype(cfg.dtype))
-    return logits.astype(jnp.float32), (k_cache, v_cache)
+    logits = _lm_head(x[:, 0, :], params, cfg.dtype)
+    return logits, (k_cache, v_cache)
+
+
+def decode_step_paged(cfg: LlamaConfig, params: Dict, token: jax.Array,
+                      k_pool, v_pool, tables, pos, *, kv_scales=None,
+                      interpret: Optional[bool] = None):
+    """One decode step with PER-ROW positions straight off the paged
+    KV pool — `decode_step_vec` with the dense gather/scatter replaced
+    by the Pallas kernels in `ops/paged_attention.py`.
+
+    token [B] int32; k_pool/v_pool [L, num_blocks, block_size, KV, hd]
+    (the `BlockPool` tensors, passed WHOLE — the layer index rides the
+    kernels as a scalar-prefetch arg, so the scan never slices the
+    pool); tables [B, W] int32 block tables (scratch-block padded);
+    pos [B] int32 per-row positions.  Per layer: `paged_kv_append`
+    writes the new KV row in place, then `paged_decode_attention`
+    walks each row's blocks with an online softmax.  Returns
+    (logits [B, vocab] f32, k_pool, v_pool) — plus the updated
+    (k_scale, v_scale) sidecar when `kv_scales` is given (int8 pools).
+
+    Numerics mirror `decode_step_vec` (write-then-attend, f32 score
+    accumulation, -1e30 mask, f32 softmax, weights cast to cfg.dtype
+    for the value matmul); the reduction is blockwise-online, so
+    logits agree to float rounding and greedy argmax is preserved
+    (`tests/test_paged_attention.py` pins both).  Int8 weights ride
+    the same `<name>_scale` leaves as the other decode paths."""
+    from ray_tpu.ops import paged_attention as _pa
+
+    B = token.shape[0]
+    L = k_pool.shape[0]
+    hd, H, KV = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    quant = kv_scales is not None
+
+    x = params["tok_emb"].astype(cfg.dtype)[token][:, None, :]  # [B,1,d]
+
+    def body(carry, inputs):
+        if quant:
+            x, kp, vp, ks, vs = carry
+        else:
+            x, kp, vp = carry
+        li, layer = inputs
+        h = _rms_norm(x, layer["attn_norm"].astype(cfg.dtype), cfg.norm_eps)
+        q = _apply(h, layer["wq"], cfg.dtype, scale=layer.get("wq_scale"))
+        k = _apply(h, layer["wk"], cfg.dtype, scale=layer.get("wk_scale"))
+        v = _apply(h, layer["wv"], cfg.dtype, scale=layer.get("wv_scale"))
+        q = _rope_at(q.reshape(B, 1, H, hd), cfg.rope_theta, pos)
+        k_new = _rope_at(k.reshape(B, 1, KV, hd), cfg.rope_theta, pos)
+        v_new = v.reshape(B, 1, KV, hd)
+        if quant:
+            kq, ks_new = _pa.quantize_int8(k_new[:, 0])
+            vq, vs_new = _pa.quantize_int8(v_new[:, 0])
+            kp, vp, ks, vs = _pa.paged_kv_append(
+                kp, vp, kq, vq, tables, pos, li,
+                k_scale=ks, v_scale=vs, k_new_scale=ks_new,
+                v_new_scale=vs_new, interpret=interpret,
+            )
+            o = _pa.paged_decode_attention(
+                q[:, 0], kp, vp, tables, pos, li,
+                k_scale=ks, v_scale=vs, interpret=interpret,
+            )
+        else:
+            kp, vp = _pa.paged_kv_append(
+                kp, vp, k_new[:, 0].astype(kp.dtype),
+                v_new[:, 0].astype(vp.dtype), tables, pos, li,
+                interpret=interpret,
+            )
+            o = _pa.paged_decode_attention(
+                q[:, 0], kp, vp, tables, pos, li, interpret=interpret,
+            )
+        o = o.astype(cfg.dtype).reshape(B, 1, H * hd)
+        x1 = x + _apply(o, layer["wo"], cfg.dtype,
+                        scale=layer.get("wo_scale"))
+
+        h2 = _rms_norm(x1, layer["mlp_norm"].astype(cfg.dtype), cfg.norm_eps)
+        gate = _apply(h2, layer["w_gate"], cfg.dtype,
+                      scale=layer.get("w_gate_scale"))
+        up = _apply(h2, layer["w_up"], cfg.dtype,
+                    scale=layer.get("w_up_scale"))
+        down = _apply(jax.nn.silu(gate) * up, layer["w_down"], cfg.dtype,
+                      scale=layer.get("w_down_scale"))
+        if quant:
+            return (x1 + down, kp, vp, ks, vs), None
+        return (x1 + down, kp, vp), None
+
+    if quant:
+        carry0 = (x.astype(cfg.dtype), k_pool, v_pool) + tuple(kv_scales)
+    else:
+        carry0 = (x.astype(cfg.dtype), k_pool, v_pool)
+    xs = (jnp.arange(L, dtype=jnp.int32), dict(params["blocks"]))
+    carry, _ = lax.scan(body, carry0, xs)
+    x = _rms_norm(carry[0], params["final_norm"].astype(cfg.dtype),
+                  cfg.norm_eps)
+    logits = _lm_head(x[:, 0, :], params, cfg.dtype)
+    return (logits,) + tuple(carry[1:])
 
 
 _DECODE_JIT_CACHE: Dict = {}

@@ -81,6 +81,85 @@ class DeploymentConfig:
 
 
 @dataclass
+class LLMEngineConfig:
+    """Knobs for the continuous-batching LLM engine
+    (`serve/llm_engine.py`), validated once and expanded into
+    `LlamaEngine(**engine_kwargs())` by the serving wrappers
+    (`examples/serve_llm.py` ContinuousLlamaService).
+
+    The decode/quantization plane:
+    - `decode_kernel`: "auto" (fused Pallas paged-attention kernel on
+      TPU, compiled gather+`decode_step_vec` elsewhere), "pallas"
+      (force the kernel; interpret mode off-TPU), or "gather" (force
+      the reference route).
+    - `kv_dtype`: "model" stores KV in the compute dtype; "int8"
+      stores per-row-scaled int8 (half the pool HBM, f32 scale
+      sidecar, dequant fused in the kernel / applied on gather).
+    - `weight_dtype`: "model" serves the params as given; "int8"
+      applies `llama.quantize_weights_int8` at replica init
+      (per-output-channel scales, matmuls dequant on the fly).
+    """
+
+    slots: int = 32
+    chunk: int = 8
+    max_len: Optional[int] = None
+    block_size: int = 16
+    kv_blocks: Optional[int] = None
+    prefix_cache: bool = True
+    max_queued: Optional[int] = None
+    decode_kernel: str = "auto"
+    kv_dtype: str = "model"
+    weight_dtype: str = "model"
+    chunk_cache_cap: int = 8
+
+    def validate(self) -> "LLMEngineConfig":
+        if self.decode_kernel not in ("auto", "pallas", "gather"):
+            raise ValueError(
+                f"decode_kernel={self.decode_kernel!r} not in "
+                "('auto', 'pallas', 'gather')"
+            )
+        if self.kv_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} not in ('model', 'int8')"
+            )
+        if self.weight_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"weight_dtype={self.weight_dtype!r} not in "
+                "('model', 'int8')"
+            )
+        if self.slots < 1:
+            raise ValueError(f"slots={self.slots} must be >= 1")
+        if self.chunk < 1:
+            raise ValueError(f"chunk={self.chunk} must be >= 1")
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size={self.block_size} must be >= 1"
+            )
+        if self.chunk_cache_cap < 1:
+            raise ValueError(
+                f"chunk_cache_cap={self.chunk_cache_cap} must be >= 1"
+            )
+        return self
+
+    def engine_kwargs(self) -> Dict[str, Any]:
+        """Kwargs for `LlamaEngine(...)` — everything except
+        `weight_dtype`, which the serving wrapper applies to the params
+        BEFORE constructing the engine."""
+        return {
+            "slots": self.slots,
+            "chunk": self.chunk,
+            "max_len": self.max_len,
+            "block_size": self.block_size,
+            "kv_blocks": self.kv_blocks,
+            "prefix_cache": self.prefix_cache,
+            "max_queued": self.max_queued,
+            "decode_kernel": self.decode_kernel,
+            "kv_dtype": self.kv_dtype,
+            "chunk_cache_cap": self.chunk_cache_cap,
+        }
+
+
+@dataclass
 class ReplicaConfig:
     """What it takes to construct one replica: the callable plus its init
     args and per-replica resources (reference: `serve/config.py`

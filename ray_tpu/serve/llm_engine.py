@@ -19,6 +19,18 @@ TPU-native design points:
   count — per-step attention cost tracks LIVE tokens, not the pool
   budget, killing the measured "ring size is a per-step tax" cost
   (PERF.md round 5: a 1024-ring ran ~20x slower than a 192-ring).
+- FUSED DECODE KERNEL (`decode_kernel="pallas"`, auto on TPU): the
+  gather/scatter copies die entirely — `llama.decode_step_paged`
+  reads and writes the pool IN PLACE through the block tables via the
+  Pallas kernels in `ops/paged_attention.py` (tables in SMEM, split-KV
+  walk with an online softmax, `input_output_aliases` for the append).
+  The gather route above remains the reference/fallback; both produce
+  the same greedy tokens (`tests/test_paged_attention.py`).  With
+  `kv_dtype="int8"` the pool stores per-row-scaled int8 K/V (half the
+  HBM — double the resident batch at a fixed budget) and the kernel
+  fuses the dequant; the gather fallback dequants the gathered view
+  and requantizes ONLY the rows each chunk wrote, so stored KV never
+  drifts through repeated round trips.
 - RADIX PREFIX CACHE: prompt prefixes are cached in a block-granular
   token trie (`serve/kv_cache.py`).  A request whose prompt prefix is
   cached pins those blocks (zero-copy sharing — its block table simply
@@ -44,7 +56,7 @@ import logging
 import os
 import threading
 import time as _time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional
 
@@ -87,7 +99,9 @@ class LlamaEngine:
                  max_len: Optional[int] = None, chunk: int = 8,
                  block_size: int = 16, kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 max_queued: Optional[int] = None):
+                 max_queued: Optional[int] = None,
+                 decode_kernel: str = "auto", kv_dtype: str = "model",
+                 chunk_cache_cap: int = 8):
         import jax
         import jax.numpy as jnp
 
@@ -110,7 +124,34 @@ class LlamaEngine:
                 f"kv_blocks={budget} cannot hold one max_len sequence "
                 f"({self._max_seq_blocks} blocks of {self.block_size})"
             )
-        self._pool = BlockPool(budget + 1)  # +1: reserved scratch block
+        # +1: reserved scratch block.  kv_dtype is validated (and
+        # carried) by the pool: "int8" halves pool HBM and adds the f32
+        # scale sidecar the paged kernels dequant from.
+        self._pool = BlockPool(budget + 1, kv_dtype=kv_dtype)
+        self._kv_int8 = self._pool.kv_dtype == "int8"
+        if decode_kernel not in ("auto", "pallas", "gather"):
+            raise ValueError(
+                f"decode_kernel={decode_kernel!r} not in "
+                "('auto', 'pallas', 'gather')"
+            )
+        mode = decode_kernel
+        if mode == "auto":
+            # the fused kernel exists for TPU HBM bandwidth; on CPU the
+            # interpret-mode path is a correctness vehicle, not a win —
+            # auto keeps CPU deployments on the compiled gather route
+            mode = "pallas" if jax.default_backend() == "tpu" else "gather"
+        if mode == "pallas":
+            from ray_tpu.testing import pallas_kernel_support
+
+            ok, why = pallas_kernel_support("paged")
+            if not ok:
+                logger.warning(
+                    "decode_kernel=pallas unavailable (%s); falling "
+                    "back to the gather+decode_step_vec route", why,
+                )
+                mode = "gather"
+        self._decode_kernel = mode  # resolved: "pallas" | "gather"
+        self._paged_interpret = jax.default_backend() != "tpu"
         if prefix_cache and getattr(cfg, "attention", "dense") != "dense":
             # the suffix prefill (`llama.forward_with_prefix`) mirrors
             # the DENSE attention numerics; under flash/ring/ulysses
@@ -129,15 +170,33 @@ class LlamaEngine:
         )
 
         L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        pool_dtype = jnp.int8 if self._kv_int8 else cfg.dtype
         self._k_pool = jnp.zeros(
-            (L, self._pool.num_blocks, self.block_size, KV, hd), cfg.dtype
+            (L, self._pool.num_blocks, self.block_size, KV, hd), pool_dtype
         )
         self._v_pool = jnp.zeros_like(self._k_pool)
+        # int8 scale sidecar: one f32 scale per (layer, row, kv-head),
+        # written by the same paths that write KV rows
+        self._k_scale = self._v_scale = None
+        if self._kv_int8:
+            self._k_scale = jnp.zeros(
+                (L, self._pool.num_blocks, self.block_size, KV),
+                jnp.float32,
+            )
+            self._v_scale = jnp.zeros_like(self._k_scale)
         self._pos = jnp.zeros((slots,), jnp.int32)
         self._tok = jnp.zeros((slots,), jnp.int32)
 
-        # compiled-program families (each keyed by a static shape)
-        self._chunk_cache: Dict[int, object] = {}          # gather width W
+        # compiled-program families (each keyed by a static shape).
+        # The chunk family is LRU-BOUNDED: each entry retains a
+        # compiled executable (host + device memory) per gather width,
+        # and a long-lived replica sweeping many widths would otherwise
+        # grow it without bound (same rationale as _DECODE_JIT_CACHE)
+        self._chunk_cache: "OrderedDict[int, object]" = OrderedDict()
+        self._chunk_cache_cap = max(1, int(chunk_cache_cap))
+        self._chunk_cache_evictions = 0
+        self._decode_kernel_dispatches = 0   # fused-kernel chunk ticks
+        self._decode_fallback_dispatches = 0  # gather-route chunk ticks
         self._prefill_cache: Dict[int, object] = {}        # prompt bucket
         self._suffix_cache: Dict[tuple, object] = {}       # (S_bucket, P_blocks)
         self._write_cache: Dict[tuple, object] = {}        # (T_in, nb)
@@ -193,7 +252,7 @@ class LlamaEngine:
         # BEFORE the thread starts: the first admission's compile is
         # exactly the window the fallback exists for, and an empty
         # dict there would blind queue-depth routing during startup
-        self._stats_snapshot: Dict[str, float] = self._stats_locked()
+        self._stats_snapshot: Dict[str, object] = self._stats_locked()
 
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine", daemon=True
@@ -282,8 +341,9 @@ class LlamaEngine:
             self._wake.notify()
         return fut
 
-    def stats(self) -> Dict[str, float]:
-        """Engine load/health signals: consumed by the serve replica's
+    def stats(self) -> Dict[str, object]:
+        """Engine load/health signals (floats plus the `decode_kernel`
+        / `kv_dtype` mode strings): consumed by the serve replica's
         metrics piggyback (queue-depth routing + the dashboard's
         /api/serve) and by the tick-trace benchmark.
 
@@ -304,7 +364,7 @@ class LlamaEngine:
             self._lock.release()
         return dict(snap)
 
-    def _stats_locked(self) -> Dict[str, float]:
+    def _stats_locked(self) -> Dict[str, object]:
         served = self._hit_tokens + self._prefill_tokens
         cached = self._radix.cached_blocks if self._radix else 0
         return {
@@ -329,6 +389,24 @@ class LlamaEngine:
                 ),
                 "prefill_calls": self._prefill_calls,
                 "gather_blocks": self._last_gather_blocks,
+                # decode-kernel / quantization plane: which route the
+                # chunk dispatches take and what the pool costs in HBM
+                # (payload and int8 scale sidecar reported separately,
+                # so the ½-bytes-at-equal-blocks claim stays auditable)
+                "decode_kernel": self._decode_kernel,
+                "kv_dtype": self._pool.kv_dtype,
+                "kv_pool_bytes": (self._k_pool.nbytes
+                                  + self._v_pool.nbytes),
+                "kv_scale_bytes": (
+                    (self._k_scale.nbytes + self._v_scale.nbytes)
+                    if self._kv_int8 else 0
+                ),
+                "decode_kernel_dispatch_total":
+                    self._decode_kernel_dispatches,
+                "decode_fallback_dispatch_total":
+                    self._decode_fallback_dispatches,
+                "chunk_cache_size": len(self._chunk_cache),
+                "chunk_cache_evictions": self._chunk_cache_evictions,
                 "ttft_ema_s": self._ttft_ema_s,
                 "tick_ema_s": self._tick_ema_s,
                 "ticks": self._chunk_seq,
@@ -361,15 +439,149 @@ class LlamaEngine:
 
     # -- compiled-program families ------------------------------------
     def _chunk_step_for(self, W: int):
-        """Chunk stepper over a gathered W-block view: per-step cost is
-        O(W * block_size) per slot — live tokens, not pool budget."""
-        fn = self._chunk_cache.get(W)
-        if fn is None:
-            jax, jnp, llama = self._jax, self._jnp, self._llama
-            cfg, bs, chunk = self.cfg, self.block_size, self.chunk
-            L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-            S = self.slots
+        """Chunk stepper for gather width W, under the `decode_kernel`
+        knob:
 
+        - "pallas": the fused paged route — `llama.decode_step_paged`
+          reads/writes the pool IN PLACE through the block tables (the
+          Pallas kernels in `ops/paged_attention.py`); no gather, no
+          scatter, no dense copy.  Per-step HBM traffic is the live KV
+          once, not three times.
+        - "gather": the reference route — gather every slot's blocks
+          into a dense W-block view, run `llama.decode_step_vec`,
+          scatter the blocks back.  Per-step cost is O(W * block_size)
+          per slot — live tokens, not pool budget.
+
+        Entries are LRU-bounded at `chunk_cache_cap` programs; an
+        evicted width recompiles on next use (degradation, not
+        growth)."""
+        fn = self._chunk_cache.get(W)
+        if fn is not None:
+            self._chunk_cache.move_to_end(W)
+            return fn
+        jax, jnp, llama = self._jax, self._jnp, self._llama
+        cfg, bs, chunk = self.cfg, self.block_size, self.chunk
+        L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        S = self.slots
+
+        if self._decode_kernel == "pallas":
+            interp = self._paged_interpret
+            if self._kv_int8:
+                def _fn(params, k_pool, v_pool, k_scale, v_scale,
+                        tables, tok, pos):
+                    def body(carry, _):
+                        tok, kp, vp, ks, vs, pos = carry
+                        logits, kp, vp, ks, vs = llama.decode_step_paged(
+                            cfg, params, tok, kp, vp, tables, pos,
+                            kv_scales=(ks, vs), interpret=interp,
+                        )
+                        nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        pos2 = jnp.minimum(pos + 1, self.max_len - 1)
+                        return (nt, kp, vp, ks, vs, pos2), nt
+
+                    tok_in = tok
+                    (tok, k_pool, v_pool, k_scale, v_scale, pos), toks = \
+                        jax.lax.scan(
+                            body,
+                            (tok, k_pool, v_pool, k_scale, v_scale, pos),
+                            None, length=chunk,
+                        )
+                    return (k_pool, v_pool, k_scale, v_scale, tok, pos,
+                            jnp.concatenate([tok_in[None], toks], axis=0))
+
+                fn = jax.jit(_fn, donate_argnums=(1, 2, 3, 4))
+            else:
+                def _fn(params, k_pool, v_pool, tables, tok, pos):
+                    def body(carry, _):
+                        tok, kp, vp, pos = carry
+                        logits, kp, vp = llama.decode_step_paged(
+                            cfg, params, tok, kp, vp, tables, pos,
+                            interpret=interp,
+                        )
+                        nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        # clamp: idle/finished slots must never walk
+                        # their position past the sequence cap
+                        pos2 = jnp.minimum(pos + 1, self.max_len - 1)
+                        return (nt, kp, vp, pos2), nt
+
+                    tok_in = tok  # pre-chunk tokens (see gather route)
+                    (tok, k_pool, v_pool, pos), toks = jax.lax.scan(
+                        body, (tok, k_pool, v_pool, pos), None,
+                        length=chunk,
+                    )
+                    return k_pool, v_pool, tok, pos, jnp.concatenate(
+                        [tok_in[None], toks], axis=0
+                    )
+
+                fn = jax.jit(_fn, donate_argnums=(1, 2))
+        elif self._kv_int8:
+            from ray_tpu.ops import paged_attention as _pa
+
+            def _fn(params, k_pool, v_pool, k_scale, v_scale, tables,
+                    tok, pos):
+                # gather payload + scales, dequant to the compute dtype
+                kq = jnp.take(k_pool, tables, axis=1).reshape(
+                    L, S, W * bs, KV, hd
+                )
+                vq = jnp.take(v_pool, tables, axis=1).reshape(
+                    L, S, W * bs, KV, hd
+                )
+                ks = jnp.take(k_scale, tables, axis=1).reshape(
+                    L, S, W * bs, KV
+                )
+                vs = jnp.take(v_scale, tables, axis=1).reshape(
+                    L, S, W * bs, KV
+                )
+                k = _pa.dequantize_int8(kq, ks, cfg.dtype)
+                v = _pa.dequantize_int8(vq, vs, cfg.dtype)
+                pos0 = pos
+
+                def body(carry, _):
+                    tok, kv, pos = carry[0], (carry[1], carry[2]), carry[3]
+                    logits, (k2, v2) = llama.decode_step_vec(
+                        cfg, params, tok, kv, pos
+                    )
+                    nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    pos2 = jnp.minimum(pos + 1, self.max_len - 1)
+                    return (nt, k2, v2, pos2), nt
+
+                tok_in = tok
+                (tok, k, v, pos), toks = jax.lax.scan(
+                    body, (tok, k, v, pos), None, length=chunk
+                )
+                # requantize ONLY the rows this chunk wrote; untouched
+                # rows keep their stored payload+scale bit-exactly, so
+                # repeated gather/scatter cycles cannot drift the cache
+                # (a full-view requant would re-round every row through
+                # the compute dtype each chunk)
+                idx = jnp.arange(W * bs)[None, :]
+                touched = ((idx >= pos0[:, None])
+                           & (idx < pos0[:, None] + chunk))  # [S, M]
+                kq2, ks2 = _pa.quantize_int8(k)
+                vq2, vs2 = _pa.quantize_int8(v)
+                t_p = touched[None, :, :, None, None]
+                t_s = touched[None, :, :, None]
+                kq2 = jnp.where(t_p, kq2, kq)
+                vq2 = jnp.where(t_p, vq2, vq)
+                ks2 = jnp.where(t_s, ks2, ks)
+                vs2 = jnp.where(t_s, vs2, vs)
+                k_pool = k_pool.at[:, tables].set(
+                    kq2.reshape(L, S, W, bs, KV, hd)
+                )
+                v_pool = v_pool.at[:, tables].set(
+                    vq2.reshape(L, S, W, bs, KV, hd)
+                )
+                k_scale = k_scale.at[:, tables].set(
+                    ks2.reshape(L, S, W, bs, KV)
+                )
+                v_scale = v_scale.at[:, tables].set(
+                    vs2.reshape(L, S, W, bs, KV)
+                )
+                return (k_pool, v_pool, k_scale, v_scale, tok, pos,
+                        jnp.concatenate([tok_in[None], toks], axis=0))
+
+            fn = jax.jit(_fn, donate_argnums=(1, 2, 3, 4))
+        else:
             def _fn(params, k_pool, v_pool, tables, tok, pos):
                 # tables [slots, W] -> dense [L, slots, W*bs, KV, hd]
                 k = jnp.take(k_pool, tables, axis=1).reshape(
@@ -410,9 +622,16 @@ class LlamaEngine:
                     [tok_in[None], toks], axis=0
                 )
 
-            fn = self._chunk_cache[W] = jax.jit(
-                _fn, donate_argnums=(1, 2)
+            fn = jax.jit(_fn, donate_argnums=(1, 2))
+
+        while len(self._chunk_cache) >= self._chunk_cache_cap:
+            old_w, _old = self._chunk_cache.popitem(last=False)
+            self._chunk_cache_evictions += 1
+            logger.info(
+                "chunk-program cache evicted W=%d (cap=%d, evictions=%d)",
+                old_w, self._chunk_cache_cap, self._chunk_cache_evictions,
             )
+        self._chunk_cache[W] = fn
         return fn
 
     def _prefill_for(self, bucket: int):
@@ -446,17 +665,36 @@ class LlamaEngine:
             cfg, bs = self.cfg, self.block_size
             L, KV, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
 
-            def _pf(params, k_pool, v_pool, suffix, blk_ids, prefix_len):
-                pk = jnp.take(k_pool, blk_ids, axis=1).reshape(
-                    L, 1, p_blocks * bs, KV, hd
-                )
-                pv = jnp.take(v_pool, blk_ids, axis=1).reshape(
-                    L, 1, p_blocks * bs, KV, hd
-                )
-                logits, (ks, vs) = llama.forward_with_prefix(
-                    cfg, params, suffix, (pk, pv), prefix_len
-                )
-                return logits[0], ks, vs
+            if self._kv_int8:
+                from ray_tpu.ops import paged_attention as _pa
+
+                def _pf(params, k_pool, v_pool, k_scale, v_scale,
+                        suffix, blk_ids, prefix_len):
+                    pk = _pa.dequantize_int8(
+                        jnp.take(k_pool, blk_ids, axis=1),
+                        jnp.take(k_scale, blk_ids, axis=1), cfg.dtype,
+                    ).reshape(L, 1, p_blocks * bs, KV, hd)
+                    pv = _pa.dequantize_int8(
+                        jnp.take(v_pool, blk_ids, axis=1),
+                        jnp.take(v_scale, blk_ids, axis=1), cfg.dtype,
+                    ).reshape(L, 1, p_blocks * bs, KV, hd)
+                    logits, (ks, vs) = llama.forward_with_prefix(
+                        cfg, params, suffix, (pk, pv), prefix_len
+                    )
+                    return logits[0], ks, vs
+            else:
+                def _pf(params, k_pool, v_pool, suffix, blk_ids,
+                        prefix_len):
+                    pk = jnp.take(k_pool, blk_ids, axis=1).reshape(
+                        L, 1, p_blocks * bs, KV, hd
+                    )
+                    pv = jnp.take(v_pool, blk_ids, axis=1).reshape(
+                        L, 1, p_blocks * bs, KV, hd
+                    )
+                    logits, (ks, vs) = llama.forward_with_prefix(
+                        cfg, params, suffix, (pk, pv), prefix_len
+                    )
+                    return logits[0], ks, vs
 
             fn = self._suffix_cache[key] = jax.jit(_pf)
         return fn
@@ -476,28 +714,62 @@ class LlamaEngine:
                          self.cfg.head_dim)
             target = nb * bs
 
-            def _fn(k_pool, v_pool, k1, v1, blk_ids, slot, pos0, tok0,
-                    pos, tok):
+            def _clip(k1, v1):
                 # k1/v1 [L, 1, t_in, KV, hd] -> exactly nb blocks
                 if t_in < target:
                     pad = [(0, 0), (0, 0), (0, target - t_in), (0, 0),
                            (0, 0)]
-                    k1 = jnp.pad(k1, pad)
-                    v1 = jnp.pad(v1, pad)
-                elif t_in > target:
-                    k1 = k1[:, :, :target]
-                    v1 = v1[:, :, :target]
-                kb = k1.astype(k_pool.dtype).reshape(L, nb, bs, KV, hd)
-                vb = v1.astype(v_pool.dtype).reshape(L, nb, bs, KV, hd)
-                k_pool = k_pool.at[:, blk_ids].set(kb)
-                v_pool = v_pool.at[:, blk_ids].set(vb)
-                pos = pos.at[slot].set(pos0)
-                tok = tok.at[slot].set(tok0)
-                return k_pool, v_pool, pos, tok
+                    return jnp.pad(k1, pad), jnp.pad(v1, pad)
+                if t_in > target:
+                    return k1[:, :, :target], v1[:, :, :target]
+                return k1, v1
 
-            fn = self._write_cache[key] = jax.jit(
-                _fn, donate_argnums=(0, 1)
-            )
+            if self._kv_int8:
+                from ray_tpu.ops import paged_attention as _pa
+
+                def _fn(k_pool, v_pool, k_scale, v_scale, k1, v1,
+                        blk_ids, slot, pos0, tok0, pos, tok):
+                    k1, v1 = _clip(k1, v1)
+                    kq, ksc = _pa.quantize_int8(k1)  # [L,1,target,KV]
+                    vq, vsc = _pa.quantize_int8(v1)
+                    k_pool = k_pool.at[:, blk_ids].set(
+                        kq.reshape(L, nb, bs, KV, hd)
+                    )
+                    v_pool = v_pool.at[:, blk_ids].set(
+                        vq.reshape(L, nb, bs, KV, hd)
+                    )
+                    k_scale = k_scale.at[:, blk_ids].set(
+                        ksc.reshape(L, nb, bs, KV)
+                    )
+                    v_scale = v_scale.at[:, blk_ids].set(
+                        vsc.reshape(L, nb, bs, KV)
+                    )
+                    pos = pos.at[slot].set(pos0)
+                    tok = tok.at[slot].set(tok0)
+                    return k_pool, v_pool, k_scale, v_scale, pos, tok
+
+                fn = self._write_cache[key] = jax.jit(
+                    _fn, donate_argnums=(0, 1, 2, 3)
+                )
+            else:
+                def _fn(k_pool, v_pool, k1, v1, blk_ids, slot, pos0,
+                        tok0, pos, tok):
+                    k1, v1 = _clip(k1, v1)
+                    kb = k1.astype(k_pool.dtype).reshape(
+                        L, nb, bs, KV, hd
+                    )
+                    vb = v1.astype(v_pool.dtype).reshape(
+                        L, nb, bs, KV, hd
+                    )
+                    k_pool = k_pool.at[:, blk_ids].set(kb)
+                    v_pool = v_pool.at[:, blk_ids].set(vb)
+                    pos = pos.at[slot].set(pos0)
+                    tok = tok.at[slot].set(tok0)
+                    return k_pool, v_pool, pos, tok
+
+                fn = self._write_cache[key] = jax.jit(
+                    _fn, donate_argnums=(0, 1)
+                )
         return fn
 
     # -- admission -----------------------------------------------------
@@ -580,10 +852,18 @@ class LlamaEngine:
             suffix = jnp.asarray(
                 [prompt[P:] + [0] * (s_bucket - S)], jnp.int32
             )
-            logits, k1, v1 = self._suffix_prefill_for(s_bucket, p_bucket)(
-                self.params, self._k_pool, self._v_pool, suffix,
-                blk_ids, jnp.asarray(P, jnp.int32),
-            )
+            sfn = self._suffix_prefill_for(s_bucket, p_bucket)
+            if self._kv_int8:
+                logits, k1, v1 = sfn(
+                    self.params, self._k_pool, self._v_pool,
+                    self._k_scale, self._v_scale, suffix, blk_ids,
+                    jnp.asarray(P, jnp.int32),
+                )
+            else:
+                logits, k1, v1 = sfn(
+                    self.params, self._k_pool, self._v_pool, suffix,
+                    blk_ids, jnp.asarray(P, jnp.int32),
+                )
             tok0 = jnp.argmax(logits[S - 1], axis=-1).astype(jnp.int32)
             # suffix KV starts exactly at block boundary P//bs; write
             # only the blocks holding real suffix tokens — bucket-pad
@@ -616,12 +896,22 @@ class LlamaEngine:
             wfn = self._write_blocks_for(bucket, nb_real)
         self._prefill_calls += 1
 
-        self._k_pool, self._v_pool, self._pos, self._tok = wfn(
-            self._k_pool, self._v_pool, k1, v1,
-            jnp.asarray(write_ids, jnp.int32),
-            jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32),
-            tok0, self._pos, self._tok,
-        )
+        if self._kv_int8:
+            (self._k_pool, self._v_pool, self._k_scale, self._v_scale,
+             self._pos, self._tok) = wfn(
+                self._k_pool, self._v_pool, self._k_scale,
+                self._v_scale, k1, v1,
+                jnp.asarray(write_ids, jnp.int32),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32),
+                tok0, self._pos, self._tok,
+            )
+        else:
+            self._k_pool, self._v_pool, self._pos, self._tok = wfn(
+                self._k_pool, self._v_pool, k1, v1,
+                jnp.asarray(write_ids, jnp.int32),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32),
+                tok0, self._pos, self._tok,
+            )
 
         # donate this prompt's full blocks to the radix cache (pinned
         # until completion); blocks the trie adopts stop being
@@ -767,11 +1057,25 @@ class LlamaEngine:
                 toks = None
                 if have_active:
                     self._last_gather_blocks = W
-                    (self._k_pool, self._v_pool, self._tok, self._pos,
-                     toks) = self._chunk_step_for(W)(
-                        self.params, self._k_pool, self._v_pool,
-                        jnp.asarray(tables), self._tok, self._pos,
-                    )
+                    cfn = self._chunk_step_for(W)
+                    if self._kv_int8:
+                        (self._k_pool, self._v_pool, self._k_scale,
+                         self._v_scale, self._tok, self._pos,
+                         toks) = cfn(
+                            self.params, self._k_pool, self._v_pool,
+                            self._k_scale, self._v_scale,
+                            jnp.asarray(tables), self._tok, self._pos,
+                        )
+                    else:
+                        (self._k_pool, self._v_pool, self._tok,
+                         self._pos, toks) = cfn(
+                            self.params, self._k_pool, self._v_pool,
+                            jnp.asarray(tables), self._tok, self._pos,
+                        )
+                    if self._decode_kernel == "pallas":
+                        self._decode_kernel_dispatches += 1
+                    else:
+                        self._decode_fallback_dispatches += 1
                     self._chunk_seq += 1
                     with self._lock:
                         for req in self._active.values():
@@ -832,20 +1136,29 @@ class LlamaEngine:
                     # host bookkeeping restarts from scratch: every
                     # block returns to the pool and the radix cache
                     # empties (its pinned paths died with the requests)
-                    self._pool = BlockPool(self._pool.num_blocks)
+                    self._pool = BlockPool(self._pool.num_blocks,
+                                           kv_dtype=self._pool.kv_dtype)
                     if self._radix is not None:
                         self._radix = RadixCache(
                             self.block_size, self._pool
                         )
                 # the failed tick may have DONATED pool buffers without
-                # ever rebinding them — rebuild the device state or
+                # ever rebinding them — rebuild the device state (int8
+                # scale sidecars included: they are donated too) or
                 # every later dispatch dies on invalid donated buffers
                 self._k_pool = jnp.zeros(
                     (self.cfg.n_layers, self._pool.num_blocks,
                      self.block_size, self.cfg.n_kv_heads,
                      self.cfg.head_dim),
-                    self.cfg.dtype,
+                    jnp.int8 if self._kv_int8 else self.cfg.dtype,
                 )
                 self._v_pool = jnp.zeros_like(self._k_pool)
+                if self._kv_int8:
+                    self._k_scale = jnp.zeros(
+                        (self.cfg.n_layers, self._pool.num_blocks,
+                         self.block_size, self.cfg.n_kv_heads),
+                        jnp.float32,
+                    )
+                    self._v_scale = jnp.zeros_like(self._k_scale)
                 self._pos = jnp.zeros((self.slots,), jnp.int32)
                 self._tok = jnp.zeros((self.slots,), jnp.int32)

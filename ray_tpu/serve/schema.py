@@ -16,7 +16,49 @@ from typing import Any, Dict, List, Optional, Union
 
 from pydantic import BaseModel, ConfigDict, Field, field_validator
 
-from ray_tpu.serve.config import AutoscalingConfig
+from ray_tpu.serve.config import AutoscalingConfig, LLMEngineConfig
+
+
+class LLMEngineSchema(BaseModel):
+    """Declarative knobs for the continuous-batching LLM engine —
+    the validated form of `config.LLMEngineConfig`, accepted in a
+    deployment's `user_config` (ContinuousLlamaService applies it via
+    `engine_config=`) or anywhere a deploy document wants to pin the
+    decode/quantization plane (`decode_kernel`, `kv_dtype`,
+    `weight_dtype`) alongside the batching shape."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    slots: int = Field(default=32, ge=1)
+    chunk: int = Field(default=8, ge=1)
+    max_len: Optional[int] = Field(default=None, ge=2)
+    block_size: int = Field(default=16, ge=1)
+    kv_blocks: Optional[int] = Field(default=None, ge=1)
+    prefix_cache: bool = True
+    max_queued: Optional[int] = Field(default=None, ge=0)
+    decode_kernel: str = "auto"
+    kv_dtype: str = "model"
+    weight_dtype: str = "model"
+    chunk_cache_cap: int = Field(default=8, ge=1)
+
+    @field_validator("decode_kernel")
+    @classmethod
+    def _kernel_valid(cls, v):
+        if v not in ("auto", "pallas", "gather"):
+            raise ValueError(
+                'decode_kernel must be "auto", "pallas" or "gather"'
+            )
+        return v
+
+    @field_validator("kv_dtype", "weight_dtype")
+    @classmethod
+    def _dtype_valid(cls, v):
+        if v not in ("model", "int8"):
+            raise ValueError('dtype knobs must be "model" or "int8"')
+        return v
+
+    def to_config(self) -> LLMEngineConfig:
+        return LLMEngineConfig(**self.model_dump()).validate()
 
 
 class RayActorOptionsSchema(BaseModel):

@@ -164,7 +164,7 @@ def pallas_kernel_support(kind: str = "attention") -> tuple:
     repo's Pallas TPU kernels (interpret mode on CPU)?  Kernel tests
     skip-guard on this instead of failing tier-1 when the environment's
     Pallas API surface is missing or incompatible.  `kind` selects the
-    kernel family actually probed ("attention" | "xent")."""
+    kernel family actually probed ("attention" | "xent" | "paged")."""
     if kind in _pallas_cache:
         return _pallas_cache[kind]
     try:
@@ -185,6 +185,22 @@ def pallas_kernel_support(kind: str = "attention") -> tuple:
             w = jnp.ones((16, 16), jnp.float32) * 0.1
             tg = jnp.zeros((8,), jnp.int32)
             np.asarray(pallas_cross_entropy(x, w, tg, 8, 16))
+        elif kind == "paged":
+            # both paged kernels end-to-end: scalar-prefetch block
+            # tables, aliased in-place append, online-softmax walk
+            from ray_tpu.ops.paged_attention import (
+                paged_decode_attention, paged_kv_append,
+            )
+
+            kp = jnp.zeros((1, 3, 4, 1, 16), jnp.float32)
+            vp = jnp.zeros_like(kp)
+            tables = jnp.asarray([[1, 2]], jnp.int32)
+            pos = jnp.asarray([5], jnp.int32)
+            row = jnp.ones((1, 1, 16), jnp.float32) * 0.1
+            kp, vp = paged_kv_append(kp, vp, row, row, tables, pos, 0)
+            q = jnp.ones((1, 2, 16), jnp.float32) * 0.1
+            out = paged_decode_attention(q, kp, vp, tables, pos, 0)
+            assert np.asarray(out).shape == (1, 2, 16)
         else:
             raise ValueError(f"unknown kernel probe kind: {kind}")
         result = (True, "")

@@ -81,6 +81,39 @@ def test_engine_trace_smoke_rows():
     assert results["prefix_off"]["prefix_hit_tokens"] == 0
 
 
+def test_decode_kernel_rows():
+    """`--config decode_kernel`: the fused paged-attention rows,
+    structurally validated (CPU interpret-mode timings are not speed
+    claims — see PERF.md):
+    - the kernel rows really dispatched the Pallas plane (kernel
+      ticks > 0, zero gather-fallback ticks) and vice versa;
+    - both routes completed the same workload (equal tick counts at
+      equal batch);
+    - the int8 pool sits at exactly half the bf16 payload bytes at
+      the same block budget, scale sidecar priced separately."""
+    import pytest as _pytest
+
+    from ray_tpu.testing import pallas_kernel_support
+
+    ok, why = pallas_kernel_support("paged")
+    if not ok:
+        _pytest.skip(f"paged Pallas kernels unsupported here: {why}")
+    from ray_tpu.scripts.perf import main
+
+    results = main(["--config", "decode_kernel",
+                    "--decode-batches", "4"])
+    pal, gat = results["decode_b4_pallas"], results["decode_b4_gather"]
+    assert pal["decode_kernel"] == "pallas"
+    assert pal["kernel_ticks"] > 0 and pal["fallback_ticks"] == 0
+    assert gat["decode_kernel"] == "gather"
+    assert gat["kernel_ticks"] == 0 and gat["fallback_ticks"] > 0
+    assert pal["tokens_per_sec"] > 0 and gat["tokens_per_sec"] > 0
+    assert pal["ticks"] == gat["ticks"] > 0
+    occ = results["kv_pool_occupancy"]
+    assert occ["int8_payload_ratio"] == 0.5
+    assert occ["kv_scale_bytes_int8"] > 0 == occ["kv_scale_bytes_fp"]
+
+
 def test_elastic_recovery_row():
     """`--elastic-recovery`: the elastic-training MTTR canary —
     structurally validated like the engine-trace rows (measured
