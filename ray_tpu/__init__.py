@@ -43,6 +43,14 @@ def timeline(filename=None, trace_id=None):
 
     return _tl(filename, trace_id=trace_id)
 
+
+def slo_status():
+    """Per-deployment serve SLO burn rates ({app: {deployment: row}});
+    see `ray_tpu.serve.slo`.  Requires a running serve controller."""
+    from ray_tpu.serve.api import slo_status as _slo
+
+    return _slo()
+
 __all__ = [
     "ActorClass",
     "ActorHandle",
@@ -64,6 +72,7 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "slo_status",
     "timeline",
     "wait",
 ]

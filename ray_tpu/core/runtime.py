@@ -2765,18 +2765,31 @@ class Runtime:
             self._stream_next_async(task_id_bytes), timeout=timeout
         )
 
-    async def stream_wait_done(self, tid: bytes):
+    async def stream_wait_done(self, tid: bytes, trace_ctx=None):
         """Await completion of a streaming task (ok or error); used by
         watchers (e.g. serve's router queue-len tracking) that must not
         race the consumer.  Returns the stream's terminal error envelope
         (None on clean completion) — read off the held stream object, so
         a consumer popping the stream can't hide the error from the
-        watcher (the router's breaker classification depends on it)."""
+        watcher (the router's breaker classification depends on it).
+
+        `trace_ctx` is the watched request's trace context: the
+        stream's terminal event is recorded into THAT trace, so a
+        streaming request's lifecycle stays one trace id end to end
+        instead of fragmenting at the watcher."""
         with self._state_lock:
             stream = self._streams.get(tid)
         if stream is None:
             return None
         await stream.done.wait()
+        if trace_ctx is not None:
+            from ray_tpu.util import tracing as _tracing
+
+            if stream.error is not None:
+                _tracing.record_instant("stream_done", trace_ctx,
+                                        error=True)
+            else:
+                _tracing.record_instant("stream_done", trace_ctx)
         return stream.error
 
     async def _stream_next_async(self, tid: bytes):
@@ -3809,10 +3822,26 @@ class Runtime:
         return value becomes a single-item stream."""
         import inspect
 
+        from ray_tpu.util import tracing as _tracing
+
         loop = asyncio.get_running_loop()
         _END = object()
         index = 0
         tid = spec.task_id.binary()
+        # the execution_span that wrapped generator CREATION has already
+        # exited by the time the body runs here — re-install the task's
+        # trace context around iteration so spans opened inside the
+        # generator (engine ticks, nested submits) join the request's
+        # trace instead of fragmenting.  A stream span wraps the whole
+        # drive; its context is what generator frames see.
+        trace_ctx = getattr(spec, "trace_ctx", None)
+        stream_span = None
+        stream_ctx = None
+        if trace_ctx is not None:
+            with _tracing.use_context(trace_ctx):
+                stream_span = _tracing.start_span(f"stream:{spec.name}",
+                                                  kind="CONSUMER")
+            stream_ctx = _tracing.ctx_of(stream_span)
 
         def _abandoned() -> bool:
             cancelled = getattr(self, "_cancelled_streams", None)
@@ -3836,35 +3865,47 @@ class Runtime:
                              "via noded", e)
                 self.noded.send("task_stream", payload)
 
-        if inspect.isasyncgen(value):
-            async for item in value:
-                await _send(item)
-                if _abandoned():
-                    await value.aclose()  # user generator's finally runs
-                    break
-        elif inspect.isgenerator(value):
+        try:
+            if inspect.isasyncgen(value):
+                with _tracing.use_context(stream_ctx):
+                    async for item in value:
+                        await _send(item)
+                        if _abandoned():
+                            # user generator's finally runs
+                            await value.aclose()
+                            break
+            elif inspect.isgenerator(value):
 
-            def _next():
-                try:
-                    return next(value)
-                except StopIteration:
-                    return _END
+                def _next():
+                    # run_in_executor does not propagate contextvars:
+                    # re-install the stream context on the pool thread
+                    # so the generator body's spans/submits stay in the
+                    # request's trace
+                    with _tracing.use_context(stream_ctx):
+                        try:
+                            return next(value)
+                        except StopIteration:
+                            return _END
 
-            # a grouped streaming method iterates on its group's pool
-            # (same isolation rule as _exec_task's sync-method path)
-            _pool = getattr(self, "_group_pools", {}).get(
-                spec.kwargs.get("__rt_group__"), self._exec_pool
-            )
-            while True:
-                item = await loop.run_in_executor(_pool, _next)
-                if item is _END:
-                    break
-                await _send(item)
-                if _abandoned():
-                    await loop.run_in_executor(_pool, value.close)
-                    break
-        else:
-            await _send(value)
+                # a grouped streaming method iterates on its group's pool
+                # (same isolation rule as _exec_task's sync-method path)
+                _pool = getattr(self, "_group_pools", {}).get(
+                    spec.kwargs.get("__rt_group__"), self._exec_pool
+                )
+                while True:
+                    item = await loop.run_in_executor(_pool, _next)
+                    if item is _END:
+                        break
+                    await _send(item)
+                    if _abandoned():
+                        await loop.run_in_executor(_pool, value.close)
+                        break
+            else:
+                await _send(value)
+        except BaseException as e:
+            _tracing.finish_span(stream_span, error=type(e).__name__)
+            raise
+        _tracing.finish_span(stream_span)
         return index
 
     async def _create_with_backpressure(self, id_bytes: bytes, total: int,

@@ -94,6 +94,7 @@ async def update_builtin_metrics(ctl):
         "rt_serve_engine_block_occupancy": "block_occupancy",
         "rt_serve_engine_prefix_hit_rate": "prefix_hit_rate",
         "rt_serve_engine_ttft_ema_seconds": "ttft_ema_s",
+        "rt_serve_engine_ttft_p90_seconds": "ttft_p90_s",
         "rt_serve_engine_rejected_total": "rejected_total",
         "rt_serve_engine_shed_total": "shed_total",
         "rt_serve_kv_pool_bytes": "kv_pool_bytes",
@@ -244,6 +245,34 @@ DEFAULT_PANELS: List[Panel] = [
                           "lineage reconstructions")],
           description="sustained nonzero = store budget or partition "
                       "count needs tuning"),
+    # ---- serve request ledger (serve/request_ledger.py) -------------
+    Panel("Serve request latency", unit="s",
+          targets=[Target(
+              "histogram_quantile(0.9, sum by (le, app, deployment) "
+              "(rate(rt_serve_ttft_seconds_bucket[5m])))",
+              "ttft p90 {{app}}/{{deployment}}"),
+              Target(
+              "histogram_quantile(0.9, sum by (le, app, deployment) "
+              "(rate(rt_serve_e2e_seconds_bucket[5m])))",
+              "e2e p90 {{app}}/{{deployment}}"),
+              Target(
+              "histogram_quantile(0.9, sum by (le, app, deployment) "
+              "(rate(rt_serve_queue_wait_seconds_bucket[5m])))",
+              "queue wait p90 {{app}}/{{deployment}}")],
+          description="per-request ledger phases (windowed histograms, "
+                      "not EMAs): TTFT, end-to-end, and router queue "
+                      "wait; pair with /api/slo burn rates"),
+    Panel("Serve decode cadence", unit="s",
+          targets=[Target(
+              "histogram_quantile(0.5, sum by (le, app, deployment) "
+              "(rate(rt_serve_tpot_seconds_bucket[5m])))",
+              "tpot p50 {{app}}/{{deployment}}"),
+              Target(
+              "histogram_quantile(0.9, sum by (le, app, deployment) "
+              "(rate(rt_serve_prefill_seconds_bucket[5m])))",
+              "prefill p90 {{app}}/{{deployment}}")],
+          description="time-per-output-token and prefill from the "
+                      "engine tickets on the request ledger"),
     Panel("Engine queue depth",
           targets=[Target("rt_serve_engine_queue_depth",
                           "{{app}}/{{deployment}}/{{replica}}")],

@@ -311,6 +311,21 @@ class DashboardHead:
                 # the correct answer, not an error page
                 logger.debug("serve status unavailable: %s", e)
                 return httpd.json_response({})
+        if path == "/api/slo":
+            # per-deployment SLO burn rates (serve/slo.py): configured
+            # targets + multi-window burn rates + ok verdict, folded
+            # by the controller from the replicas' ledger counters
+            try:
+                from ray_tpu.serve.api import _get_controller_async
+                from ray_tpu.core.runtime import get_runtime
+
+                controller = await _get_controller_async()
+                ref = controller.get_slo_status.remote()
+                status = await get_runtime()._get_one(ref)
+                return httpd.json_response(status)
+            except Exception as e:
+                logger.debug("slo status unavailable: %s", e)
+                return httpd.json_response({})
         if path == "/api/serve/applications":
             # REST deploy (reference: `dashboard/modules/serve/` REST API
             # + `serve/schema.py` app config): PUT deploys an app whose

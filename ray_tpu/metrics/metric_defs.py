@@ -109,6 +109,10 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
     "rt_serve_engine_ttft_ema_seconds": (
         "gauge", "time-to-first-token EMA",
         ("app", "deployment", "replica"), None),
+    "rt_serve_engine_ttft_p90_seconds": (
+        "gauge", "windowed time-to-first-token p90 (decays; feeds "
+        "shedding + SLO autoscaling)",
+        ("app", "deployment", "replica"), None),
     "rt_serve_engine_rejected_total": (
         "gauge", "engine admission rejections (monotonic, bridged)",
         ("app", "deployment", "replica"), None),
@@ -124,6 +128,26 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[tuple]]] = {
         "attention kernel (monotonic, bridged; gather-fallback ticks "
         "are the engine's decode_fallback_dispatch_total)",
         ("app", "deployment", "replica"), None),
+    # ---- serve request ledger (serve/request_ledger.py; windowed
+    # per-request phase latencies replacing EMA-only reporting) -------
+    "rt_serve_ttft_seconds": (
+        "histogram", "request time-to-first-token (submit to first "
+        "harvested token)", ("app", "deployment", "replica"),
+        _LATENCY_S),
+    "rt_serve_tpot_seconds": (
+        "histogram", "mean time per output token after the first "
+        "(decode cadence)", ("app", "deployment", "replica"),
+        _LATENCY_S),
+    "rt_serve_queue_wait_seconds": (
+        "histogram", "router assignment wait (request arrival to "
+        "replica pick)", ("app", "deployment", "replica"), _LATENCY_S),
+    "rt_serve_prefill_seconds": (
+        "histogram", "engine prefill wall time (admission to KV "
+        "residency)", ("app", "deployment", "replica"), _LATENCY_S),
+    "rt_serve_e2e_seconds": (
+        "histogram", "end-to-end request latency at the ledger origin "
+        "(proxy arrival or replica entry to terminal phase)",
+        ("app", "deployment", "replica"), _LATENCY_S),
     # ---- rllib (rllib/env/env_runner_group.py, algorithms/ppo.py) ---
     "rt_rllib_env_steps_total": (
         "counter", "env steps consumed by the learner side (ledger-"

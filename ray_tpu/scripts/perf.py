@@ -1438,6 +1438,116 @@ def measure_obs_overhead(*, storm_n: int = 3000, rounds: int = 6,
     return out
 
 
+def measure_serve_obs_overhead(*, requests: int = 24, n_new: int = 8,
+                               rounds: int = 6, seed: int = 0,
+                               ) -> Dict[str, Dict[str, float]]:
+    """The serve-path half of `--config obs_overhead`: throughput cost
+    of the per-request ledger + phase histograms on the continuous-
+    batching hot path (CPU tiny model, in-process engine, no cluster).
+
+    Same alternating-median methodology as the task-storm half: ONE
+    engine serves every round, 'off' and 'on' storms alternate with the
+    driver-side metrics gate flipped between them.  The driver loop is
+    byte-identical in both phases — it always calls `start_request` and
+    wraps the submit in `use_ledger` — so the gate alone decides the
+    cost: gate down, `start_request` returns None and the engine's
+    `engine_ticket()` returns None (the zero-allocation path the unit
+    tests pin); gate up, every request carries a live ledger and the
+    engine stamps admission/prefill/first-token/done onto its ticket,
+    with phase histograms observed at finish.  The 'on' phases
+    self-validate through the e2e histogram count (every storm request
+    must land one observation — the row can never measure a disabled
+    ledger).  Budget: <=2% on serve tok/s, recorded in PERF.md."""
+    import statistics as _stats
+
+    import jax
+
+    from ray_tpu.metrics import metric_defs as _md
+    from ray_tpu.models import llama
+    from ray_tpu.serve import request_ledger as _rl
+    from ray_tpu.serve.llm_engine import LlamaEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    prompts = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size, size=24)]
+        for _ in range(requests)
+    ]
+
+    def _e2e_count() -> float:
+        return sum(
+            v for labels, v in
+            _md.metric("rt_serve_e2e_seconds")._samples()
+            if "__count__" in labels
+        )
+
+    def _storm(eng) -> float:
+        futs = []
+        ledgers = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            led = _rl.start_request("bench", "perf", "obs", replica="r0")
+            with _rl.use_ledger(led):
+                futs.append(eng.submit(list(p), n_new))
+            ledgers.append(led)
+        for f, led in zip(futs, ledgers):
+            f.result(timeout=600)
+            if led is not None:
+                led.finish("ok")
+        return requests * n_new / (time.perf_counter() - t0)
+
+    prior_env = os.environ.get("RT_METRICS_ENABLED")
+    off_tps: List[float] = []
+    on_tps: List[float] = []
+    instrumented = True
+    eng = LlamaEngine(cfg, params, slots=4, chunk=4, block_size=8,
+                      max_len=48)
+    try:
+        _md.set_enabled(False)
+        _storm(eng)  # warm compiles (both prefill routes stay warm)
+        _storm(eng)
+        for _ in range(rounds):
+            _md.set_enabled(False)
+            off_tps.append(_storm(eng))
+            _md.set_enabled(True)
+            before = _e2e_count()
+            on_tps.append(_storm(eng))
+            instrumented &= (_e2e_count() - before) >= requests
+    finally:
+        eng.shutdown()
+        _md.set_enabled(prior_env in ("1", "true", "True"))
+        if prior_env is not None:
+            os.environ["RT_METRICS_ENABLED"] = prior_env
+    med_off = _stats.median(off_tps)
+    med_on = _stats.median(on_tps)
+    out: Dict[str, Dict[str, float]] = {
+        "serve_obs_off": {
+            "tokens_per_sec": round(med_off, 1),
+            "tokens_per_sec_min": round(min(off_tps), 1),
+            "tokens_per_sec_max": round(max(off_tps), 1),
+            "rounds": float(rounds), "requests": float(requests),
+        },
+        "serve_obs_on": {
+            "tokens_per_sec": round(med_on, 1),
+            "tokens_per_sec_min": round(min(on_tps), 1),
+            "tokens_per_sec_max": round(max(on_tps), 1),
+            "rounds": float(rounds), "requests": float(requests),
+            "instrumented": float(instrumented),
+        },
+        "serve_obs_overhead": {
+            "overhead_pct": round(100.0 * (1.0 - med_on / med_off), 2),
+            "ledger_off_tokens_per_sec": round(med_off, 1),
+            "ledger_on_tokens_per_sec": round(med_on, 1),
+            "instrumented": float(instrumented),
+        },
+    }
+    for k in ("serve_obs_off", "serve_obs_on", "serve_obs_overhead"):
+        print(f"obs_overhead[{k}]: " + ", ".join(
+            f"{kk}={vv}" for kk, vv in out[k].items()), flush=True)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filter", default=None, help="substring filter")
@@ -1490,7 +1600,9 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
                         "repartition+sort of a dataset ~2x the object "
                         "store, rows/s + spill bytes; obs_overhead: "
                         "task-storm throughput with the metrics plane "
-                        "off vs on, overhead pct; storage_faults: the "
+                        "off vs on, overhead pct, plus the serve-path "
+                        "A/B (request ledger + phase histograms on vs "
+                        "off on the CB engine); storage_faults: the "
                         "same exchange under a seeded bit-flip + "
                         "ENOSPC + EIO disk-fault schedule, exact row "
                         "accounting + fault-counter evidence; "
@@ -1527,6 +1639,10 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
     p.add_argument("--storage-faults-store-mb", type=int, default=8)
     p.add_argument("--obs-storm-n", type=int, default=3000)
     p.add_argument("--obs-rounds", type=int, default=6)
+    p.add_argument("--obs-serve-requests", type=int, default=24,
+                   help="obs_overhead: requests per serve-path A/B "
+                        "storm (ledger+histograms on vs off on the "
+                        "in-process CB engine)")
     p.add_argument("--envelope", action="store_true",
                    help="run the scalability-envelope rows INSTEAD of "
                         "the microbenchmark matrix (reference: "
@@ -1637,6 +1753,11 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
             storm_n=args.obs_storm_n, rounds=args.obs_rounds,
             num_workers=args.num_workers,
         )
+        # serve-path half: runs after the cluster is down (in-process
+        # engine, no runtime needed)
+        results.update(measure_serve_obs_overhead(
+            requests=args.obs_serve_requests, rounds=args.obs_rounds,
+        ))
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(results, f, indent=2)

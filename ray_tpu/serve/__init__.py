@@ -21,6 +21,7 @@ from ray_tpu.serve.api import (
     ingress,
     run,
     shutdown,
+    slo_status,
     start,
     status,
 )
@@ -29,6 +30,7 @@ from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, GRPCOptions, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.request import Request, Response
+from ray_tpu.serve.slo import SLOConfig
 
 __all__ = [
     "Application",
@@ -41,6 +43,7 @@ __all__ = [
     "HTTPOptions",
     "Request",
     "Response",
+    "SLOConfig",
     "batch",
     "get_multiplexed_model_id",
     "multiplexed",
@@ -54,6 +57,7 @@ __all__ = [
     "ingress",
     "run",
     "shutdown",
+    "slo_status",
     "start",
     "status",
 ]

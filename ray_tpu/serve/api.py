@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu as rt
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, GRPCOptions, HTTPOptions
+from ray_tpu.serve.slo import SLOConfig
 from ray_tpu.serve.controller import (
     CONTROLLER_NAME,
     CONTROLLER_NAMESPACE,
@@ -67,6 +68,8 @@ class Deployment:
         for k, v in kwargs.items():
             if k == "autoscaling_config":
                 v = _coerce_autoscaling(v)
+            if k == "slo_config":
+                v = _coerce_slo(v)
             if hasattr(cfg, k):
                 setattr(cfg, k, v)
             else:
@@ -88,6 +91,12 @@ def _coerce_autoscaling(v) -> Optional[AutoscalingConfig]:
     return AutoscalingConfig(**v)
 
 
+def _coerce_slo(v) -> Optional[SLOConfig]:
+    if v is None or isinstance(v, SLOConfig):
+        return v
+    return SLOConfig(**v)
+
+
 def deployment(
     _func_or_class: Optional[Callable] = None,
     *,
@@ -96,6 +105,7 @@ def deployment(
     max_ongoing_requests: int = 16,
     max_queued_requests: int = -1,
     autoscaling_config: Union[AutoscalingConfig, dict, None] = None,
+    slo_config: Union[SLOConfig, dict, None] = None,
     user_config: Optional[Any] = None,
     health_check_period_s: float = 2.0,
     health_check_timeout_s: float = 10.0,
@@ -115,6 +125,7 @@ def deployment(
             max_ongoing_requests=max_ongoing_requests,
             max_queued_requests=max_queued_requests,
             autoscaling_config=auto,
+            slo_config=_coerce_slo(slo_config),
             user_config=user_config,
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
@@ -420,6 +431,16 @@ def delete(name: str):
 def status() -> Dict[str, Any]:
     controller = _get_controller()
     return rt.get(controller.get_serve_status.remote())
+
+
+def slo_status() -> Dict[str, Any]:
+    """Per-deployment SLO burn rates: {app: {deployment: row}} where
+    row carries the configured targets, multi-window burn rates folded
+    from the replicas' ledger counters, and an `ok` verdict (see
+    serve/slo.py).  Deployments without an `slo_config` report
+    {"configured": False}."""
+    controller = _get_controller()
+    return rt.get(controller.get_slo_status.remote())
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

@@ -5,7 +5,7 @@ Reference: `python/ray/serve/autoscaling_policy.py` +
 handle-reported ongoing-request counts, this policy consumes the
 ENGINE-grade signals the stats() piggyback already delivers to the
 controller on the health-check cadence (PR 6): per-replica queue
-depth, TTFT EMA, and shed/rejection counters.  That makes the scaling
+depth, windowed TTFT p90, and shed/rejection counters.  That makes the scaling
 loop close over the metric users actually experience (time to first
 token) instead of a proxy for it, and lets an overloaded system that
 is actively REFUSING work scale out even when its smoothed latency
@@ -98,32 +98,27 @@ class AutoscalingPolicy:
         """Instantaneous load ratio for the deployment: the max over
         configured SLOs of observed/target.
 
-        - TTFT: the WORST replica's `ttft_ema_s` (a p99-flavored
-          reading — one replica missing the SLO means real users
-          missing it, however good the mean looks);
+        - TTFT: the WORST replica's `ttft_p90_s` — the engine's
+          WINDOWED percentile over `RT_SERVE_TTFT_WINDOW_S` (a
+          p99-flavored reading — one replica missing the SLO means
+          real users missing it, however good the mean looks).  The
+          windowed percentile decays to zero once its samples age out,
+          so a storm-inflated reading stops asserting pressure within
+          one window of the storm ending.  The PR-10 idle override
+          (zero the ratio when nothing is in flight) existed only
+          because the old lifetime TTFT EMA never decayed; it is
+          retired along with the EMA input.
         - queue depth: the MEAN per-replica backlog (depth is additive
           across replicas, so the mean is what scaling actually
           changes);
         - sheds/rejections since the last tick force the ratio above
           the hysteresis band: a system refusing work is
-          under-provisioned by definition.
-
-        IDLE OVERRIDE: with zero backlog and zero in-flight work the
-        ratio is 0.0 regardless of the TTFT EMA — the EMA is lifetime-
-        smoothed and never decays once traffic stops, and without this
-        a deployment that was once slow could never scale back down."""
+          under-provisioned by definition."""
         cfg = self.config
         depths = [replica_depth(m) for m in metrics]
-        ongoing = 0.0
-        for m in metrics:
-            try:
-                ongoing += float(m.get("ongoing", 0) or 0)
-            except (TypeError, ValueError):
-                pass
         refused = self._refused_delta(metrics)
         self.refusal_forced = refused > 0.0
-        if not metrics or (sum(depths) == 0.0 and ongoing == 0.0
-                           and refused == 0.0):
+        if not metrics:
             return 0.0
         r = 0.0
         if cfg.target_queue_depth is not None and depths:
@@ -134,7 +129,7 @@ class AutoscalingPolicy:
             for m in metrics:
                 us = m.get("user_stats") or {}
                 try:
-                    worst = max(worst, float(us.get("ttft_ema_s", 0) or 0))
+                    worst = max(worst, float(us.get("ttft_p90_s", 0) or 0))
                 except (TypeError, ValueError):
                     pass
             r = max(r, worst / max(cfg.target_ttft_s, 1e-9))

@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ray_tpu.serve.slo import SLOConfig
+
 
 @dataclass
 class AutoscalingConfig:
@@ -73,6 +75,10 @@ class DeploymentConfig:
     health_check_timeout_s: float = 10.0
     graceful_shutdown_timeout_s: float = 5.0
     user_config: Optional[Any] = None
+    # per-deployment SLOs (serve/slo.py): the controller tracks
+    # multi-window burn rates against these from the replica-shipped
+    # ledger counters; surfaced via rt.slo_status() / /api/slo
+    slo_config: Optional[SLOConfig] = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

@@ -118,6 +118,18 @@ class _DeploymentState:
             self.policy = AutoscalingPolicy(ac)
         else:
             self.policy = None
+        # SLO burn-rate tracker (serve/slo.py): folds the replicas'
+        # ledger counter blocks (the health piggyback's "slo" key)
+        # into a deployment-cumulative series behind rt.slo_status()
+        # and /api/slo.  getattr: configs rehydrated from pre-SLO
+        # checkpoints have no slo_config attribute.
+        sc = getattr(config, "slo_config", None)
+        if sc is not None and sc.has_any():
+            from ray_tpu.serve.slo import BurnRateTracker
+
+            self.slo_tracker = BurnRateTracker()
+        else:
+            self.slo_tracker = None
 
     def routing_table(self) -> Dict[str, Any]:
         running = [r for r in self.replicas.values() if r.state == RUNNING]
@@ -558,6 +570,33 @@ class ServeController:
                 for app_name, deployments in self._apps.items()
             }
 
+    def get_slo_status(self) -> Dict[str, Any]:
+        """Per-deployment SLO burn rates (serve/slo.py) for
+        rt.slo_status() and the dashboard's /api/slo: configured
+        targets, multi-window burn rates folded from the replicas'
+        ledger counter blocks, and an ok verdict."""
+        from ray_tpu.serve import slo as _slo
+
+        with self._lock:
+            return {
+                app_name: {
+                    name: _slo.status_for(
+                        getattr(ds, "slo_tracker", None),
+                        getattr(ds.config, "slo_config", None),
+                    )
+                    for name, ds in deployments.items()
+                }
+                for app_name, deployments in self._apps.items()
+            }
+
+    @staticmethod
+    def _forget_slo_replica(ds: _DeploymentState, rid: str):
+        """Replica removed: drop its last-seen counter block so a
+        replacement reusing the id delta-folds from zero."""
+        tracker = getattr(ds, "slo_tracker", None)
+        if tracker is not None:
+            tracker.forget_replica(rid)
+
     def ping(self) -> bool:
         return True
 
@@ -780,6 +819,13 @@ class ServeController:
                         reply = rt.get(r.health_ref)
                         if isinstance(reply, dict):
                             r.metrics = reply
+                            tracker = getattr(ds, "slo_tracker", None)
+                            if tracker is not None:
+                                # delta-fold the replica's cumulative
+                                # SLO counter block; snapshot() is
+                                # internally throttled to >= 1 s
+                                tracker.fold(rid, reply.get("slo"))
+                                tracker.snapshot()
                         if r.state == STARTING:
                             r.state = RUNNING
                             changed = True
@@ -788,11 +834,13 @@ class ServeController:
                                      rid, e)
                         del ds.replicas[rid]
                         changed = True
+                        self._forget_slo_replica(ds, rid)
                         self._kill_quietly(r)
                     r.health_ref = None
                 elif now - r.health_sent > ds.config.health_check_timeout_s:
                     del ds.replicas[rid]
                     changed = True
+                    self._forget_slo_replica(ds, rid)
                     self._kill_quietly(r)
             # 2. scale up to target
             while len(ds.replicas) < ds.target_replicas:
@@ -807,6 +855,7 @@ class ServeController:
                 )
                 for rid in order[-excess:]:
                     victims.append(ds.replicas.pop(rid))
+                    self._forget_slo_replica(ds, rid)
                 changed = True
             if changed:
                 ds.version += 1

@@ -63,6 +63,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
+from ray_tpu.serve import request_ledger as _rl
 from ray_tpu.serve.kv_cache import SCRATCH_BLOCK, BlockPool, RadixCache
 
 logger = logging.getLogger(__name__)
@@ -244,6 +245,25 @@ class LlamaEngine:
         self._shed_predicted = 0      # predicted TTFT > remaining budget
         self._draining = False        # begin_drain(): reject new work
         self._ttft_ema_s = 0.0
+        # windowed TTFT samples (monotonic ts, ttft): the shed
+        # predictor and the SLO autoscaler consume the p90 over
+        # RT_SERVE_TTFT_WINDOW_S, which DECAYS as samples age out —
+        # unlike the lifetime EMA (kept for back-compat reporting), a
+        # storm-inflated history stops biasing decisions one window
+        # after the storm ends.  Touched only on the engine thread
+        # (appends in _harvest, reads in _maybe_shed/_stats_locked).
+        self._ttft_window_s = float(
+            os.environ.get("RT_SERVE_TTFT_WINDOW_S", "10") or 10
+        )
+        self._ttft_samples: deque = deque(maxlen=256)
+        # tick introspection ring: the last N per-tick records (batch
+        # composition, live tokens, gather width, kernel route, shed
+        # counters, phase wall times) exposed via stats() for the
+        # dashboard and postmortems.  Bounded; one dict per tick, no
+        # per-request cost.
+        self._tick_ring: deque = deque(maxlen=max(1, int(
+            os.environ.get("RT_ENGINE_TICK_RING", "32") or 32
+        )))
         self._tick_ema_s = 0.0
         self._last_gather_blocks = 0  # W of the latest chunk dispatch
         # last computed stats() dict, served when the engine lock is
@@ -294,6 +314,9 @@ class LlamaEngine:
             ))
             return f
         n_new = max(1, min(int(max_new_tokens), limit - len(prompt_ids)))
+        # engine slice of the request's latency ledger: None (zero
+        # allocations) unless an ambient ledger or sampled trace exists
+        tk = _rl.engine_ticket()
         # no pool-size check needed: __init__ guarantees the pool holds
         # a full max_len sequence, and T + n_new - 1 <= max_len - 1
         now = _time.monotonic()
@@ -301,10 +324,14 @@ class LlamaEngine:
         fut: Future = Future()
         with self._wake:
             if not self._running:
+                if tk is not None:
+                    tk.refused("shutdown")
                 fut.set_exception(RuntimeError("engine is shut down"))
                 return fut
             if self._draining:
                 self._rejected_total += 1
+                if tk is not None:
+                    tk.refused("draining")
                 fut.set_exception(BackPressureError(
                     "engine is draining (replica scaling down)",
                     retry_after_s=self.retry_after_hint_s(),
@@ -323,6 +350,8 @@ class LlamaEngine:
                 # slots are zero and the queue is bounded at exactly
                 # max_queued.
                 self._rejected_total += 1
+                if tk is not None:
+                    tk.refused("queue_full")
                 fut.set_exception(BackPressureError(
                     f"engine queue full (max_queued={self.max_queued})",
                     retry_after_s=self.retry_after_hint_s(),
@@ -330,13 +359,15 @@ class LlamaEngine:
                 return fut
             if deadline is not None and now >= deadline:
                 self._shed_expired += 1
+                if tk is not None:
+                    tk.refused("expired_at_submit")
                 fut.set_exception(DeadlineExceededError(
                     "request budget already spent at submission",
                     timeout_s=timeout_s,
                 ))
                 return fut
             self._queue.append(
-                (list(prompt_ids), n_new, fut, now, deadline)
+                (list(prompt_ids), n_new, fut, now, deadline, tk)
             )
             self._wake.notify()
         return fut
@@ -363,6 +394,17 @@ class LlamaEngine:
         finally:
             self._lock.release()
         return dict(snap)
+
+    def _ttft_p90(self) -> float:
+        """p90 TTFT over the trailing window — 0.0 once every sample
+        has aged out, so load-shedding and autoscaling decisions built
+        on it decay naturally after a storm (the lifetime EMA never
+        did; see _maybe_shed)."""
+        cutoff = _time.monotonic() - self._ttft_window_s
+        live = sorted(v for ts, v in self._ttft_samples if ts >= cutoff)
+        if not live:
+            return 0.0
+        return live[min(len(live) - 1, int(len(live) * 0.9))]
 
     def _stats_locked(self) -> Dict[str, object]:
         served = self._hit_tokens + self._prefill_tokens
@@ -408,8 +450,17 @@ class LlamaEngine:
                 "chunk_cache_size": len(self._chunk_cache),
                 "chunk_cache_evictions": self._chunk_cache_evictions,
                 "ttft_ema_s": self._ttft_ema_s,
+                # windowed TTFT percentile (decays to 0 as samples age
+                # out): the shed predictor and AutoscalingPolicy
+                # .pressure() consume THIS, not the lifetime EMA
+                "ttft_p90_s": self._ttft_p90(),
+                "ttft_window_s": self._ttft_window_s,
                 "tick_ema_s": self._tick_ema_s,
                 "ticks": self._chunk_seq,
+                # tick introspection ring: last N per-tick records for
+                # the dashboard / postmortems (list of small dicts;
+                # numeric-bridge consumers skip non-float values)
+                "tick_ring": list(self._tick_ring),
                 # overload plane (admission control + shedding):
                 # consumed by the SLO autoscaler and /api/serve
                 "max_queued": (-1 if self.max_queued is None
@@ -774,36 +825,39 @@ class LlamaEngine:
 
     # -- admission -----------------------------------------------------
     def _maybe_shed(self, fut: Future, deadline: Optional[float],
-                    busy: bool) -> bool:
+                    tk=None) -> bool:
         """Deadline-aware load shedding, applied when a request is
         popped for admission — the last instant before it costs a
         prefill dispatch.  Sheds when the deadline has already passed,
-        OR — only while the engine is BUSY (`busy`: live slots or more
-        queued work behind this pop) — when the predicted
-        time-to-first-token (the TTFT EMA, which tracks queueing +
-        prefill under load) must overrun the remaining budget: a
-        backed-up engine stops doing work nobody will read.  The busy
-        gate matters because the EMA is lifetime-smoothed and never
-        decays while idle: without it, a storm-inflated EMA would keep
-        shedding deadline-carrying requests from a completely idle
-        engine forever (sheds never update the EMA, so nothing would
-        ever bring it back down).  Sheds are breaker-NEUTRAL
-        downstream (the router classifies DeadlineExceededError as
-        neutral, PR-1 convention): an overloaded-but-reachable replica
-        must not accrue breaker failures for honest sheds."""
+        or when the predicted time-to-first-token (the windowed TTFT
+        p90, which tracks queueing + prefill under load) must overrun
+        the remaining budget: a backed-up engine stops doing work
+        nobody will read.  The predictor is the WINDOWED percentile,
+        not the old lifetime EMA, so it decays to zero within
+        `_ttft_window_s` of the load ending — the PR-10 busy gate
+        (which existed only because a storm-inflated, never-decaying
+        EMA would otherwise shed from an idle engine forever) is
+        retired with it.  Sheds are breaker-NEUTRAL downstream (the
+        router classifies DeadlineExceededError as neutral, PR-1
+        convention): an overloaded-but-reachable replica must not
+        accrue breaker failures for honest sheds."""
         if deadline is None or fut.done():
             return False
         now = _time.monotonic()
+        pred = self._ttft_p90()
         if now >= deadline:
             self._shed_expired += 1
             why = "deadline already expired in queue"
-        elif (busy and self._ttft_ema_s > 0.0
-                and now + self._ttft_ema_s >= deadline):
+            reason = "shed_expired"
+        elif pred > 0.0 and now + pred >= deadline:
             self._shed_predicted += 1
-            why = (f"predicted TTFT ({self._ttft_ema_s * 1e3:.0f} ms EMA) "
+            why = (f"predicted TTFT ({pred * 1e3:.0f} ms windowed p90) "
                    "exceeds the remaining budget")
+            reason = "shed_predicted"
         else:
             return False
+        if tk is not None:
+            tk.refused(reason)
         fut.set_exception(DeadlineExceededError(
             f"shed before prefill: {why}",
             timeout_s=max(0.0, deadline - now),
@@ -818,7 +872,7 @@ class LlamaEngine:
         return own
 
     def _admit(self, prompt: List[int], n_new: int, fut: Future,
-               t_submit: float) -> bool:
+               t_submit: float, tk=None) -> bool:
         """Returns False (without consuming anything) when the pool
         cannot cover the request right now — the caller requeues it."""
         jnp = self._jnp
@@ -837,6 +891,10 @@ class LlamaEngine:
             if self._radix is not None:
                 self._radix.release(path)
             return False
+        if tk is not None:
+            # queue wait ends here: the request holds a slot and its
+            # blocks; everything after is prefill dispatch
+            tk.admitted(_time.time())
 
         slot = self._free.pop()
         if P > 0:
@@ -925,11 +983,15 @@ class LlamaEngine:
                 own_set = [b for b in own_set if b not in adopted_set]
 
         self._slot_blocks[slot] = shared + own
+        if tk is not None:
+            # host-side dispatch timestamp: the prefill computes async
+            # on device, but the ledger phases are wall-clock anyway
+            tk.prefilled(_time.time())
         self._active[slot] = {
             "fut": fut, "out": [], "want": n_new,
             "since": self._chunk_seq + 1,  # first chunk with its steps
             "pos_host": T, "own_blocks": own_set, "tree_path": path,
-            "t_submit": t_submit, "first_tok": False,
+            "t_submit": t_submit, "first_tok": False, "tk": tk,
         }
         return True
 
@@ -962,6 +1024,7 @@ class LlamaEngine:
         A request's FIRST chunk contributes from row 0 (its prefill
         token rode along); later chunks from row 1."""
         now = _time.monotonic()
+        wall = _time.time()
         done = []
         for slot, req in self._active.items():
             if req["since"] > seq:
@@ -979,11 +1042,16 @@ class LlamaEngine:
                     ttft if self._ttft_ema_s == 0.0
                     else 0.8 * self._ttft_ema_s + 0.2 * ttft
                 )
+                self._ttft_samples.append((now, ttft))
+                if req["tk"] is not None:
+                    req["tk"].first_token(wall)
             if len(req["out"]) >= req["want"]:
                 done.append(slot)
         for slot in done:
             req = self._active.pop(slot)
             self._release(slot, req)
+            if req["tk"] is not None:
+                req["tk"].done(len(req["out"][:req["want"]]), wall)
             if not req["fut"].done():
                 req["fut"].set_result(req["out"][:req["want"]])
 
@@ -1023,16 +1091,16 @@ class LlamaEngine:
             try:
                 t0 = _time.perf_counter()
                 requeue = []
-                for i, (prompt, n_new, fut, ts, dl) in enumerate(admissions):
+                for i, (prompt, n_new, fut, ts, dl, tk) in \
+                        enumerate(admissions):
                     # shed BEFORE the prefill dispatch: an expired (or,
                     # under load, predictably-expiring) request consumes
                     # neither a slot nor a KV block nor a compile
-                    busy = bool(self._active) or bool(self._queue)
-                    if self._maybe_shed(fut, dl, busy):
+                    if self._maybe_shed(fut, dl, tk):
                         self._pending_admissions -= 1
                         continue
                     with self._lock:
-                        if not self._admit(prompt, n_new, fut, ts):
+                        if not self._admit(prompt, n_new, fut, ts, tk):
                             # pool exhausted by LIVE sequences: wait for
                             # completions, preserving arrival order
                             requeue = admissions[i:]
@@ -1104,6 +1172,27 @@ class LlamaEngine:
                     else 0.8 * self._tick_ema_s + 0.2 * (t3 - t0)
                 )
                 with self._lock:  # keep the lock-free stats() fallback
+                    # one introspection record per tick (bounded ring;
+                    # shipped through stats() -> health piggyback ->
+                    # /api/serve for batch-composition postmortems)
+                    self._tick_ring.append({
+                        "seq": self._chunk_seq,
+                        "admitted": len(admissions),
+                        "active": len(self._active),
+                        "queued": len(self._queue),
+                        "free_slots": len(self._free),
+                        "live_tokens": sum(
+                            r["pos_host"] for r in self._active.values()
+                        ),
+                        "gather_blocks": W,
+                        "kernel": self._decode_kernel,
+                        "admit_s": t1 - t0,
+                        "dispatch_s": t2 - t1,
+                        "harvest_s": t3 - t2,
+                        "shed_expired": self._shed_expired,
+                        "shed_predicted": self._shed_predicted,
+                        "rejected_total": self._rejected_total,
+                    })
                     self._stats_snapshot = self._stats_locked()  # fresh
                 if _TRACE:
                     with self._lock:
@@ -1126,7 +1215,7 @@ class LlamaEngine:
                     # admissions popped from the queue but not (yet)
                     # registered in _active would otherwise hang their
                     # callers forever
-                    for _p, _n, fut, _ts, _dl in admissions:
+                    for _p, _n, fut, _ts, _dl, _tk in admissions:
                         if not fut.done():
                             fut.set_exception(e)
                     self._active.clear()

@@ -21,10 +21,22 @@ from typing import Any, Dict, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 from ray_tpu import exceptions as _exc
+from ray_tpu.serve import request_ledger as _rl
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.request import Request, Response
+from ray_tpu.util import tracing as _tracing
 
 _MAX_BODY = 256 * 1024 * 1024
+
+
+def _terminal_status(http_status: int) -> str:
+    """Ledger terminal classification from the HTTP translation:
+    503 == refused by backpressure, 504 == shed on deadline."""
+    if http_status == 503:
+        return "rejected"
+    if http_status == 504:
+        return "shed"
+    return "error"
 
 
 def _error_response(e: BaseException):
@@ -111,24 +123,48 @@ class HTTPProxy:
                     break
                 self._num_requests += 1
                 keep_alive = req.headers.get("connection", "keep-alive") != "close"
+                # request ledger: proxy arrival is t0; control paths
+                # (/-/healthz, /-/routes) are not user requests and
+                # stay out of the latency surfaces
+                led = (None if req.path.startswith("/-/")
+                       else _rl.start_request("http", "-", "-"))
                 try:
-                    out = await self._dispatch(req)
+                    if led is not None:
+                        led.begin("proxy")
+                        # ambient trace ctx + ledger ride the dispatch
+                        # chain (handle -> router -> runtime submit),
+                        # so the whole request shares one trace id
+                        with _tracing.use_context(led.ctx()), \
+                                _rl.use_ledger(led):
+                            out = await self._dispatch(req, led)
+                    else:
+                        out = await self._dispatch(req)
                 except Exception as e:  # noqa: BLE001 — boundary to HTTP
                     # overload signals become retryable statuses (503 +
                     # Retry-After / 504), not generic 500s; 500 bodies
                     # carry the traceback
                     logger.debug("dispatch of %s failed: %s", req.path, e)
                     out = _error_response(e)
+                    if led is not None:
+                        led.finish(_terminal_status(out[0]),
+                                   type(e).__name__)
                 if isinstance(out, _StreamOut):
                     # chunked transfer: one chunk per generator item
                     # (reference: streaming responses through the proxy,
                     # `proxy.py` send_request_to_replica_streaming)
-                    await self._write_stream(writer, out, keep_alive)
+                    await self._write_stream(writer, out, keep_alive,
+                                             led=led)
+                    if led is not None:
+                        led.finish("ok")
                 else:
                     status, ctype, body, extra = out
+                    if led is not None:
+                        led.begin("write")
                     await self._write_response(
                         writer, status, ctype, body, extra, keep_alive
                     )
+                    if led is not None:
+                        led.finish("ok")
                 if not keep_alive:
                     break
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -186,7 +222,7 @@ class HTTPProxy:
         self._route_cache[path] = (now, route)
         return route
 
-    async def _dispatch(self, req: Request):
+    async def _dispatch(self, req: Request, led=None):
         if req.path == "/-/healthz":
             return 200, "text/plain", b"ok", {}
         if req.path == "/-/routes":
@@ -200,6 +236,10 @@ class HTTPProxy:
         route = await self._route(req.path)
         if route is None:
             return 404, "text/plain", b"no application for route", {}
+        if led is not None:
+            led.app = route["app"]
+            led.deployment = route["ingress"]
+            led.begin("backend")
         if route.get("streaming"):
             handle = DeploymentHandle(route["ingress"], route["app"],
                                       _stream=True)
@@ -232,7 +272,8 @@ class HTTPProxy:
             return 200, "application/octet-stream", bytes(value), {}
         return 200, "text/plain; charset=utf-8", str(value).encode(), {}
 
-    async def _write_stream(self, writer, out: "_StreamOut", keep_alive: bool):
+    async def _write_stream(self, writer, out: "_StreamOut",
+                            keep_alive: bool, led=None):
         """Write one HTTP/1.1 chunked response, one chunk per item the
         ingress generator yields — the client sees bytes as they are
         produced, not after the generator completes.
@@ -256,6 +297,8 @@ class HTTPProxy:
             # backpressured stream is a clean 503 + Retry-After
             logger.debug("stream failed before first item: %s", e)
             status, ctype, body, extra = _error_response(e)
+            if led is not None:
+                led.finish(_terminal_status(status), type(e).__name__)
             await self._write_response(
                 writer, status, ctype, body, extra, keep_alive,
             )
@@ -288,6 +331,8 @@ class HTTPProxy:
                     ended = True
         except Exception:  # noqa: BLE001 — mid-stream failure
             logger.exception("streaming response aborted mid-body")
+            if led is not None:
+                led.finish("error", "stream_aborted")
             writer.close()  # truncated chunked body signals the abort
             raise ConnectionResetError("stream aborted")
         writer.write(b"0\r\n\r\n")

@@ -15,9 +15,23 @@ import logging
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu import exceptions as _exc
 from ray_tpu.exceptions import BackPressureError
+from ray_tpu.serve import request_ledger as _rl
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _terminal_of(e: BaseException) -> str:
+    """Ledger terminal classification of a replica-side failure:
+    backpressure (engine admission, replica cap) == rejected, deadline
+    expiry == shed, anything else == error."""
+    if _exc.backpressure_retry_after(e) is not None:
+        return "rejected"
+    if _exc.is_deadline_expiry(e):
+        return "shed"
+    return "error"
 
 
 async def _ensure_coro(awaitable):
@@ -48,6 +62,10 @@ class Replica:
     ):
         self._deployment_name = deployment_name
         self._replica_id = replica_id
+        # replica ids are "{app}#{deployment}#{idx}" — the app tag for
+        # the request ledger's histogram series
+        self._app = (replica_id.split("#", 1)[0]
+                     if "#" in replica_id else "default")
         self._max_ongoing = max_ongoing_requests
         self._ongoing = 0
         self._total = 0
@@ -93,24 +111,44 @@ class Replica:
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
 
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
-        self._reject_if_saturated()
+        # replica-side ledger: its trace identity joins the request's
+        # trace (the execution_span installed the propagated context);
+        # None — and zero per-request allocations — when telemetry is
+        # off
+        led = _rl.start_request("replica", self._app,
+                                self._deployment_name, self._replica_id)
+        try:
+            self._reject_if_saturated()
+        except BackPressureError:
+            if led is not None:
+                led.finish("rejected", "replica_saturated")
+            raise
         self._ongoing += 1
         self._total += 1
         t0 = time.monotonic()
         try:
+            if led is not None:
+                led.begin("execute")
             if self._is_function:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name or "__call__")
             if asyncio.iscoroutinefunction(target):
                 _set_model_id(model_id)
-                out = await target(*args, **kwargs)
+                with _rl.use_ledger(led):
+                    out = await target(*args, **kwargs)
             else:
                 from ray_tpu.core.runtime import get_runtime
 
+                tctx = _tracing.current_context()
+
                 def _call_with_ctx():
+                    # pool threads inherit neither contextvar: restore
+                    # the trace context and the ledger so engine-side
+                    # telemetry stays attached to this request
                     _set_model_id(model_id)
-                    return target(*args, **kwargs)
+                    with _tracing.use_context(tctx), _rl.use_ledger(led):
+                        return target(*args, **kwargs)
 
                 loop = asyncio.get_running_loop()
                 out = await loop.run_in_executor(
@@ -119,9 +157,15 @@ class Replica:
                 if inspect.isawaitable(out):
                     out = await out
             return out
+        except Exception as e:  # noqa: BLE001 — terminal classification
+            if led is not None:
+                led.finish(_terminal_of(e), type(e).__name__)
+            raise
         finally:
             self._ongoing -= 1
             self._observe_latency(time.monotonic() - t0)
+            if led is not None:
+                led.finish("ok")  # no-op if a terminal already landed
 
     async def handle_request_streaming(self, method_name: str, *args, **kwargs):
         """Streaming request path (reference: `replica.py:463-492`
@@ -133,34 +177,52 @@ class Replica:
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _set_model_id
 
         model_id = kwargs.pop(MODEL_ID_KWARG, "")
-        self._reject_if_saturated()
+        led = _rl.start_request("replica", self._app,
+                                self._deployment_name, self._replica_id)
+        try:
+            self._reject_if_saturated()
+        except BackPressureError:
+            if led is not None:
+                led.finish("rejected", "replica_saturated")
+            raise
         self._ongoing += 1
         self._total += 1
         t0 = time.monotonic()
         try:
+            if led is not None:
+                led.begin("execute")
             if self._is_function:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name or "__call__")
             _set_model_id(model_id)
+            tctx = _tracing.current_context()
             if inspect.isasyncgenfunction(target):
-                async for item in target(*args, **kwargs):
-                    yield item
+                # the generator body runs at iteration, not creation:
+                # keep the ledger installed around the whole drive (the
+                # ambient var is ours again on every resume; between
+                # our own yields it is visible to the stream driver,
+                # which never touches it)
+                with _rl.use_ledger(led):
+                    async for item in target(*args, **kwargs):
+                        yield item
                 return
             loop = asyncio.get_running_loop()
             from ray_tpu.core.runtime import get_runtime
 
             pool = get_runtime()._exec_pool
             if inspect.iscoroutinefunction(target):
-                out = await target(*args, **kwargs)
+                with _rl.use_ledger(led):
+                    out = await target(*args, **kwargs)
             else:
                 # sync targets run on pool threads, which do NOT inherit
-                # this task's contextvars — set the model id on the
-                # executing thread (same pattern as handle_request's
-                # _call_with_ctx)
+                # this task's contextvars — set the model id, trace
+                # context and ledger on the executing thread (same
+                # pattern as handle_request's _call_with_ctx)
                 def _call_with_ctx():
                     _set_model_id(model_id)
-                    return target(*args, **kwargs)
+                    with _tracing.use_context(tctx), _rl.use_ledger(led):
+                        return target(*args, **kwargs)
 
                 out = await loop.run_in_executor(pool, _call_with_ctx)
             if inspect.isgenerator(out):
@@ -168,10 +230,11 @@ class Replica:
 
                 def _next():
                     _set_model_id(model_id)  # any pool thread may run this
-                    try:
-                        return next(out)
-                    except StopIteration:
-                        return _END
+                    with _tracing.use_context(tctx), _rl.use_ledger(led):
+                        try:
+                            return next(out)
+                        except StopIteration:
+                            return _END
 
                 while True:
                     item = await loop.run_in_executor(pool, _next)
@@ -179,16 +242,23 @@ class Replica:
                         return
                     yield item
             elif hasattr(out, "__aiter__"):
-                async for item in out:
-                    yield item
+                with _rl.use_ledger(led):
+                    async for item in out:
+                        yield item
             elif isinstance(out, (list, tuple)):
                 for item in out:
                     yield item
             else:
                 yield out
+        except Exception as e:  # noqa: BLE001 — terminal classification
+            if led is not None:
+                led.finish(_terminal_of(e), type(e).__name__)
+            raise
         finally:
             self._ongoing -= 1
             self._observe_latency(time.monotonic() - t0)
+            if led is not None:
+                led.finish("ok")  # no-op if a terminal already landed
 
     # -- control plane ------------------------------------------------
     def _reject_if_saturated(self):
@@ -226,6 +296,14 @@ class Replica:
             "latency_sum_s": self._latency_sum_s,
             "latency_buckets": list(self._latency_buckets),
         }
+        # cumulative SLO counter block from the request ledger (slo.py
+        # shape): the controller delta-folds it into the deployment's
+        # burn-rate tracker.  Absent when telemetry never ran here.
+        slo_blk = _rl.slo_snapshot().get(
+            f"{self._app}/{self._deployment_name}"
+        )
+        if slo_blk is not None:
+            out["slo"] = slo_blk
         # user-callable load signals (reference: the pow-2 scheduler's
         # queue-len RPC): a deployment exposing `stats()` — e.g. the
         # continuous-batching LLM engine's queue depth / TTFT / block

@@ -26,6 +26,9 @@ from typing import Any, Dict, List, Optional
 import ray_tpu as rt
 from ray_tpu import exceptions as _exc
 from ray_tpu.core import rpc as _rpc
+from ray_tpu.metrics import metric_defs as _md
+from ray_tpu.serve import request_ledger as _rl
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -338,6 +341,12 @@ class Router:
             target = target.options(timeout_s=remaining)
         out = target.remote(method_name, *args, **kwargs)
 
+        # the request's trace context, captured on the submitting frame:
+        # the streaming watcher passes it to stream_wait_done so the
+        # stream's completion joins the request's trace instead of
+        # fragmenting (a NOT_SAMPLED marker propagates the negative
+        # decision and records nothing)
+        tctx = _tracing.current_context()
         t0 = time.monotonic()
 
         def _done(outcome: str):
@@ -417,7 +426,8 @@ class Router:
                     # terminal error envelope (None on clean end): a
                     # replica dying mid-stream must trip the breaker,
                     # not record a success
-                    env = await rt_.stream_wait_done(out.task_id)
+                    env = await rt_.stream_wait_done(out.task_id,
+                                                     trace_ctx=tctx)
                     if env is not None:
                         outcome = _classify(env)
                 else:
@@ -431,6 +441,32 @@ class Router:
 
         asyncio.run_coroutine_threadsafe(_watch(), rt_.loop)
         return out
+
+    def _enter_queue_wait(self):
+        """Ledger hook at assignment entry: the queue-wait phase covers
+        everything between request arrival at the router and a
+        successful replica pick.  Returns (ledger-or-None, t0); zero
+        work (and zero allocations) when telemetry is off."""
+        led = _rl.current()
+        if led is not None:
+            t0 = time.time()
+            led.begin("queue_wait", t0)
+            return led, t0
+        if _md.enabled():
+            return None, time.time()
+        return None, 0.0
+
+    def _leave_queue_wait(self, led, t_q0: float):
+        if led is not None:
+            # the phase duration feeds rt_serve_queue_wait_seconds at
+            # ledger finish — no direct observe here (double counting)
+            led.begin("replica")
+        elif t_q0:
+            _md.observe(
+                "rt_serve_queue_wait_seconds", time.time() - t_q0,
+                tags={"app": self._app, "deployment": self._deployment,
+                      "replica": "-"},
+            )
 
     def _enter_wait_or_reject(self):
         """Admission control at the router: a request that found no
@@ -482,11 +518,13 @@ class Router:
             else time.monotonic() + timeout_s
         backoff = 0.005
         waiting = False
+        led, t_q0 = self._enter_queue_wait()
         try:
             while True:
                 self._refresh()
                 info = self._try_pick(affinity)
                 if info is not None:
+                    self._leave_queue_wait(led, t_q0)
                     return self._submit(info, method_name, args, kwargs,
                                         streaming=streaming,
                                         deadline_s=deadline_s)
@@ -513,11 +551,13 @@ class Router:
             else time.monotonic() + timeout_s
         backoff = 0.005
         waiting = False
+        led, t_q0 = self._enter_queue_wait()
         try:
             while True:
                 await self._refresh_async()
                 info = self._try_pick(affinity)
                 if info is not None:
+                    self._leave_queue_wait(led, t_q0)
                     return self._submit(info, method_name, args, kwargs,
                                         streaming=streaming,
                                         deadline_s=deadline_s)

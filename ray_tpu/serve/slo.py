@@ -1,0 +1,257 @@
+"""SLO burn-rate tracking for serve deployments.
+
+Reference: the multi-window burn-rate alerting model (SRE workbook ch.5)
+— an SLO like "99% of requests see TTFT under 200ms" defines an error
+budget (1%), and the *burn rate* over a window is the fraction of the
+budget the deployment is currently consuming per unit time: burn 1.0
+means exactly on budget, burn 14.4 over 5 minutes means the monthly
+budget gone in two days.
+
+The controller is the natural place to compute this: replicas already
+piggyback their metrics on health checks, so each replica ships a
+compact cumulative counter block (request count, error count, and
+per-bucket TTFT/e2e latency counts over the cataloged boundaries) and
+the controller folds the per-replica deltas into a deployment-cumulative
+series (`BurnRateTracker`).  Burn rates are then windowed differences of
+that series — no per-request state crosses the wire, and replica
+restarts fold in as zero-delta resets exactly like the router stats.
+
+Latency targets are snapped to the catalog's bucket resolution
+(`metric_defs._LATENCY_S`): a request landing in the bucket that
+CONTAINS the target counts as bad, so the reported burn rate is
+conservative (never under-reports a violation).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.metrics.metric_defs import _LATENCY_S
+
+# shared bucket boundaries for the ledger's SLO counter blocks; the
+# final implicit bucket is +Inf, so a counter block has len(BOUNDS)+1
+# entries
+BOUNDS: Tuple[float, ...] = _LATENCY_S
+
+# burn-rate windows (seconds): short/medium/long, the classic
+# multi-window set — a short-window spike confirms the long-window
+# signal is current, the long window keeps one blip from paging
+DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 300.0, 3600.0)
+
+
+@dataclass
+class SLOConfig:
+    """Per-deployment service-level objectives.
+
+    `objective` is the target success fraction (0.99 == "99% of
+    requests meet each latency target"); its complement is the error
+    budget that burn rates are measured against.  `target_error_rate`
+    overrides the budget for the error-rate dimension only (defaults to
+    the same 1 - objective budget)."""
+
+    target_ttft_s: Optional[float] = None
+    target_e2e_s: Optional[float] = None
+    target_error_rate: Optional[float] = None
+    objective: float = 0.99
+    windows: Tuple[float, ...] = DEFAULT_WINDOWS
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        for t in (self.target_ttft_s, self.target_e2e_s):
+            if t is not None and t <= 0:
+                raise ValueError("latency targets must be positive")
+        if self.target_error_rate is not None and not (
+                0.0 < self.target_error_rate < 1.0):
+            raise ValueError("target_error_rate must be in (0, 1)")
+        self.windows = tuple(sorted(float(w) for w in self.windows))
+        if not self.windows:
+            raise ValueError("at least one burn-rate window is required")
+
+    def has_any(self) -> bool:
+        return (self.target_ttft_s is not None
+                or self.target_e2e_s is not None
+                or self.target_error_rate is not None)
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.objective
+
+
+def empty_counters() -> Dict[str, Any]:
+    """A zeroed cumulative counter block (the shape replicas ship)."""
+    n = len(BOUNDS) + 1
+    return {"n": 0, "errors": 0, "ttft": [0] * n, "e2e": [0] * n}
+
+
+def bucket_index(value_s: float) -> int:
+    """Index of the (non-cumulative) bucket a latency lands in."""
+    return bisect.bisect_left(BOUNDS, value_s)
+
+
+def bad_fraction(delta: Dict[str, Any], dim: str,
+                 target_s: float) -> Optional[float]:
+    """Fraction of requests in `delta` whose `dim` latency exceeded
+    `target_s`, judged at bucket resolution (the bucket containing the
+    target counts as bad).  None when the window saw no requests."""
+    counts = delta.get(dim)
+    if not counts:
+        return None
+    total = sum(counts)
+    if total <= 0:
+        return None
+    # buckets with upper boundary <= target are definitively good
+    good = sum(counts[:bisect.bisect_right(BOUNDS, target_s)])
+    return (total - good) / total
+
+
+class BurnRateTracker:
+    """Deployment-cumulative SLO counter series with windowed burn-rate
+    queries.  `fold()` ingests one replica's cumulative block (deltas
+    are clamped at zero so a replica restart folds in as a reset, the
+    same contract as the controller's router-stats folding);
+    `snapshot()` appends the current totals to a bounded time ring;
+    `burn_rates()` reads windowed differences off the ring."""
+
+    # ring sized to cover the longest default window at the controller's
+    # >=1s snapshot throttle
+    RING = 4000
+    MIN_SNAP_INTERVAL_S = 1.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, Dict[str, Any]] = {}
+        self._totals = empty_counters()
+        self._ring: deque = deque(maxlen=self.RING)
+
+    def forget_replica(self, replica_id: str):
+        with self._lock:
+            self._last_seen.pop(replica_id, None)
+
+    def fold(self, replica_id: str, counters: Optional[Dict[str, Any]]):
+        if not counters:
+            return
+        with self._lock:
+            prev = self._last_seen.get(replica_id) or empty_counters()
+            tot = self._totals
+            tot["n"] += max(0, int(counters.get("n", 0)) - prev["n"])
+            tot["errors"] += max(
+                0, int(counters.get("errors", 0)) - prev["errors"])
+            for dim in ("ttft", "e2e"):
+                cur = counters.get(dim) or []
+                old = prev[dim]
+                agg = tot[dim]
+                for i in range(min(len(cur), len(agg))):
+                    o = old[i] if i < len(old) else 0
+                    agg[i] += max(0, int(cur[i]) - o)
+            self._last_seen[replica_id] = {
+                "n": int(counters.get("n", 0)),
+                "errors": int(counters.get("errors", 0)),
+                "ttft": [int(c) for c in (counters.get("ttft") or [])],
+                "e2e": [int(c) for c in (counters.get("e2e") or [])],
+            }
+
+    def snapshot(self, now: Optional[float] = None):
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._ring and now - self._ring[-1][0] < \
+                    self.MIN_SNAP_INTERVAL_S:
+                return
+            self._ring.append((now, {
+                "n": self._totals["n"],
+                "errors": self._totals["errors"],
+                "ttft": list(self._totals["ttft"]),
+                "e2e": list(self._totals["e2e"]),
+            }))
+
+    def _delta_over(self, window_s: float,
+                    now: float) -> Tuple[float, Dict[str, Any]]:
+        """(actual_window_s, counter deltas) against the newest ring
+        entry at least `window_s` old (oldest entry when the ring does
+        not yet span the window)."""
+        cutoff = now - window_s
+        base_ts, base = self._ring[0]
+        for ts, snap in reversed(self._ring):
+            if ts <= cutoff:
+                base_ts, base = ts, snap
+                break
+        head_ts, head = self._ring[-1]
+        delta = {
+            "n": head["n"] - base["n"],
+            "errors": head["errors"] - base["errors"],
+            "ttft": [h - b for h, b in zip(head["ttft"], base["ttft"])],
+            "e2e": [h - b for h, b in zip(head["e2e"], base["e2e"])],
+        }
+        return max(head_ts - base_ts, 1e-9), delta
+
+    def burn_rates(self, cfg: SLOConfig,
+                   now: Optional[float] = None) -> Dict[str, Any]:
+        """Burn rate per window per dimension: observed bad fraction
+        over the window divided by the error budget.  1.0 == consuming
+        exactly the budget; None == no data / no target."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if not self._ring:
+                return {"windows": {}, "requests_total": 0}
+            out: Dict[str, Any] = {"windows": {}}
+            for w in cfg.windows:
+                span_s, delta = self._delta_over(w, now)
+                row: Dict[str, Any] = {
+                    "window_s": w,
+                    "actual_window_s": round(span_s, 3),
+                    "requests": delta["n"],
+                }
+                budget = cfg.error_budget
+                if cfg.target_ttft_s is not None:
+                    frac = bad_fraction(delta, "ttft", cfg.target_ttft_s)
+                    row["ttft_burn"] = (
+                        None if frac is None else frac / budget)
+                if cfg.target_e2e_s is not None:
+                    frac = bad_fraction(delta, "e2e", cfg.target_e2e_s)
+                    row["e2e_burn"] = (
+                        None if frac is None else frac / budget)
+                err_budget = (cfg.target_error_rate
+                              if cfg.target_error_rate is not None
+                              else budget)
+                if delta["n"] > 0:
+                    row["error_burn"] = (
+                        delta["errors"] / delta["n"]) / err_budget
+                else:
+                    row["error_burn"] = None
+                out["windows"][str(int(w))] = row
+            out["requests_total"] = self._ring[-1][1]["n"]
+            return out
+
+
+def status_for(tracker: Optional[BurnRateTracker],
+               cfg: Optional[SLOConfig]) -> Dict[str, Any]:
+    """The `/api/slo` row for one deployment: configured targets plus
+    current burn rates and an `ok` verdict (every computed burn <= 1)."""
+    if cfg is None or not cfg.has_any():
+        return {"configured": False}
+    row: Dict[str, Any] = {
+        "configured": True,
+        "objective": cfg.objective,
+        "targets": {
+            "ttft_s": cfg.target_ttft_s,
+            "e2e_s": cfg.target_e2e_s,
+            "error_rate": (cfg.target_error_rate
+                           if cfg.target_error_rate is not None
+                           else cfg.error_budget),
+        },
+    }
+    rates = (tracker.burn_rates(cfg) if tracker is not None
+             else {"windows": {}, "requests_total": 0})
+    row.update(rates)
+    burns: List[float] = [
+        v for win in rates["windows"].values()
+        for k, v in win.items()
+        if k.endswith("_burn") and v is not None
+    ]
+    row["ok"] = all(b <= 1.0 for b in burns) if burns else True
+    return row

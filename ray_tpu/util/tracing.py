@@ -319,6 +319,27 @@ def finish_span(span_rec: Optional[Dict[str, Any]],
     _record(span_rec)
 
 
+def new_id() -> str:
+    """Public id maker for out-of-band span builders (the serve request
+    ledger constructs its span tree lazily and only commits it at
+    terminal time via `record_spans`)."""
+    return _new_id()
+
+
+def record_spans(spans: List[Dict[str, Any]]):
+    """Commit a batch of pre-built span dicts to the ring + export
+    queue, bypassing head sampling.  This is the tail-capture hook: the
+    serve ledger buffers a request's phase spans locally and calls this
+    only when the request turns out to matter (slowest-K% latency, or
+    shed/rejected) — even when the head-sampling roll at the root said
+    drop.  No-op when tracing is off."""
+    if not is_enabled():
+        return
+    for s in spans:
+        if s.get("trace_id"):
+            _record(s)
+
+
 class use_context:
     """Temporarily install `ctx` as the ambient trace context (set +
     reset in the same frame — safe inside generator bodies).  None is

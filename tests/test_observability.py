@@ -293,3 +293,96 @@ def test_dashboard_timeline_and_metrics_exposition(obs_cluster):
             rt.kill(head)
         except Exception as e:
             print(f"dashboard teardown: {e}")  # best-effort cleanup
+
+
+# ---------------------------------------------------------------------
+# request-level serve telemetry (PR 17): streaming trace continuity +
+# SLO burn-rate flow
+# ---------------------------------------------------------------------
+@serve.deployment
+class _ObsStreamer:
+    def tokens(self, n):
+        for i in range(int(n)):
+            yield f"tok{i}"
+
+
+def test_streaming_request_one_trace_end_to_end(obs_cluster):
+    """Satellite fix: the streaming serve path keeps ONE trace id from
+    the caller's root span through the replica-side request ledger to
+    the stream-done instant — no orphan fragment traces on the
+    generator drive."""
+    serve.run(_ObsStreamer.bind(), name="obsstream",
+              route_prefix="/obsstream")
+    tracing.clear_spans()
+    with tracing.span("stream-e2e-root"):
+        h = serve.get_app_handle("obsstream").options(stream=True)
+        out = list(h.tokens.remote(3))
+    assert out == ["tok0", "tok1", "tok2"]
+    root = [s for s in tracing.get_spans()
+            if s["name"] == "stream-e2e-root"][-1]
+    trace_id = root["trace_id"]
+    # caller side: the stream watcher stamps its terminal instant into
+    # THIS trace (the satellite's stream_wait_done propagation fix)
+    assert any(s["name"] == "stream_done" and s["trace_id"] == trace_id
+               for s in tracing.get_spans())
+    # collected cluster-wide: the replica's ledger joined the SAME
+    # trace — serve.request root with its execute phase child — and the
+    # producer-side stream span rode it too
+    spans = _controller_spans(trace_id, min_procs=2)
+    assert spans and all(s["trace_id"] == trace_id for s in spans)
+    names = {s["name"] for s in spans}
+    led_roots = [s for s in spans
+                 if s["name"] == "serve.request:_ObsStreamer"]
+    assert led_roots, f"no ledger root in {sorted(names)}"
+    rid = led_roots[-1]["span_id"]
+    assert any(s["name"] == "serve.execute"
+               and s.get("parent_id") == rid for s in spans)
+    assert any(s["name"].startswith("stream:") for s in spans), names
+
+
+@serve.deployment(health_check_period_s=0.2,
+                  slo_config={"target_ttft_s": 1.0, "target_e2e_s": 5.0})
+class _SLOEcho:
+    def __call__(self, request):
+        return "ok"
+
+
+def test_slo_burn_rates_flow_to_status_and_api(obs_cluster):
+    """SLO flow e2e: replica ledger counters ride the health piggyback
+    into the controller's BurnRateTracker and come back out through
+    `rt.slo_status()` and the dashboard's `/api/slo`."""
+    from ray_tpu.dashboard import start_dashboard
+
+    h = serve.run(_SLOEcho.bind(), name="sloapp", route_prefix="/sloapp")
+    for _ in range(5):
+        assert h.remote(None).result(timeout_s=30) == "ok"
+    deadline = time.time() + 30
+    row = {}
+    while time.time() < deadline:
+        row = rt.slo_status().get("sloapp", {}).get("_SLOEcho", {})
+        if row.get("requests_total", 0) >= 5:
+            break
+        time.sleep(0.3)
+    assert row.get("configured") is True, row
+    assert row["requests_total"] >= 5
+    assert row["targets"] == {"ttft_s": 1.0, "e2e_s": 5.0,
+                              "error_rate": pytest.approx(0.01)}
+    assert set(row["windows"]) == {"60", "300", "3600"}
+    w = row["windows"]["60"]
+    assert w["error_burn"] == 0.0  # no failures: no budget burned
+    assert w["e2e_burn"] == 0.0    # echo latency nowhere near 5 s
+    assert row["ok"] is True
+    # the dashboard serves the same rows
+    head, (host, port) = start_dashboard()
+    try:
+        status, body = _http_get(f"http://{host}:{port}/api/slo")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["sloapp"]["_SLOEcho"]["configured"] is True
+        assert doc["sloapp"]["_SLOEcho"]["requests_total"] >= 5
+    finally:
+        try:
+            rt.get(head.stop.remote(), timeout=5)
+            rt.kill(head)
+        except Exception as e:
+            print(f"dashboard teardown: {e}")  # best-effort cleanup

@@ -197,13 +197,17 @@ def test_obs_overhead_row():
     - the overhead number is well-formed and the plane cannot cost a
       structural multiple of throughput (CI boxes are too noisy to
       gate the 3% budget itself — an off-vs-off control shows ±4%
-      phantom overhead at this storm size)."""
+      phantom overhead at this storm size);
+    - the serve-path half ran the same alternating A/B on the CB
+      engine and its 'on' phases PROVED the ledger fired (every storm
+      request landed an e2e histogram observation)."""
     from ray_tpu.scripts.perf import main
 
     results = main([
         "--config", "obs_overhead",
         "--obs-storm-n", "300",
         "--obs-rounds", "2",
+        "--obs-serve-requests", "8",
         "--num-workers", "2",
     ])
     row = results["obs_overhead"]
@@ -211,6 +215,11 @@ def test_obs_overhead_row():
     assert results["metrics_on"]["tasks_per_s"] > 0
     assert row["instrumented"] == 1.0
     assert -50.0 < row["overhead_pct"] < 50.0
+    srow = results["serve_obs_overhead"]
+    assert results["serve_obs_off"]["tokens_per_sec"] > 0
+    assert results["serve_obs_on"]["tokens_per_sec"] > 0
+    assert srow["instrumented"] == 1.0
+    assert -50.0 < srow["overhead_pct"] < 50.0
 
 
 def test_rllib_ppo_row():

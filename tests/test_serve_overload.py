@@ -273,7 +273,7 @@ def _metrics(rid="a", ongoing=0, depth=0.0, ttft=0.0, shed=0.0,
         "ongoing": ongoing,
         "rejected": rejected,
         "engine_queue_depth": depth,
-        "user_stats": {"queue_depth": depth, "ttft_ema_s": ttft,
+        "user_stats": {"queue_depth": depth, "ttft_p90_s": ttft,
                        "shed_total": shed, "rejected_total": 0.0},
     }
 
@@ -287,8 +287,13 @@ def test_slo_policy_pressure_signals():
                            hysteresis=0.1)
     assert ac.has_slo()
     p = AutoscalingPolicy(ac)
-    # idle override: a stale lifetime TTFT EMA must not pin replicas up
-    assert p.pressure([_metrics(ttft=9.0)]) == 0.0
+    # empty fleet: nothing reports, nothing scales
+    assert p.pressure([]) == 0.0
+    # a breached windowed TTFT p90 asserts pressure even with nothing
+    # in flight — the ENGINE's sample window decays the reading
+    # (tests/test_llm_engine.py pins that), not the policy; the old
+    # idle override existed only for the non-decaying lifetime EMA
+    assert p.pressure([_metrics(ttft=0.9)]) == pytest.approx(9.0)
     # loaded: the binding SLO (worst-replica TTFT at 3x) drives r
     r = p.pressure([_metrics(ongoing=1, depth=8.0, ttft=0.3)])
     assert r == pytest.approx(3.0)
@@ -469,7 +474,7 @@ def test_slo_autoscaler_scales_up_down_with_graceful_drain(serve_instance):
 
             raw = get_runtime().kv_get(_LOAD_KEY)
             if not raw:
-                return {"queue_depth": 0.0, "ttft_ema_s": 0.0}
+                return {"queue_depth": 0.0, "ttft_p90_s": 0.0}
             return json.loads(raw)
 
         async def work(self, duration_s):
@@ -499,7 +504,7 @@ def test_slo_autoscaler_scales_up_down_with_graceful_drain(serve_instance):
     assert _running() == 1
     # sustained overload: TTFT 5x over SLO + real backlog
     _kv_put(_LOAD_KEY, json.dumps(
-        {"queue_depth": 8.0, "ttft_ema_s": 0.5}
+        {"queue_depth": 8.0, "ttft_p90_s": 0.5}
     ).encode())
     deadline = time.time() + 60
     while time.time() < deadline and _running() < 2:
@@ -510,7 +515,7 @@ def test_slo_autoscaler_scales_up_down_with_graceful_drain(serve_instance):
     # must drain victims gracefully, not drop their work
     responses = [h.work.remote(3.0) for _ in range(6)]
     _kv_put(_LOAD_KEY, json.dumps(
-        {"queue_depth": 0.0, "ttft_ema_s": 0.0}
+        {"queue_depth": 0.0, "ttft_p90_s": 0.0}
     ).encode())
     assert all(r.result(timeout_s=60) == "ok" for r in responses)
     deadline = time.time() + 60
