@@ -416,7 +416,10 @@ class ResultCoalescer:
             return
         if not self._scheduled:
             self._scheduled = True
-            self.rt.loop.call_soon(self._flush_all)
+            # enqueue() runs entirely on rt.loop (completion delivery
+            # is loop-affine), so plain call_soon is the cheap and
+            # correct same-thread schedule here
+            self.rt.loop.call_soon(self._flush_all)  # rtlint: disable=RT011
 
     def _flush_all(self):
         self._scheduled = False

@@ -212,6 +212,13 @@ class Config:
     #: advertised in node registration (`get_nodes` → "metrics_port").
     metrics_http_port: int = 0
 
+    # ---- sanitizer (ray_tpu/util/sanitizer.py, RT_SANITIZE=1) --------
+    #: event-loop lag watchdog threshold: a single callback holding a
+    #: registered loop longer than this many ms is reported (with the
+    #: offending callable) when the sanitizer is on; 0 disables the
+    #: watchdog while keeping lock-order/leak checks
+    sanitize_loop_lag_ms: float = 500.0
+
     # ---- paths -------------------------------------------------------
     session_dir: str = ""  # filled at init: /tmp/ray_tpu/session_<ts>
 

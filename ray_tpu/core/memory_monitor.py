@@ -45,7 +45,9 @@ def _system_memory() -> Tuple[int, int]:
     reference does (memory_monitor.cc GetLinuxMemoryBytes)."""
     total = avail = None
     try:
-        with open("/proc/meminfo") as f:
+        # procfs reads are memory-backed (microseconds, no disk) —
+        # safe on the daemon loop's periodic check
+        with open("/proc/meminfo") as f:  # rtlint: disable=RT009
             for line in f:
                 if line.startswith("MemTotal:"):
                     total = int(line.split()[1]) * 1024

@@ -437,7 +437,11 @@ class NodeDaemon:
         proc = subprocess.Popen(
             argv,
             env=env,
-            stdout=open(os.path.join(self.session_dir, "logs", f"worker-{time.time():.0f}-{os.urandom(2).hex()}.out"), "wb"),
+            # worker spawn is deliberately synchronous on the daemon
+            # loop (lease-grant ordering); the log-file open is a
+            # bounded local create dwarfed by the fork+exec beside it,
+            # and spawns are rare
+            stdout=open(os.path.join(self.session_dir, "logs", f"worker-{time.time():.0f}-{os.urandom(2).hex()}.out"), "wb"),  # rtlint: disable=RT009
             stderr=subprocess.STDOUT,
         )
         # booting = spawned but not yet registered; token membership

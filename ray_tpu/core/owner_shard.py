@@ -38,6 +38,7 @@ from ray_tpu.core import rpc
 from ray_tpu.core.retry import backoff_delay_s
 from ray_tpu.core.task_spec import TaskResult, TaskSpec
 from ray_tpu.metrics import metric_defs as _mdefs
+from ray_tpu.util import sanitizer as _sanitizer
 
 logger = logging.getLogger(__name__)
 
@@ -116,13 +117,19 @@ class OwnerShard:
         self.loop: asyncio.AbstractEventLoop = (
             rt.loop if shared else asyncio.new_event_loop()
         )
+        if not shared:
+            _sanitizer.register_loop(
+                self.loop, f"rt-owner-{index}", audit_timers=False
+            )
         self.noded: Optional[rpc.Connection] = None
         self.thread: Optional[threading.Thread] = None
         self.native_tid: Optional[int] = None
         # guards pools/conn_lease/counters; NEVER held across an await
         # and NEVER taken before acquiring rt._state_lock (lock order:
         # _state_lock outer, shard.lock inner)
-        self.lock = threading.Lock()
+        self.lock = _sanitizer.wrap_lock(
+            threading.Lock(), f"shard[{index}].lock", _sanitizer.SHARD_LOCK
+        )
         self.pools: Dict[tuple, LeasePool] = {}
         self.conn_lease: Dict[rpc.Connection, Tuple[LeasePool, Lease]] = {}
         self.lease_timers: set = set()

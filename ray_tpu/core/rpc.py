@@ -36,6 +36,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 from ray_tpu.core import wire
 from ray_tpu.core.serialization import dumps_oob as _dumps_oob
 from ray_tpu.core.serialization import loads as _loads_oob
+from ray_tpu.util import sanitizer as _sanitizer
 
 logger = logging.getLogger(__name__)
 
@@ -487,7 +488,10 @@ class Connection:
         self.serial = next(_conn_serials)
         self._pending: Dict[int, asyncio.Future] = {}
         self._outbox: list = []
-        self._outbox_lock = threading.Lock()
+        self._outbox_lock = _sanitizer.wrap_lock(
+            threading.Lock(), "rpc.Connection._outbox_lock",
+            _sanitizer.LEAF_LOCK,
+        )
         self._flush_scheduled = False
         self._closed = False
         self._hello_seen = False
@@ -543,7 +547,10 @@ class Connection:
             if self._flush_scheduled:
                 return
             self._flush_scheduled = True
-        self._loop.call_soon(self._flush)
+        # _enqueue is only reached from coroutines already on this
+        # conn's loop (cross-thread senders go through send_threadsafe
+        # / call_on_conn_loop), so the selector is awake by definition
+        self._loop.call_soon(self._flush)  # rtlint: disable=RT011
 
     def send_threadsafe(self, method: str, payload: Any = None):
         """Fire-and-forget from any thread.  Frames are pickled on the

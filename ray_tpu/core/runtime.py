@@ -72,6 +72,7 @@ from ray_tpu.core.task_spec import (
     function_id_of,
 )
 from ray_tpu.shm import ObjectNotFoundError, ShmStore
+from ray_tpu.util import sanitizer as _sanitizer
 
 logger = logging.getLogger(__name__)
 
@@ -278,6 +279,7 @@ class Runtime:
         self.worker_id = WorkerID.random()
         self.node_id: str = ""
         self.loop = asyncio.new_event_loop()
+        _sanitizer.register_loop(self.loop, "rt-io", audit_timers=False)
         self._io_thread = threading.Thread(
             target=self._run_loop, name="rt-io", daemon=True
         )
@@ -289,7 +291,10 @@ class Runtime:
 
         # owner-side state; _state_lock guards dict mutation from the
         # submitting thread; the io thread holds it in result handlers
-        self._state_lock = threading.RLock()
+        self._state_lock = _sanitizer.wrap_lock(
+            threading.RLock(), "runtime._state_lock",
+            _sanitizer.RUNTIME_STATE_LOCK,
+        )
         self.objects: Dict[bytes, _ObjectState] = {}
         self.refs: Dict[bytes, _RefCount] = {}
         self.pending_tasks: Dict[bytes, _PendingTask] = {}
@@ -1492,7 +1497,11 @@ class Runtime:
             if cached is not None and cached[0] == sig:
                 entries.append((cached[1], cached[2]))
                 continue
-            [(name, key, pkg_blob)] = package_py_modules([root])
+            # deflate over a whole module tree takes long enough to
+            # stall every task on the loop — zip off-loop
+            [(name, key, pkg_blob)] = await self.loop.run_in_executor(
+                None, package_py_modules, [root]
+            )
             if key not in uploaded and not await self.controller.call(
                 "kv_exists", {"key": key}
             ):

@@ -35,7 +35,9 @@ def _load_spa() -> str:
 
         path = os.path.join(os.path.dirname(__file__), "app.html")
         try:
-            with open(path, encoding="utf-8") as f:
+            # read-once, cached for the process lifetime (the module
+            # docstring's no-per-request-disk-IO contract)
+            with open(path, encoding="utf-8") as f:  # rtlint: disable=RT009
                 _SPA_CACHE = f.read()
         except OSError:
             _SPA_CACHE = _FALLBACK_PAGE

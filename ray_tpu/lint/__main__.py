@@ -49,6 +49,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-rule finding counts and wall time",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -69,7 +74,21 @@ def main(argv=None) -> int:
         if args.select
         else None
     )
-    findings = lint_paths(paths, select=select)
+    stats: dict = {}
+    findings = lint_paths(paths, select=select, stats=stats)
+    if args.stats:
+        total = stats.pop("_total", {"findings": 0.0, "seconds": 0.0})
+        print(f"{'rule':<8}{'findings':>10}{'seconds':>10}")
+        for rule in sorted(stats):
+            row = stats[rule]
+            print(
+                f"{rule:<8}{int(row['findings']):>10}"
+                f"{row['seconds']:>10.3f}"
+            )
+        print(
+            f"{'total':<8}{int(total['findings']):>10}"
+            f"{total['seconds']:>10.3f}"
+        )
 
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:

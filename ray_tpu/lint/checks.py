@@ -52,6 +52,51 @@ def _numeric_constant(node: ast.AST) -> bool:
 
 
 # ----------------------------------------------------------------------
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "os.system",
+    "os.popen",
+    "os.waitpid",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.request",
+}
+# unambiguous blocking method names, matched without receiver type
+_BLOCKING_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+def blocking_label(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
+    """Label of a known-blocking call, or None.  Shared by RT001
+    (direct blocking in `async def`) and RT009 (blocking reachable
+    from `async def` through the call graph)."""
+    cn = mod.canonical(call.func)
+    if cn in _BLOCKING_CALLS:
+        return f"{cn}()"
+    if cn == "open" and "open" not in mod.aliases:
+        return "open()"
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in _BLOCKING_METHODS:
+            return f".{call.func.attr}()"
+        # chained `...submit(...).result()` / run_coroutine_threadsafe
+        if call.func.attr == "result" and isinstance(
+            call.func.value, ast.Call
+        ):
+            inner = call.func.value.func
+            if _last_segment(inner) in (
+                "submit",
+                "run_coroutine_threadsafe",
+            ):
+                return f"{_last_segment(inner)}(...).result()"
+    return None
+
+
 @register
 class BlockingInAsync(Check):
     """RT001: a blocking call on an event-loop path stalls every task
@@ -66,25 +111,6 @@ class BlockingInAsync(Check):
         "run_in_executor"
     )
 
-    _CALLS = {
-        "time.sleep",
-        "subprocess.run",
-        "subprocess.call",
-        "subprocess.check_call",
-        "subprocess.check_output",
-        "subprocess.getoutput",
-        "os.system",
-        "os.popen",
-        "os.waitpid",
-        "socket.create_connection",
-        "urllib.request.urlopen",
-        "requests.get",
-        "requests.post",
-        "requests.request",
-    }
-    # unambiguous blocking method names, matched without receiver type
-    _METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
-
     def visit_module(self, mod: ModuleInfo) -> Iterable[Finding]:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.AsyncFunctionDef):
@@ -92,7 +118,7 @@ class BlockingInAsync(Check):
             for sub in shallow_walk(node.body):
                 if not isinstance(sub, ast.Call):
                     continue
-                label = self._blocking_label(sub, mod)
+                label = blocking_label(sub, mod)
                 if label:
                     yield Finding(
                         self.rule,
@@ -103,29 +129,6 @@ class BlockingInAsync(Check):
                         f"{node.name}` stalls the event loop — await "
                         f"the async equivalent or run_in_executor",
                     )
-
-    def _blocking_label(
-        self, call: ast.Call, mod: ModuleInfo
-    ) -> Optional[str]:
-        cn = mod.canonical(call.func)
-        if cn in self._CALLS:
-            return f"{cn}()"
-        if cn == "open" and "open" not in mod.aliases:
-            return "open()"
-        if isinstance(call.func, ast.Attribute):
-            if call.func.attr in self._METHODS:
-                return f".{call.func.attr}()"
-            # chained `...submit(...).result()` / run_coroutine_threadsafe
-            if call.func.attr == "result" and isinstance(
-                call.func.value, ast.Call
-            ):
-                inner = call.func.value.func
-                if _last_segment(inner) in (
-                    "submit",
-                    "run_coroutine_threadsafe",
-                ):
-                    return f"{_last_segment(inner)}(...).result()"
-        return None
 
 
 # ----------------------------------------------------------------------

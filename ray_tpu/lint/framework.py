@@ -14,6 +14,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -55,6 +56,13 @@ class ModuleInfo:
         self.source = source
         self.tree = tree
         self.aliases = _import_aliases(tree)
+        # dotted import path of this module ("ray_tpu/core/retry.py" ->
+        # "ray_tpu.core.retry"); the interprocedural pass keys its
+        # project-wide function table on it
+        self.dotted = (
+            path.removesuffix(".py").removesuffix("/__init__")
+            .replace("/", ".")
+        )
 
     def canonical(self, node: ast.AST) -> str:
         """Dotted name of a Name/Attribute expr with the first segment
@@ -129,8 +137,18 @@ class Check:
     rule: str = "RT000"
     name: str = ""
     description: str = ""
+    #: lint root (absolute path); set by `lint_paths` before any visit
+    #: so checks that consult non-Python project files (docs/ knob
+    #: tables, the baseline) resolve them against the tree under lint.
+    root: str = ""
 
     def visit_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def visit_project(self, mods: Sequence[ModuleInfo]) -> Iterable[Finding]:
+        """Called once after every module's `visit_module`, with ALL
+        parsed modules — the interprocedural checks (call graph,
+        catalog drift) do their whole-program reasoning here."""
         return ()
 
     def finalize(self) -> Iterable[Finding]:
@@ -155,6 +173,7 @@ def rule_catalog() -> List[Tuple[str, str, str]]:
 def _load_checks() -> None:
     if not _REGISTRY:
         from ray_tpu.lint import checks  # noqa: F401  (registers on import)
+        from ray_tpu.lint import concurrency  # noqa: F401  (RT009-RT013)
 
 
 # ----------------------------------------------------------------------
@@ -228,17 +247,26 @@ def lint_paths(
     *,
     select: Optional[Set[str]] = None,
     root: Optional[str] = None,
+    stats: Optional[Dict[str, Dict[str, float]]] = None,
 ) -> List[Finding]:
     """Run every registered check over `paths`; findings come back
     suppression-filtered and sorted.  `root` anchors the relative paths
-    findings carry (default: the repo root)."""
+    findings carry (default: the repo root).  Passing a dict as `stats`
+    fills it with per-rule accounting: {rule: {"findings": n,
+    "seconds": wall}} plus a "_total" row (the `--stats` CLI view and
+    the tier-1 interprocedural-pass time budget read it)."""
     _load_checks()
     root = os.path.abspath(root or _REPO_ROOT)
     checks = [cls() for cls in _REGISTRY]
     if select:
         checks = [c for c in checks if c.rule in select]
+    t_start = time.perf_counter()
+    spent: Dict[str, float] = {c.rule: 0.0 for c in checks}
+    for check in checks:
+        check.root = root
     raw: List[Finding] = []
     sup: Dict[str, Tuple[Dict[int, Set[str]], Set[str]]] = {}
+    mods: List[ModuleInfo] = []
     for abspath in iter_py_files([os.path.abspath(p) for p in paths]):
         rel = os.path.relpath(abspath, root).replace(os.sep, "/")
         if rel.startswith("../"):  # outside the root: keep it readable
@@ -253,16 +281,36 @@ def lint_paths(
             continue
         sup[rel] = _suppressions(source)
         mod = ModuleInfo(rel, source, tree)
+        mods.append(mod)
         for check in checks:
+            t0 = time.perf_counter()
             raw.extend(check.visit_module(mod))
+            spent[check.rule] += time.perf_counter() - t0
     for check in checks:
+        t0 = time.perf_counter()
+        raw.extend(check.visit_project(mods))
         raw.extend(check.finalize())
+        spent[check.rule] += time.perf_counter() - t0
     out = [
         f
         for f in raw
         if f.path not in sup or not _suppressed(f, *sup[f.path])
     ]
-    return sorted(set(out), key=lambda f: (f.path, f.line, f.col, f.rule))
+    out = sorted(set(out), key=lambda f: (f.path, f.line, f.col, f.rule))
+    if stats is not None:
+        per_rule: Dict[str, int] = {}
+        for f in out:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        for check in checks:
+            stats[check.rule] = {
+                "findings": float(per_rule.get(check.rule, 0)),
+                "seconds": spent[check.rule],
+            }
+        stats["_total"] = {
+            "findings": float(len(out)),
+            "seconds": time.perf_counter() - t_start,
+        }
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -24,11 +24,17 @@ from ray_tpu.serve.controller import (
     ServeController,
 )
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.util import sanitizer as _sanitizer
 
 logger = logging.getLogger(__name__)
 
 _state: Dict[str, Any] = {}
-_state_lock = threading.Lock()
+# outermost in the declared order: start() holds it across rt.get()
+# while the controller ping round-trips, so runtime._state_lock nests
+# inside it (see ray_tpu/util/sanitizer.py for the full order table)
+_state_lock = _sanitizer.wrap_lock(
+    threading.Lock(), "serve.api._state_lock", _sanitizer.SERVE_STATE_LOCK
+)
 
 
 # ----------------------------------------------------------------------

@@ -17,6 +17,8 @@ import re
 import subprocess
 import threading
 
+from ray_tpu.util import sanitizer as _sanitizer
+
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -44,7 +46,9 @@ def _ensure_built() -> str:
     with _build_lock:
         if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
             tmp = _LIB + f".tmp.{os.getpid()}"
-            subprocess.run(
+            # one-time native build at first touch, cached on mtime;
+            # any caller (sync or async) accepts the startup hit
+            subprocess.run(  # rtlint: disable=RT009
                 ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC, "-lpthread", "-lrt"],
                 check=True,
                 capture_output=True,
@@ -255,10 +259,16 @@ class ShmStore:
         rc = _load().rts_create_ex(self._h, _pad_id(object_id), size,
                                    ctypes.byref(off), 1 if allow_evict else 0)
         _check(rc, f"create {object_id.hex()}")
+        _sanitizer.note_acquire(
+            "store-create", object_id.hex(),
+            f"object {object_id.hex()} ({size}B) created but never "
+            "sealed/aborted — pins arena and wedges readers",
+        )
         return self._view[off.value : off.value + size]
 
     def seal(self, object_id: bytes):
         _check(_load().rts_seal(self._h, _pad_id(object_id)), f"seal {object_id.hex()}")
+        _sanitizer.note_release("store-create", object_id.hex())
 
     def put(self, object_id: bytes, data, allow_evict: bool = True) -> None:
         """create + copy + seal in one call."""
@@ -282,6 +292,8 @@ class ShmStore:
 
     def delete(self, object_id: bytes) -> bool:
         rc = _load().rts_delete(self._h, _pad_id(object_id))
+        if rc == OK:
+            _sanitizer.note_release("store-create", object_id.hex())
         return rc == OK
 
     def abort(self, object_id: bytes) -> bool:
@@ -296,6 +308,7 @@ class ShmStore:
         lib = _load()
         oid = _pad_id(object_id)
         lib.rts_release(self._h, oid)
+        _sanitizer.note_release("store-create", object_id.hex())
         return lib.rts_delete(self._h, oid) == OK
 
     def contains(self, object_id: bytes) -> bool:
@@ -361,6 +374,11 @@ class ShmStore:
         if rc == BAD_STATE:
             raise ChannelClosedError(chan_id.hex())
         _check(rc, f"chan_write_acquire {chan_id.hex()}")
+        _sanitizer.note_acquire(
+            "ring-slot", chan_id.hex(),
+            f"channel {chan_id.hex()} slot acquired but never sealed "
+            "— ring wedged for every later writer",
+        )
         data = payload if isinstance(payload, (bytes, bytearray, memoryview)) \
             else bytes(payload)
         n = len(data)
@@ -369,6 +387,7 @@ class ShmStore:
             # acquired-but-unsealed (that wedges the ring for every
             # later writer) — publish the typed overflow marker instead
             lib.rts_chan_write_seal(self._h, cid, 0, KIND_OVERFLOW_MARKER)
+            _sanitizer.note_release("ring-slot", chan_id.hex())
             raise ValueError(
                 f"payload {n}B exceeds channel slot size {cap.value}B"
             )
@@ -377,6 +396,7 @@ class ShmStore:
             lib.rts_chan_write_seal(self._h, cid, n, kind),
             f"chan_write_seal {chan_id.hex()}",
         )
+        _sanitizer.note_release("ring-slot", chan_id.hex())
 
     def chan_write_chunks(self, chan_id: bytes, chunks, kind: int = 0,
                           timeout_ms: int = -1):
@@ -402,12 +422,18 @@ class ShmStore:
         if rc == BAD_STATE:
             raise ChannelClosedError(chan_id.hex())
         _check(rc, f"chan_write_acquire {chan_id.hex()}")
+        _sanitizer.note_acquire(
+            "ring-slot", chan_id.hex(),
+            f"channel {chan_id.hex()} slot acquired but never sealed "
+            "— ring wedged for every later writer",
+        )
         if total > cap.value:
             # reachable only when endpoints disagree on ring geometry
             # (the creator's slot size won): seal a zero-length marker
             # rather than leave the slot acquired (which would wedge
             # the ring); the reader raises typed on the marker
             lib.rts_chan_write_seal(self._h, cid, 0, KIND_OVERFLOW_MARKER)
+            _sanitizer.note_release("ring-slot", chan_id.hex())
             raise ValueError(
                 f"payload {total}B exceeds channel slot size {cap.value}B"
             )
@@ -419,6 +445,7 @@ class ShmStore:
             lib.rts_chan_write_seal(self._h, cid, total, kind),
             f"chan_write_seal {chan_id.hex()}",
         )
+        _sanitizer.note_release("ring-slot", chan_id.hex())
 
     def chan_read(self, chan_id: bytes, timeout_ms: int = -1):
         """Blocking read: returns (kind, bytes) of the next message and

@@ -16,7 +16,13 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
-from ray_tpu.core.runtime import get_runtime
+def get_runtime():
+    # deferred: core modules import ray_tpu.util (sanitizer wrappers),
+    # and this package's __init__ pulls us in — a module-level runtime
+    # import would close the cycle before Runtime exists
+    from ray_tpu.core.runtime import get_runtime as _get
+
+    return _get()
 
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 

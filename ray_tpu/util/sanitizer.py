@@ -1,0 +1,432 @@
+"""Runtime concurrency sanitizer (`RT_SANITIZE=1`).
+
+The static rtlint pass (RT009–RT013) proves what it can from source;
+this module catches the rest at runtime, the way the reference leans on
+TSan plus its declared lock discipline in `src/ray/common/`.  Three
+detectors, all recording TYPED reports into one process-local list so a
+test can assert exactly what went wrong:
+
+* **Lock order.**  The runtime's declared partial order, written down
+  here once instead of living in PR descriptions::
+
+      rank  0  ray_tpu.serve.api._state_lock      (outermost: held
+               across rt.get() during deployment rollout)
+      rank 10  Runtime._state_lock                (RLock; owner state)
+      rank 20  OwnerShard.lock                    (never before 10)
+      rank 30  leaf locks (rpc outbox, channel/ring internals) —
+               never held while taking anything else
+
+  :func:`wrap_lock` proxies a lock and records per-thread acquisition
+  stacks; acquiring a lock whose rank is LOWER than one already held
+  (on the same thread, different object) is a
+  :class:`LockOrderViolation`.  Reentrant RLock acquires are fine.
+
+* **Loop health.**  While enabled, every asyncio callback in the
+  process is timed (one patched ``Handle._run``); a callback holding
+  its loop longer than ``Config.sanitize_loop_lag_ms`` becomes a
+  :class:`LoopLagViolation` naming the callable — the runtime symptom
+  of everything RT001/RT009 exists to prevent.
+
+* **Leaks.**  :func:`audit_leaks` sweeps at end of test: non-cancelled
+  timers on loops registered via :func:`register_loop` (the PR-1
+  un-cancelled deadline-timer class), coroutines garbage-collected
+  without ever being awaited (PR-6), store objects CREATED but never
+  sealed/aborted and ring slots ACQUIRED but never sealed (PR-15,
+  reported through the :func:`note_acquire`/:func:`note_release` hooks
+  the shm layer calls), and placement groups still CREATED at audit
+  time (PR-9).
+
+Everything is no-op-cheap when disabled: the wrappers check one module
+flag.  `tests/conftest.py` enables this for tests carrying the
+``sanitize`` marker and asserts a clean report at teardown (see
+docs/lint.md, "Running sanitized").
+"""
+
+from __future__ import annotations
+
+import asyncio.events
+import gc
+import os
+import threading
+import time
+import traceback
+import warnings
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# the declared partial order (see module docstring)
+SERVE_STATE_LOCK = 0
+RUNTIME_STATE_LOCK = 10
+SHARD_LOCK = 20
+LEAF_LOCK = 30
+
+
+# ----------------------------------------------------------------------
+# typed reports
+# ----------------------------------------------------------------------
+@dataclass
+class LockOrderViolation:
+    acquiring: str
+    acquiring_rank: int
+    held: str
+    held_rank: int
+    thread: str
+    stack: str = field(repr=False, default="")
+
+    def __str__(self) -> str:
+        return (
+            f"lock-order inversion on {self.thread}: acquiring "
+            f"{self.acquiring!r} (rank {self.acquiring_rank}) while "
+            f"holding {self.held!r} (rank {self.held_rank})"
+        )
+
+
+@dataclass
+class LoopLagViolation:
+    callback: str
+    lag_ms: float
+    threshold_ms: float
+
+    def __str__(self) -> str:
+        return (
+            f"event-loop callback held its loop {self.lag_ms:.0f}ms "
+            f"(threshold {self.threshold_ms:.0f}ms): {self.callback}"
+        )
+
+
+@dataclass
+class LeakReport:
+    kind: str  # pending-timer | unawaited-coroutine | store-create |
+    #            ring-slot | placement-group
+    detail: str
+
+    def __str__(self) -> str:
+        return f"leak[{self.kind}]: {self.detail}"
+
+
+# ----------------------------------------------------------------------
+# state
+# ----------------------------------------------------------------------
+_enabled = os.environ.get("RT_SANITIZE", "") in ("1", "true", "True")
+_lag_threshold_ms = 0.0
+_report_lock = threading.Lock()  # plain on purpose: never sanitized
+_violations: List[Any] = []
+_held = threading.local()  # .stack: per-thread list of SanitizedLock
+_loops: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# (kind, key) -> description of the still-pending acquire
+_pending: Dict[Tuple[str, str], str] = {}
+_orig_handle_run = None
+# "coroutine ... was never awaited" messages trapped while enabled —
+# CPython emits the warning the moment the refcount hits zero, which
+# is mid-test, long before the audit's own capture window
+_unawaited: List[str] = []
+_prev_showwarning = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Flip the sanitizer for THIS process; mirrors RT_SANITIZE so
+    children spawned after the flip inherit it, and (un)installs the
+    loop-lag watchdog."""
+    global _enabled, _lag_threshold_ms
+    _enabled = bool(on)
+    if on:
+        os.environ["RT_SANITIZE"] = "1"
+        _lag_threshold_ms = _resolve_lag_threshold_ms()
+        _install_watchdog()
+        _install_warning_trap()
+    else:
+        os.environ.pop("RT_SANITIZE", None)
+        _uninstall_watchdog()
+        _uninstall_warning_trap()
+
+
+def _resolve_lag_threshold_ms() -> float:
+    env = os.environ.get("RT_SANITIZE_LOOP_LAG_MS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        from ray_tpu.core.config import get_config
+
+        return float(get_config().sanitize_loop_lag_ms)
+    # fall back to the documented default: this runs at enable time,
+    # possibly mid-bootstrap before the config package imports — the
+    # sanitizer must arm regardless
+    except Exception:  # rtlint: disable=RT005
+        return 500.0
+
+
+def violations() -> List[Any]:
+    with _report_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clear recorded violations and pending-acquire bookkeeping
+    (start-of-test)."""
+    with _report_lock:
+        _violations.clear()
+    _pending.clear()
+    _unawaited.clear()
+
+
+def _record(v: Any) -> None:
+    with _report_lock:
+        _violations.append(v)
+
+
+# ----------------------------------------------------------------------
+# lock-order discipline
+# ----------------------------------------------------------------------
+def _stack() -> List["SanitizedLock"]:
+    s = getattr(_held, "stack", None)
+    if s is None:
+        s = _held.stack = []
+    return s
+
+
+class SanitizedLock:
+    """Proxy recording per-thread acquisition order.  Delegates every
+    unknown attribute to the wrapped lock, so RLock reentrancy and
+    Condition integration keep working; the order check reports but
+    never refuses the acquire (a sanitizer must not deadlock the code
+    under test)."""
+
+    def __init__(self, lock: Any, name: str, rank: int):
+        self._lock = lock
+        self.name = name
+        self.rank = rank
+
+    def acquire(self, *args, **kwargs) -> bool:
+        if _enabled:
+            self._check_order()
+        got = self._lock.acquire(*args, **kwargs)
+        if got and _enabled:
+            _stack().append(self)
+        return got
+
+    def release(self) -> None:
+        if _enabled:
+            s = _stack()
+            for i in range(len(s) - 1, -1, -1):
+                if s[i] is self:
+                    del s[i]
+                    break
+        self._lock.release()
+
+    def __enter__(self) -> "SanitizedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _check_order(self) -> None:
+        worst: Optional[SanitizedLock] = None
+        for h in _stack():
+            if h._lock is self._lock:
+                return  # RLock reentry on the same object: always fine
+            if worst is None or h.rank > worst.rank:
+                worst = h
+        if worst is not None and self.rank < worst.rank:
+            _record(
+                LockOrderViolation(
+                    acquiring=self.name,
+                    acquiring_rank=self.rank,
+                    held=worst.name,
+                    held_rank=worst.rank,
+                    thread=threading.current_thread().name,
+                    stack="".join(traceback.format_stack(limit=12)),
+                )
+            )
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._lock, item)
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self.name!r} rank={self.rank}>"
+
+
+def wrap_lock(lock: Any, name: str, rank: int) -> SanitizedLock:
+    """Wrap unconditionally (the declared-order sites call this at
+    construction, which may precede enablement); disabled-mode cost is
+    one flag test per acquire/release."""
+    return SanitizedLock(lock, name, rank)
+
+
+# ----------------------------------------------------------------------
+# loop-lag watchdog
+# ----------------------------------------------------------------------
+def _install_watchdog() -> None:
+    global _orig_handle_run
+    if _orig_handle_run is not None:
+        return
+    _orig_handle_run = asyncio.events.Handle._run
+
+    def _timed_run(handle):
+        t0 = time.monotonic()
+        try:
+            return _orig_handle_run(handle)
+        finally:
+            if _enabled and _lag_threshold_ms > 0:
+                lag_ms = (time.monotonic() - t0) * 1000.0
+                if lag_ms >= _lag_threshold_ms:
+                    cb = getattr(handle, "_callback", None)
+                    _record(
+                        LoopLagViolation(
+                            callback=repr(cb),
+                            lag_ms=lag_ms,
+                            threshold_ms=_lag_threshold_ms,
+                        )
+                    )
+
+    asyncio.events.Handle._run = _timed_run
+
+
+def _uninstall_watchdog() -> None:
+    global _orig_handle_run
+    if _orig_handle_run is not None:
+        asyncio.events.Handle._run = _orig_handle_run
+        _orig_handle_run = None
+
+
+def _install_warning_trap() -> None:
+    global _prev_showwarning
+    if _prev_showwarning is not None:
+        return
+    _prev_showwarning = warnings.showwarning
+
+    def _trap(message, category, filename, lineno, file=None, line=None):
+        if category is RuntimeWarning and "was never awaited" in str(
+            message
+        ):
+            _unawaited.append(f"{str(message)} ({filename}:{lineno})")
+        return _prev_showwarning(
+            message, category, filename, lineno, file, line
+        )
+
+    warnings.showwarning = _trap
+
+
+def _uninstall_warning_trap() -> None:
+    global _prev_showwarning
+    if _prev_showwarning is not None:
+        warnings.showwarning = _prev_showwarning
+        _prev_showwarning = None
+
+
+def register_loop(
+    loop: asyncio.AbstractEventLoop, name: str, audit_timers: bool = True
+) -> None:
+    """Register a loop with the sanitizer.  ``audit_timers=True`` opts
+    it into the end-of-test pending-timer audit; infrastructure loops
+    (the runtime io loop, owner shards) register with ``False`` because
+    lease-keepalive and deadline timers are LEGITIMATELY armed between
+    tests on a module-scoped cluster — their discipline is that
+    shutdown cancels them, which the probe tests assert on dedicated
+    loops instead."""
+    try:
+        _loops[loop] = (name, bool(audit_timers))
+    except TypeError:  # non-weakrefable test double
+        pass
+
+
+# ----------------------------------------------------------------------
+# acquire/release leak notes (shm store + channel rings)
+# ----------------------------------------------------------------------
+def note_acquire(kind: str, key: str, detail: str = "") -> None:
+    if _enabled:
+        _pending[(kind, key)] = detail or key
+
+
+def note_release(kind: str, key: str) -> None:
+    if _enabled:
+        _pending.pop((kind, key), None)
+
+
+# ----------------------------------------------------------------------
+# end-of-test audits
+# ----------------------------------------------------------------------
+def audit_leaks() -> List[LeakReport]:
+    out: List[LeakReport] = []
+    # coroutines collected without ever being awaited surface as
+    # RuntimeWarning at finalization — force the sweep and capture
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        gc.collect()
+    for w in caught:
+        msg = str(w.message)
+        if "was never awaited" in msg:
+            out.append(LeakReport("unawaited-coroutine", msg))
+    # ...plus the ones the persistent trap caught mid-test (refcount-
+    # zero coroutines finalize immediately, not at this gc pass)
+    for msg in _unawaited:
+        out.append(LeakReport("unawaited-coroutine", msg))
+    _unawaited.clear()
+    # armed timers that nobody will ever cancel (closed loops dropped
+    # their callbacks; only live loops can still misfire)
+    for loop, (name, audit_timers) in list(_loops.items()):
+        if not audit_timers or loop.is_closed():
+            continue
+        for th in list(getattr(loop, "_scheduled", ())):
+            if not getattr(th, "_cancelled", False):
+                out.append(
+                    LeakReport(
+                        "pending-timer",
+                        f"loop {name!r}: "
+                        f"{getattr(th, '_callback', th)!r}",
+                    )
+                )
+    # created-unsealed store objects / acquired-unsealed ring slots
+    for (kind, key), detail in sorted(_pending.items()):
+        out.append(LeakReport(kind, detail))
+    # placement groups still CREATED (pinning bundles) when the test
+    # ends; only meaningful while a runtime is up
+    try:
+        from ray_tpu.core import runtime as _runtime_mod
+
+        rt = getattr(_runtime_mod, "_runtime", None)
+        if rt is not None:
+            from ray_tpu.util.placement_group import placement_group_table
+
+            for row in placement_group_table() or []:
+                if row.get("state") == "CREATED":
+                    out.append(
+                        LeakReport(
+                            "placement-group",
+                            f"pg {row.get('pg_id', '?')} still CREATED "
+                            f"(bundles {row.get('bundles')})",
+                        )
+                    )
+    # no live control plane to ask (runtime down or mid-shutdown) —
+    # nothing to audit; the other detectors above already reported
+    except Exception:  # rtlint: disable=RT005
+        pass
+    return out
+
+
+# a process born with RT_SANITIZE=1 (workers under a sanitized test,
+# `RT_SANITIZE=1 pytest ...`) arms the watchdog immediately — enabled()
+# alone would track locks but never time callbacks
+if _enabled:
+    set_enabled(True)
+
+
+def check_clean() -> None:
+    """Raise AssertionError listing every violation and leak (the
+    `sanitize` marker's teardown assertion)."""
+    probs = [str(v) for v in violations()] + [
+        str(r) for r in audit_leaks()
+    ]
+    if probs:
+        raise AssertionError(
+            "sanitizer found %d problem(s):\n  %s"
+            % (len(probs), "\n  ".join(probs))
+        )

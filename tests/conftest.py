@@ -29,6 +29,30 @@ except Exception:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _sanitize(request):
+    """Tests carrying the `sanitize` marker run under the runtime
+    sanitizer (ray_tpu/util/sanitizer.py): lock-order tracking, the
+    loop-lag watchdog, and end-of-test leak audits, asserted clean at
+    teardown.  RT_SANITIZE=1 propagates to spawned workers.  Being
+    autouse and requested FIRST, its teardown runs LAST — after
+    rt_start has shut the runtime down — so the audit sees final
+    state, not mid-shutdown churn."""
+    marker = request.node.get_closest_marker("sanitize")
+    if marker is None:
+        yield
+        return
+    from ray_tpu.util import sanitizer
+
+    sanitizer.set_enabled(True)
+    sanitizer.reset()
+    try:
+        yield
+        sanitizer.check_clean()
+    finally:
+        sanitizer.set_enabled(False)
+
+
 @pytest.fixture
 def rt_start():
     """Start a fresh single-node runtime for a test, shut down after."""

@@ -12,6 +12,10 @@ import pytest
 import ray_tpu as rt
 from ray_tpu.exceptions import GetTimeoutError, TaskError
 
+# tier-1 sanitized subset: every test in this module runs under the
+# runtime sanitizer (lock order, loop lag, leak audits) — see conftest
+pytestmark = pytest.mark.sanitize
+
 
 @pytest.fixture(scope="module")
 def cluster():

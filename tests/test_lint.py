@@ -486,6 +486,394 @@ def test_rt008_negative(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# RT009–RT013: the interprocedural pass (ray_tpu/lint/concurrency.py)
+# ----------------------------------------------------------------------
+def test_rt009_positive_transitive_blocking(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        def inner():
+            time.sleep(0.5)
+
+        def middle():
+            return inner()
+
+        async def handler():
+            middle()
+        """,
+        select={"RT009"},
+    )
+    assert _rules(out) == {"RT009"}
+    # the finding names the chain and lands at the async call site
+    assert "middle -> inner" in out[0].message
+
+
+def test_rt009_positive_self_method_chain(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        class Daemon:
+            def _spawn(self):
+                time.sleep(0.1)
+
+            async def handle(self):
+                self._spawn()
+        """,
+        select={"RT009"},
+    )
+    assert _rules(out) == {"RT009"}
+
+
+def test_rt009_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+        import time
+
+        def inner():
+            time.sleep(0.5)
+
+        async def fine_executor():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, inner)
+
+        async def async_callee():
+            await asyncio.sleep(0.5)
+
+        async def fine_async_edge():
+            # blocking inside an async callee is that callee's own
+            # RT001, not an RT009 chain
+            await async_callee()
+
+        def sync_caller():
+            inner()  # whole chain is sync: nothing to stall
+        """,
+        select={"RT009"},
+    )
+    assert "RT009" not in _rules(out)
+
+
+def test_rt009_source_site_suppression_covers_all_callers(tmp_path):
+    # one rationale'd suppression at the blocking line exempts every
+    # async caller of the chain
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        def inner():
+            time.sleep(0.5)  # rtlint: disable=RT009
+
+        async def a():
+            inner()
+
+        async def b():
+            inner()
+        """,
+        select={"RT009"},
+    )
+    assert "RT009" not in _rules(out)
+
+
+def test_rt010_positive_discarded_timer(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        def arm(loop, cb):
+            loop.call_later(5.0, cb)
+        """,
+        select={"RT010"},
+    )
+    assert _rules(out) == {"RT010"}
+    assert "discarded" in out[0].message
+
+
+def test_rt010_positive_dead_local_span(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        from ray_tpu.util.tracing import start_span
+
+        def traced():
+            span = start_span("op", kind="x")
+            do_work()
+        """,
+        select={"RT010"},
+    )
+    assert _rules(out) == {"RT010"}
+
+
+def test_rt010_positive_unsealed_ring_acquire(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        def write(store, cid):
+            store.chan_write_acquire(cid)
+            copy_payload()
+        """,
+        select={"RT010"},
+    )
+    assert _rules(out) == {"RT010"}
+
+
+def test_rt010_positive_unsealed_store_create(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        def put(store, oid, data):
+            buf = store.create(oid, len(data))
+            buf[: len(data)] = data
+        """,
+        select={"RT010"},
+    )
+    assert _rules(out) == {"RT010"}
+
+
+def test_rt010_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        from ray_tpu.util.tracing import finish_span, start_span
+
+        def timer_kept(loop, cb):
+            handle = loop.call_later(5.0, cb)
+            return handle  # escapes: the caller owns cancellation
+
+        def timer_cancelled(loop, cb):
+            handle = loop.call_later(5.0, cb)
+            try:
+                work()
+            finally:
+                handle.cancel()
+
+        def traced():
+            span = start_span("op", kind="x")
+            try:
+                do_work()
+            finally:
+                finish_span(span)
+
+        def sealed(store, oid, data):
+            buf = store.create(oid, len(data))
+            try:
+                buf[: len(data)] = data
+                store.seal(oid)
+            except Exception:
+                store.abort(oid)
+                raise
+
+        def ring_ok(store, cid):
+            store.chan_write_acquire(cid)
+            store.chan_write_seal(cid)
+
+        def not_a_store(pool, oid):
+            pool.create(oid, 1)  # receiver isn't a store: out of scope
+        """,
+        select={"RT010"},
+    )
+    assert "RT010" not in _rules(out)
+
+
+def test_rt011_positive_cross_thread_call_soon(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        class Conn:
+            def send(self, data):
+                self._loop.call_soon(self._flush)
+        """,
+        select={"RT011"},
+    )
+    assert _rules(out) == {"RT011"}
+
+
+def test_rt011_positive_module_scope_primitive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        ready = asyncio.Event()
+
+        class Shared:
+            wake = asyncio.Condition()
+        """,
+        select={"RT011"},
+    )
+    assert len([f for f in out if f.rule == "RT011"]) == 2
+
+
+def test_rt011_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        class Conn:
+            def send_threadsafe(self, data):
+                self._loop.call_soon_threadsafe(self._flush)
+
+            def same_thread(self):
+                loop = asyncio.get_event_loop()
+                loop.call_soon(self._flush)  # provably this thread's loop
+
+            async def on_loop(self):
+                self._loop.call_soon(self._flush)  # coroutine: on-loop
+
+            def not_a_loop(self):
+                self.executor.call_soon(self._flush)  # not loop-ish
+
+        def make_event():
+            return asyncio.Event()  # constructed inside a function: ok
+        """,
+        select={"RT011"},
+    )
+    assert "RT011" not in _rules(out)
+
+
+def test_rt012_positive(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        class Engine:
+            async def flush(self):
+                pass
+
+            async def run(self):
+                self.flush()  # bare statement: never executes
+
+            def check(self):
+                if self.flush():  # always-truthy coroutine object
+                    return True
+        """,
+        select={"RT012"},
+    )
+    assert len([f for f in out if f.rule == "RT012"]) == 2
+
+
+def test_rt012_negative(tmp_path):
+    out = _lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        class Engine:
+            async def flush(self):
+                pass
+
+            async def run(self):
+                await self.flush()
+                task = asyncio.ensure_future(self.flush())
+                return task
+
+            def sync_call(self):
+                self.other()  # resolves to nothing async
+
+            def other(self):
+                pass
+        """,
+        select={"RT012"},
+    )
+    assert "RT012" not in _rules(out)
+
+
+_CATALOG_FIXTURE = """
+CATALOG = {
+    "rt_known_total": ("counter", "help", (), None),
+}
+"""
+
+
+def _write_tree(tmp_path, files):
+    for rel, code in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    return lint_paths([str(tmp_path / "ray_tpu")], root=str(tmp_path))
+
+
+def test_rt013_positive_unknown_metric_name(tmp_path):
+    out = _write_tree(
+        tmp_path,
+        {
+            "ray_tpu/metrics/metric_defs.py": _CATALOG_FIXTURE,
+            "ray_tpu/core/mod.py": """
+                from ray_tpu.metrics.metric_defs import inc
+
+                def f():
+                    inc("rt_typo_total")
+                """,
+        },
+    )
+    rt13 = [f for f in out if f.rule == "RT013"]
+    # the typo at the call site AND the now-unreferenced catalog row
+    assert any("rt_typo_total" in f.message for f in rt13)
+    assert any("rt_known_total" in f.message for f in rt13)
+
+
+def test_rt013_positive_grafana_unknown_panel_metric(tmp_path):
+    out = _write_tree(
+        tmp_path,
+        {
+            "ray_tpu/metrics/metric_defs.py": _CATALOG_FIXTURE,
+            "ray_tpu/dashboard/grafana.py": """
+                PANEL = "rate(rt_known_total[5m]) + rt_ghost_total"
+                """,
+        },
+    )
+    rt13 = [f for f in out if f.rule == "RT013"]
+    assert any("rt_ghost_total" in f.message for f in rt13)
+    assert not any("'rt_known_total'" in f.message for f in rt13)
+
+
+def test_rt013_negative_catalog_in_sync(tmp_path):
+    out = _write_tree(
+        tmp_path,
+        {
+            "ray_tpu/metrics/metric_defs.py": _CATALOG_FIXTURE,
+            "ray_tpu/core/mod.py": """
+                from ray_tpu.metrics.metric_defs import inc, observe
+
+                def f(name):
+                    inc("rt_known_total")
+                    observe(name, 1.0)  # dynamic name: out of scope
+                """,
+            "ray_tpu/dashboard/grafana.py": """
+                LOCAL = _gauge("rt_dash_local", "dashboard-only gauge")
+                PANEL = "sum(rate(rt_known_total[5m])) + rt_dash_local"
+                """,
+        },
+    )
+    assert "RT013" not in _rules(out)
+
+
+def test_rt013_knob_drift(tmp_path):
+    files = {
+        "ray_tpu/core/config.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Config:
+                documented_knob: int = 1
+                secret_knob: int = 2
+            """,
+    }
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "configuration.md").write_text(
+        "| `documented_knob` (`RT_DOCUMENTED_KNOB`) | 1 | documented |\n"
+    )
+    out = _write_tree(tmp_path, files)
+    rt13 = [f for f in out if f.rule == "RT013"]
+    assert len(rt13) == 1 and "RT_SECRET_KNOB" in rt13[0].message
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 def test_inline_suppression(tmp_path):
@@ -592,10 +980,15 @@ def test_parse_error_is_a_finding(tmp_path):
 import functools
 
 
+_repo_stats: dict = {}
+
+
 @functools.lru_cache(maxsize=1)
 def _repo_findings():
     return tuple(lint_paths(
-        [str(REPO / "ray_tpu"), str(REPO / "tests")], root=str(REPO)
+        [str(REPO / "ray_tpu"), str(REPO / "tests")],
+        root=str(REPO),
+        stats=_repo_stats,
     ))
 
 
@@ -637,6 +1030,15 @@ def test_baseline_has_no_core_or_serve_rt001_rt002_rt005():
     ]
     offenders += [
         k for k in baseline if k.startswith("ray_tpu/dag/")
+    ]
+    # the v2 interprocedural rules landed with core/serve at zero —
+    # they never get grandfathered there (fix the bug or carry an
+    # inline rationale'd suppression, never a baseline entry)
+    offenders += [
+        k
+        for k in baseline
+        if k.split("::")[1] in ("RT009", "RT010", "RT011", "RT012", "RT013")
+        and k.startswith(("ray_tpu/core/", "ray_tpu/serve/"))
     ]
     assert not offenders, offenders
 
@@ -696,9 +1098,59 @@ def test_seeded_violations_fail_the_gate(tmp_path):
     assert {f.rule for f in new} >= {"RT001", "RT002", "RT004", "RT005"}
 
 
+def test_seeded_concurrency_violations_fail_the_gate(tmp_path):
+    """Same acceptance probe for the v2 interprocedural rules: one
+    deliberate violation each of RT009–RT012 planted in a mirror of
+    core/ comes back NEW against the real baseline."""
+    code = """
+        import asyncio
+        import time
+
+        ready = asyncio.Event()
+
+        def _inner():
+            time.sleep(0.2)
+
+        def _middle():
+            _inner()
+
+        class Planted:
+            async def handler(self):
+                _middle()
+
+            async def forgot(self):
+                pass
+
+            async def run(self):
+                self.forgot()
+
+            def arm(self, loop, cb):
+                loop.call_later(5.0, cb)
+
+            def send(self):
+                self._loop.call_soon(self.arm)
+        """
+    findings = _lint_snippet(tmp_path, code, rel="ray_tpu/core/planted.py")
+    assert {"RT009", "RT010", "RT011", "RT012"} <= _rules(findings)
+    baseline = load_baseline(default_baseline_path())
+    new, _ = compare_to_baseline(findings, baseline)
+    assert {f.rule for f in new} >= {"RT009", "RT010", "RT011", "RT012"}
+
+
+def test_interprocedural_pass_is_fast():
+    """The whole-repo interprocedural pass (project index build +
+    RT009–RT013) must stay under 30s so the lint gate stays cheap
+    enough to run on every test invocation."""
+    _repo_findings()  # fills _repo_stats (cached: free if already run)
+    inter = [r for r in _repo_stats if r >= "RT009" and r != "_total"]
+    assert inter, "stats missing the interprocedural rules"
+    spent = sum(_repo_stats[r]["seconds"] for r in inter)
+    assert spent < 30.0, f"interprocedural pass took {spent:.1f}s: {_repo_stats}"
+
+
 def test_rule_catalog_complete():
     rules = [r for r, _n, _d in rule_catalog()]
-    assert rules == [f"RT00{i}" for i in range(1, 9)]
+    assert rules == [f"RT{i:03d}" for i in range(1, 14)]
 
 
 def test_cli_runs_clean():

@@ -16,6 +16,10 @@ import pytest
 
 import ray_tpu as rt
 from ray_tpu.core.owner_shard import _parse_lease_reply, shard_index
+
+# tier-1 sanitized subset: every test in this module runs under the
+# runtime sanitizer (lock order, loop lag, leak audits) — see conftest
+pytestmark = pytest.mark.sanitize
 from ray_tpu.core.runtime import get_runtime
 from ray_tpu.exceptions import DeadlineExceededError, TaskCancelledError
 

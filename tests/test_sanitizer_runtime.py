@@ -1,0 +1,243 @@
+"""Unit tests for the runtime sanitizer (ray_tpu/util/sanitizer.py).
+
+Each test provokes exactly the bug class the sanitizer exists to catch
+— a lock-order inversion, a blocked event loop, a leaked timer, an
+unawaited coroutine, an unsealed store create / ring slot — and asserts
+the TYPED report comes back (not just "something failed").  These are
+the acceptance probes for the `sanitize` marker: if a detector here
+goes quiet, the sanitized tier-1 subset is running blind.
+
+The tests manage enable/disable themselves (no `sanitize` marker —
+that marker's autouse fixture asserts *clean*, which is exactly the
+opposite of what a detector probe wants).
+"""
+
+import asyncio
+import gc
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util import sanitizer
+from ray_tpu.util.sanitizer import (
+    LeakReport,
+    LockOrderViolation,
+    LoopLagViolation,
+    RUNTIME_STATE_LOCK,
+    SERVE_STATE_LOCK,
+    SHARD_LOCK,
+)
+
+
+@pytest.fixture()
+def san():
+    sanitizer.set_enabled(True)
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.reset()
+    sanitizer.set_enabled(False)
+
+
+# ----------------------------------------------------------------------
+# lock-order discipline
+# ----------------------------------------------------------------------
+def test_lock_order_inversion_is_reported(san):
+    outer = san.wrap_lock(threading.RLock(), "runtime._state_lock",
+                          RUNTIME_STATE_LOCK)
+    inner = san.wrap_lock(threading.Lock(), "shard[0].lock", SHARD_LOCK)
+    # declared order is runtime(10) -> shard(20); taking them backwards
+    # is the deadlock shape the declared partial order forbids
+    with inner:
+        with outer:
+            pass
+    vs = [v for v in san.violations() if isinstance(v, LockOrderViolation)]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.acquiring == "runtime._state_lock"
+    assert v.acquiring_rank == RUNTIME_STATE_LOCK
+    assert v.held == "shard[0].lock"
+    assert v.held_rank == SHARD_LOCK
+    assert "inversion" in str(v)
+
+
+def test_lock_order_correct_order_and_reentry_are_clean(san):
+    serve = san.wrap_lock(threading.Lock(), "serve._state_lock",
+                          SERVE_STATE_LOCK)
+    rt = san.wrap_lock(threading.RLock(), "runtime._state_lock",
+                       RUNTIME_STATE_LOCK)
+    shard = san.wrap_lock(threading.Lock(), "shard[0].lock", SHARD_LOCK)
+    with serve:
+        with rt:
+            with rt:  # RLock reentry on the same object: always fine
+                with shard:
+                    pass
+    # out-of-order RELEASE is also fine — only acquisition order is law
+    rt.acquire()
+    shard.acquire()
+    rt.release()
+    shard.release()
+    assert san.violations() == []
+
+
+def test_lock_order_never_blocks_the_acquire(san):
+    # a sanitizer must report, not deadlock: the inverted acquire still
+    # succeeds and the code under test keeps running
+    outer = san.wrap_lock(threading.Lock(), "a", RUNTIME_STATE_LOCK)
+    inner = san.wrap_lock(threading.Lock(), "b", SHARD_LOCK)
+    with inner:
+        assert outer.acquire(timeout=1)
+        outer.release()
+    assert len(san.violations()) == 1
+
+
+def test_lock_order_quiet_when_disabled():
+    sanitizer.set_enabled(False)
+    sanitizer.reset()
+    outer = sanitizer.wrap_lock(threading.Lock(), "a", RUNTIME_STATE_LOCK)
+    inner = sanitizer.wrap_lock(threading.Lock(), "b", SHARD_LOCK)
+    with inner:
+        with outer:
+            pass
+    assert sanitizer.violations() == []
+
+
+# ----------------------------------------------------------------------
+# loop-lag watchdog
+# ----------------------------------------------------------------------
+def test_loop_lag_blocked_loop_is_reported(san, monkeypatch):
+    monkeypatch.setenv("RT_SANITIZE_LOOP_LAG_MS", "50")
+    san.set_enabled(True)  # re-resolve the threshold from the env
+    loop = asyncio.new_event_loop()
+    try:
+        san.register_loop(loop, "probe")
+
+        async def blocks_the_loop():
+            # the deliberate RT001 bug this detector exists to catch
+            time.sleep(0.12)  # rtlint: disable=RT001
+
+        loop.run_until_complete(blocks_the_loop())
+    finally:
+        loop.close()
+    vs = [v for v in san.violations() if isinstance(v, LoopLagViolation)]
+    assert vs, san.violations()
+    assert vs[0].lag_ms >= 50 and vs[0].threshold_ms == 50
+    assert "held its loop" in str(vs[0])
+
+
+def test_loop_lag_fast_callbacks_are_clean(san, monkeypatch):
+    monkeypatch.setenv("RT_SANITIZE_LOOP_LAG_MS", "200")
+    san.set_enabled(True)
+    loop = asyncio.new_event_loop()
+    try:
+        san.register_loop(loop, "probe")
+
+        async def quick():
+            await asyncio.sleep(0)  # many sub-ms callbacks
+
+        loop.run_until_complete(quick())
+    finally:
+        loop.close()
+    assert not [
+        v for v in san.violations() if isinstance(v, LoopLagViolation)
+    ]
+
+
+# ----------------------------------------------------------------------
+# end-of-test leak audits
+# ----------------------------------------------------------------------
+def test_leaked_timer_is_reported_and_cancel_clears_it(san):
+    loop = asyncio.new_event_loop()
+    try:
+        san.register_loop(loop, "probe")
+        handle = loop.call_later(60.0, lambda: None)
+        leaks = [r for r in san.audit_leaks() if r.kind == "pending-timer"]
+        assert len(leaks) == 1 and "probe" in leaks[0].detail
+        handle.cancel()
+        assert not [
+            r for r in san.audit_leaks() if r.kind == "pending-timer"
+        ]
+    finally:
+        loop.close()
+
+
+def test_infrastructure_loops_opt_out_of_timer_audit(san):
+    # module-scoped clusters legitimately keep keepalive/deadline
+    # timers armed between tests; their loops register audit_timers=False
+    loop = asyncio.new_event_loop()
+    try:
+        san.register_loop(loop, "rt-io", audit_timers=False)
+        # deliberately discarded: proves the opt-out actually opts out
+        loop.call_later(60.0, lambda: None)  # rtlint: disable=RT010
+        assert not [
+            r for r in san.audit_leaks() if r.kind == "pending-timer"
+        ]
+    finally:
+        loop.close()
+
+
+def test_unawaited_coroutine_is_reported(san):
+    async def forgotten():
+        pass
+
+    # the deliberate RT012 bug this detector exists to catch
+    forgotten()  # rtlint: disable=RT012
+    gc.collect()
+    leaks = [
+        r for r in san.audit_leaks() if r.kind == "unawaited-coroutine"
+    ]
+    assert leaks and "forgotten" in leaks[0].detail
+
+
+def test_unsealed_store_create_and_ring_slot_are_reported(san):
+    san.note_acquire("store-create", "deadbeef", "object deadbeef")
+    san.note_acquire("ring-slot", "cafe", "chan cafe slot")
+    san.note_release("store-create", "deadbeef")  # sealed: forgiven
+    leaks = san.audit_leaks()
+    kinds = [r.kind for r in leaks]
+    assert "ring-slot" in kinds and "store-create" not in kinds
+    slot = next(r for r in leaks if r.kind == "ring-slot")
+    assert "cafe" in slot.detail and "leak[ring-slot]" in str(slot)
+
+
+def test_check_clean_raises_with_every_problem_listed(san):
+    inner = san.wrap_lock(threading.Lock(), "b", SHARD_LOCK)
+    outer = san.wrap_lock(threading.Lock(), "a", RUNTIME_STATE_LOCK)
+    with inner:
+        with outer:
+            pass
+    san.note_acquire("ring-slot", "cafe", "chan cafe slot")
+    with pytest.raises(AssertionError) as exc:
+        san.check_clean()
+    msg = str(exc.value)
+    assert "lock-order inversion" in msg and "leak[ring-slot]" in msg
+    # the raise drained pending state via audit_leaks; reset for teardown
+    san.reset()
+
+
+def test_check_clean_passes_when_clean(san):
+    lock = san.wrap_lock(threading.Lock(), "a", RUNTIME_STATE_LOCK)
+    with lock:
+        pass
+    san.check_clean()
+
+
+def test_reset_clears_violations_and_pending(san):
+    inner = san.wrap_lock(threading.Lock(), "b", SHARD_LOCK)
+    outer = san.wrap_lock(threading.Lock(), "a", RUNTIME_STATE_LOCK)
+    with inner:
+        with outer:
+            pass
+    san.note_acquire("store-create", "x")
+    san.reset()
+    assert san.violations() == []
+    assert not [r for r in san.audit_leaks() if r.kind == "store-create"]
+
+
+def test_enable_mirrors_env_for_spawned_workers(san):
+    import os
+
+    assert os.environ.get("RT_SANITIZE") == "1"
+    san.set_enabled(False)
+    assert "RT_SANITIZE" not in os.environ
+    san.set_enabled(True)

@@ -43,6 +43,7 @@ def _http_post(url, data: bytes, timeout=10):
         return r.status, r.read()
 
 
+@pytest.mark.sanitize  # serve smoke: tier-1 sanitized subset
 def test_deploy_and_handle_call(serve_instance):
     @serve.deployment
     class Echo:
@@ -55,6 +56,7 @@ def test_deploy_and_handle_call(serve_instance):
     assert serve.status()["echo"]["Echo"]["running"] == 1
 
 
+@pytest.mark.sanitize  # serve smoke: tier-1 sanitized subset
 def test_function_deployment_and_http(serve_instance):
     @serve.deployment
     def square(request):
@@ -68,6 +70,7 @@ def test_function_deployment_and_http(serve_instance):
     assert json.loads(body) == {"out": 49}
 
 
+@pytest.mark.sanitize  # serve smoke: tier-1 sanitized subset
 def test_composition_sync_handles(serve_instance):
     @serve.deployment
     class Doubler:
